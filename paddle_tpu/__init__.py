@@ -1,0 +1,82 @@
+"""paddle_tpu — a TPU-native deep learning framework with the PaddlePaddle
+API surface, re-founded on JAX/XLA/Pallas.
+
+Architecture (see SURVEY.md §7): eager UX on a tape over jnp ops;
+`to_static`≅jax.jit; PIR≅StableHLO; CINN≅XLA+Pallas; ProcessGroupNCCL≅
+ICI/DCN collectives; auto_parallel≅GSPMD.
+"""
+from __future__ import annotations
+
+import os as _os
+
+# x64 must be on before any jax computation: paddle's default int dtype is
+# int64 and float64 tensors exist.  Creation ops pass explicit dtypes so the
+# framework default float stays float32.
+import jax as _jax
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from . import flags as _flags_mod
+from .flags import set_flags, get_flags
+
+from . import dtype as _dtype_mod
+from .dtype import (DType, bool_, uint8, int8, int16, int32, int64, float16,
+                    bfloat16, float32, float64, complex64, complex128,
+                    set_default_dtype, get_default_dtype)
+bool = bool_  # paddle.bool
+
+from . import device
+from .device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace, CustomPlace,
+                     CUDAPinnedPlace, set_device, get_device,
+                     is_compiled_with_cuda, is_compiled_with_rocm,
+                     is_compiled_with_xpu, is_compiled_with_cinn,
+                     is_compiled_with_distribute)
+
+from .core.tensor import Tensor, to_tensor, is_tensor
+from .core.autograd_state import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .core import dispatch as _dispatch
+from .core.dispatch import grad
+
+from .random_state import seed, get_rng_state, set_rng_state, Generator
+from .random_state import get_rng_state_tracker as _get_rng_state_tracker
+
+# op surface
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum
+from .tensor.creation import create_parameter
+from .tensor.search import topk, where, nonzero, argmax, argmin, argsort, sort
+
+# static check helpers
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def in_static_mode() -> bool:
+    return not in_dynamic_mode()
+
+
+in_dygraph_mode = in_dynamic_mode
+in_dynamic_or_pir_mode = in_dynamic_mode
+
+
+def get_cudnn_version():
+    return None
+
+
+# subpackage re-exports grow here as each build stage lands (SURVEY.md §7).
+_SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
+                "linalg", "fft", "signal", "framework", "jit", "static",
+                "distributed", "distribution", "vision", "hapi", "incubate",
+                "utils", "profiler", "sparse", "text", "audio",
+                "quantization", "onnx", "version"]
+
+
+def __getattr__(name):
+    # lazy subpackage import keeps partially-built stages from breaking the core
+    if name in _SUBPACKAGES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,3 @@
+from .tensor import Tensor, Parameter, to_tensor, is_tensor
+from .autograd_state import no_grad, enable_grad, grad_enabled
+from .dispatch import call_op, call_op_custom_vjp, run_backward, grad

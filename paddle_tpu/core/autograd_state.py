@@ -1,0 +1,68 @@
+"""Global autograd mode (ref: paddle/fluid/eager/api/utils/global_utils.h
+tracer state + python paddle.no_grad / paddle.enable_grad)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.inside_backward = False
+
+
+_state = _State()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class _GradCtx:
+    """Context manager *and* decorator, like paddle.no_grad."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = []
+
+    def __enter__(self):
+        self._prev.append(_state.grad_enabled)
+        _state.grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev.pop()
+        return False
+
+    def __call__(self, func):
+        if not callable(func):
+            raise TypeError("no_grad/enable_grad used as decorator needs a callable")
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with type(self)(self._mode):
+                return func(*args, **kwargs)
+        return wrapper
+
+
+class no_grad(_GradCtx):
+    def __init__(self):
+        super().__init__(False)
+
+
+class enable_grad(_GradCtx):
+    def __init__(self):
+        super().__init__(True)
+
+
+class set_grad_enabled_ctx(_GradCtx):
+    def __init__(self, mode: bool):
+        super().__init__(bool(mode))
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled

@@ -1,0 +1,393 @@
+"""The Tensor façade over jax.Array, with dygraph-style autograd metadata.
+
+TPU-native re-design of the reference's eager Tensor
+(ref: paddle/fluid/eager/ — AutogradMeta/GradNode; paddle/phi/core/dense_tensor.h).
+A Tensor owns a jax value (concrete ``jax.Array`` in eager mode, a tracer
+when executing under ``paddle.jit``), ``stop_gradient``, an optional
+``.grad``, and a link to the GradNode that produced it.  All math lives in
+``paddle_tpu/tensor/*`` as pure jnp functions dispatched through
+``core.dispatch``; methods are monkey-patched onto this class the same way
+the reference patches methods from python/paddle/tensor/.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dtypes
+from ..device import Place, current_place
+from .autograd_state import grad_enabled
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+class Tensor:
+    """Eager tensor. ``stop_gradient`` defaults to True like the reference;
+    Parameters default to False."""
+
+    # populated by paddle_tpu.tensor (monkey-patched op methods)
+    __slots__ = (
+        "_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
+        "name", "persistable", "_retain_grads", "_hooks", "_is_param",
+        "_paddle_attrs", "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        jdt = dtypes.to_jax(dtype) if dtype is not None else None
+        if isinstance(data, (jnp.ndarray, jax.Array)) or _is_tracer(data):
+            val = data if jdt is None else data.astype(jdt)
+        else:
+            arr = np.asarray(data)
+            if jdt is None:
+                # paddle defaults: python float → default float dtype,
+                # python int → int64
+                if arr.dtype == np.float64 and not isinstance(data, np.ndarray):
+                    jdt = dtypes.default_float().numpy_dtype
+                elif arr.dtype == np.int64 and not isinstance(data, np.ndarray):
+                    jdt = dtypes.int64.numpy_dtype
+            val = jnp.asarray(arr, dtype=jdt)
+        self._data = val
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name or ""
+        self.persistable = False
+        self._retain_grads = False
+        self._hooks: List[Callable] = []
+        self._is_param = False
+        self._paddle_attrs = None
+
+    # ------------------------------------------------------------------
+    # value plumbing
+    # ------------------------------------------------------------------
+    @property
+    def value(self):
+        """The underlying jax value."""
+        return self._data
+
+    def _replace_value(self, new_value):
+        """In-place value swap (used by inplace ops / optimizer updates)."""
+        self._data = new_value
+
+    def _bind_node(self, node, out_index: int):
+        self._grad_node = node
+        self._out_index = out_index
+
+    def _snapshot(self) -> "Tensor":
+        """Shallow autograd snapshot: same value + producer node, used by
+        in-place ops so the recorded node references the *old* identity
+        (avoids a self-loop when this tensor rebinds to the new node)."""
+        s = Tensor(self._data, stop_gradient=self.stop_gradient)
+        s._grad_node = self._grad_node
+        s._out_index = self._out_index
+        return s
+
+    def _inplace_assign(self, out: "Tensor") -> "Tensor":
+        """Adopt the value + autograd identity of ``out`` (the result of the
+        out-of-place twin op).  Callers must compute ``out`` from a
+        ``_snapshot()`` of self, not self."""
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+        return self
+
+    def _check_inplace_autograd(self):
+        from .autograd_state import grad_enabled
+        if grad_enabled() and not self.stop_gradient and self._grad_node is None:
+            raise RuntimeError(
+                "in-place operation on a leaf Tensor that requires grad "
+                "is not allowed (wrap in paddle.no_grad() for updates)")
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    ndimension = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return dtypes.convert_dtype(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        return current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g)
+        self._grad = g
+
+    @property
+    def is_tensor(self):
+        return True
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not v
+
+    def is_floating_point(self) -> bool:
+        return dtypes.is_floating(self.dtype)
+
+    def is_integer(self) -> bool:
+        return dtypes.is_integer(self.dtype)
+
+    def is_complex(self) -> bool:
+        return dtypes.is_complex(self.dtype)
+
+    # ------------------------------------------------------------------
+    # host interop
+    # ------------------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        if _is_tracer(self._data):
+            raise RuntimeError(
+                "Tensor.numpy() is not available while tracing under "
+                "paddle.jit; this is a graph-break point.")
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        arr = self.numpy()
+        return arr.item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        return bool(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .dispatch import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable) -> "_HookHandle":
+        self._hooks.append(hook)
+        return _HookHandle(self._hooks, hook)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._data))
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .dispatch import call_op
+        return call_op(lambda x: x + jnp.zeros((), dtype=x.dtype), (self,), {})
+
+    # ------------------------------------------------------------------
+    # mutation
+    # ------------------------------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            value = value.reshape(self._data.shape)
+        self._data = value
+        return self
+
+    def copy_(self, other, *args):
+        return self.set_value(other)
+
+    def _to_place(self, place) -> "Tensor":
+        from ..device import jax_device
+        if _is_tracer(self._data):
+            return self
+        d = jax.device_put(self._data, jax_device(place))
+        t = Tensor(d, stop_gradient=self.stop_gradient, name=self.name)
+        return t
+
+    def cpu(self):
+        from ..device import CPUPlace
+        return self._to_place(CPUPlace())
+
+    def cuda(self, device_id=0, blocking=True):
+        from ..device import TPUPlace
+        return self._to_place(TPUPlace(device_id))
+
+    def tpu(self, device_id=0):
+        from ..device import TPUPlace
+        return self._to_place(TPUPlace(device_id))
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        blocking = kwargs.get("blocking", None)
+        for a in args:
+            if isinstance(a, (Place, )):
+                device = a
+            elif isinstance(a, dtypes.DType):
+                dtype = a
+            elif isinstance(a, str):
+                try:
+                    dtype = dtypes.convert_dtype(a)
+                except ValueError:
+                    device = a
+            elif isinstance(a, bool):
+                blocking = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            if not isinstance(device, Place):
+                from ..device import _parse
+                device = _parse(device)
+            out = out._to_place(device)
+        return out
+
+    def astype(self, dtype) -> "Tensor":
+        from .dispatch import call_op
+        jdt = dtypes.to_jax(dtype)
+        return call_op(lambda x: x.astype(jdt), (self,), {})
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+    def block_until_ready(self):
+        if not _is_tracer(self._data):
+            self._data.block_until_ready()
+        return self
+
+    def element_size(self) -> int:
+        return np.dtype(self.dtype.numpy_dtype).itemsize
+
+    def numel(self):
+        from . import dispatch
+        return Tensor(jnp.asarray(self.size, dtype=jnp.int64))
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return Tensor(jnp.asarray(self.ndim, dtype=jnp.int64))
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    f"traced=True, stop_gradient={self.stop_gradient})")
+        prefix = "Parameter" if self._is_param else "Tensor"
+        return (f"{prefix}(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+                f"       {np.asarray(self._data)!r})")
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return id(self)
+
+    # deep/shallow copy support keeps autograd detached like the reference
+    def __deepcopy__(self, memo):
+        t = Tensor(np.asarray(self._data) if not _is_tracer(self._data) else self._data,
+                   stop_gradient=self.stop_gradient, name=self.name)
+        t.persistable = self.persistable
+        t._is_param = self._is_param
+        memo[id(self)] = t
+        return t
+
+
+class _HookHandle:
+    def __init__(self, hooks_list, hook):
+        self._list = hooks_list
+        self._hook = hook
+
+    def remove(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: python/paddle/base/framework.py Parameter)."""
+
+    __slots__ = ()
+
+    def __init__(self, data, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self._is_param = True
+        self.persistable = True
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor"""
+    t = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    return t
+
+
+def is_tensor(obj) -> bool:
+    return isinstance(obj, Tensor)

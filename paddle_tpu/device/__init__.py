@@ -1,0 +1,212 @@
+"""Device / Place API.
+
+Ref design: paddle/phi/common/place.h (phi::Place, CPUPlace/CUDAPlace/
+XPUPlace/CustomPlace — the fork adds TPUPlace) and python/paddle/device/.
+On TPU the device runtime is PJRT; Places are lightweight descriptors
+that resolve to ``jax.Device`` objects.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "XPUPlace", "CustomPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_rocm",
+    "is_compiled_with_tpu", "is_compiled_with_cinn", "is_compiled_with_distribute",
+    "synchronize", "cuda", "jax_device",
+]
+
+
+class Place:
+    """Base place: a named device slot resolving to a jax.Device."""
+
+    _kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self._kind == other._kind
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+
+class CUDAPlace(Place):  # accepted for API parity; resolves to accelerator 0
+    _kind = "gpu"
+
+
+class XPUPlace(Place):
+    _kind = "xpu"
+
+
+class CUDAPinnedPlace(Place):
+    _kind = "cuda_pinned"
+
+
+class CustomPlace(Place):
+    def __init__(self, dev_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self._kind = dev_type
+
+
+_current_place: Optional[Place] = None
+
+
+def _default_place() -> Place:
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return CPUPlace()
+    return TPUPlace(0)
+
+
+def _parse(device: str) -> Place:
+    device = device.lower()
+    if device in ("cpu",):
+        return CPUPlace()
+    for prefix, cls in (("tpu", TPUPlace), ("gpu", CUDAPlace), ("xpu", XPUPlace)):
+        if device == prefix:
+            return cls(0)
+        if device.startswith(prefix + ":"):
+            return cls(int(device.split(":")[1]))
+    if ":" in device:
+        kind, idx = device.split(":")
+        return CustomPlace(kind, int(idx))
+    raise ValueError(f"cannot parse device string {device!r}")
+
+
+def set_device(device) -> Place:
+    """paddle.device.set_device — selects the default placement target."""
+    global _current_place
+    _current_place = device if isinstance(device, Place) else _parse(device)
+    return _current_place
+
+
+def get_device() -> str:
+    p = _current_place or _default_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"{p._kind}:{p.get_device_id()}"
+
+
+def current_place() -> Place:
+    return _current_place or _default_place()
+
+
+def jax_device(place: Optional[Place] = None):
+    """Resolve a Place to a jax.Device (None → framework default)."""
+    place = place or current_place()
+    if isinstance(place, CPUPlace):
+        try:
+            return jax.devices("cpu")[place.get_device_id()]
+        except RuntimeError:
+            return jax.devices()[0]
+    devs = jax.devices()
+    return devs[place.get_device_id() % len(devs)]
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role and is always on.
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def synchronize(device=None):
+    """Block until all queued work is done (ref: device synchronize)."""
+    # jax dispatch is async; the strongest barrier is a tiny blocking transfer.
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda parity shims (memory stats come from PJRT)."""
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        return (stats or {}).get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        return (stats or {}).get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        return (stats or {}).get("bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        return (stats or {}).get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+cuda = _CudaNamespace()

@@ -1,0 +1,149 @@
+"""DType system mapping paddle dtype names onto JAX dtypes.
+
+Ref design: paddle/phi/common/data_type.h (phi::DataType enum) and the
+python-visible ``paddle.float32`` objects.  Here DType is a thin wrapper
+over ``jnp.dtype`` keeping paddle's names and promotion defaults
+(default float = float32, default int = int64 — x64 is enabled in
+paddle_tpu/__init__ so int64/float64 exist like in the reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DType:
+    """A paddle-style dtype object, interning one instance per name."""
+
+    _interned = {}
+
+    def __new__(cls, name: str):
+        if name in cls._interned:
+            return cls._interned[name]
+        self = super().__new__(cls)
+        self._name = name
+        self._np = _NP_MAP[name]
+        cls._interned[name] = self
+        return self
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def numpy_dtype(self) -> np.dtype:
+        return self._np
+
+    def __repr__(self):
+        return f"paddle.{self._name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self._name == other._name
+        if isinstance(other, str):
+            return self._name == other or ("paddle." + self._name) == other
+        try:
+            return np.dtype(self._np) == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self._name)
+
+    # numpy interop: lets np.dtype(paddle.float32) work
+    def __dtype__(self):  # pragma: no cover - numpy protocol
+        return np.dtype(self._np)
+
+
+import jax.numpy as jnp  # noqa: E402  (after DType definition on purpose)
+
+_NP_MAP = {
+    "bool": np.dtype(np.bool_),
+    "uint8": np.dtype(np.uint8),
+    "int8": np.dtype(np.int8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "bfloat16": np.dtype(jnp.bfloat16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "complex64": np.dtype(np.complex64),
+    "complex128": np.dtype(np.complex128),
+}
+
+bool_ = DType("bool")
+uint8 = DType("uint8")
+int8 = DType("int8")
+int16 = DType("int16")
+int32 = DType("int32")
+int64 = DType("int64")
+float16 = DType("float16")
+bfloat16 = DType("bfloat16")
+float32 = DType("float32")
+float64 = DType("float64")
+complex64 = DType("complex64")
+complex128 = DType("complex128")
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+
+_FROM_NP = {d.numpy_dtype: d for d in _ALL}
+_FROM_NAME = {d.name: d for d in _ALL}
+_FROM_NAME["bool_"] = bool_
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype spec (DType, str, np/jnp dtype) to a DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name in _FROM_NAME:
+            return _FROM_NAME[name]
+        raise ValueError(f"unknown dtype {dtype!r}")
+    npdt = np.dtype(dtype)
+    if npdt in _FROM_NP:
+        return _FROM_NP[npdt]
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+def to_jax(dtype) -> np.dtype:
+    """DType/str/np → numpy dtype usable by jnp."""
+    d = convert_dtype(dtype)
+    return None if d is None else d.numpy_dtype
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d.numpy_dtype, np.floating) or d is bfloat16
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype).numpy_dtype, np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype).numpy_dtype, np.complexfloating)
+
+
+# paddle's defaults
+_default_float = float32
+
+
+def set_default_dtype(d):
+    global _default_float
+    _default_float = convert_dtype(d)
+
+
+def get_default_dtype() -> str:
+    return _default_float.name
+
+
+def default_float() -> DType:
+    return _default_float
+
+
+def default_int() -> DType:
+    return int64

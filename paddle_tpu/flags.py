@@ -1,0 +1,97 @@
+"""Global flag registry with env ingestion.
+
+TPU-native re-design of the reference's three-tier flag system
+(ref: paddle/phi/core/flags.cc — PHI_DEFINE_EXPORTED_*; python
+paddle.set_flags/get_flags).  Here a single Python registry holds typed
+flags, ingests ``FLAGS_*`` environment variables at import, and exposes
+``set_flags``/``get_flags`` with the same call signatures as the reference.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(ftype: type, raw: Any) -> Any:
+    if isinstance(raw, str) and ftype is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ftype(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. ``name`` may be given with or without the FLAGS_ prefix."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    ftype = type(default)
+    flag = _Flag(name=name, default=default, type=ftype, help=help,
+                 on_change=on_change)
+    env = os.environ.get(name)
+    flag.value = _coerce(ftype, env) if env is not None else default
+    _REGISTRY[name] = flag
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Query flag values. Mirrors ``paddle.get_flags``."""
+    if flags is None:
+        names: List[str] = list(_REGISTRY)
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = list(flags)
+    out = {}
+    for n in names:
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flag values. Mirrors ``paddle.set_flags``."""
+    for n, v in flags.items():
+        key = n if n.startswith("FLAGS_") else "FLAGS_" + n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        f = _REGISTRY[key]
+        f.value = _coerce(f.type, v)
+        if f.on_change is not None:
+            f.on_change(f.value)
+
+
+def get_flag(name: str) -> Any:
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _REGISTRY[key].value
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of the reference's ~300, the ones with behavioral effect
+# here; more are registered where their subsystem lives).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "scan op outputs for nan/inf")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: log only")
+define_flag("benchmark", False, "synchronize (block_until_ready) after every op")
+define_flag("sync_nccl_allreduce", False, "synchronize after every collective")
+define_flag("seed", 0, "global random seed")
+define_flag("use_stride_kernel", True, "accepted for API parity; XLA manages layout")
+define_flag("eager_delete_tensor_gb", 0.0, "accepted for API parity; PJRT manages memory")
+define_flag("allocator_strategy", "auto_growth", "accepted for API parity")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "accepted for API parity")
+define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")
+define_flag("embedding_deterministic", 0, "deterministic embedding lookup")
+define_flag("log_level", 0, "framework VLOG level")

@@ -1,0 +1,124 @@
+"""Stateful RNG over jax.random keys.
+
+Ref design: paddle/phi/core/generator.cc (Generator with seed/offset state)
+and python paddle.seed.  Here the generator holds a jax PRNG key and splits
+on every draw.  Crucially the key may be a *tracer*: the jit functionalizer
+lifts the generator state into an input/output of the traced step, so
+dropout masks differ per step inside a compiled train loop — the TPU-native
+replacement for the reference's seed+offset curand state threading.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(int(seed))
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(int(seed))
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+    def split_off(self, n: int):
+        """Derive n independent keys, advancing state once."""
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return keys[1:]
+
+
+default_generator = Generator(0)
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed"""
+    from .flags import set_flags
+    default_generator.manual_seed(int(value))
+    try:
+        set_flags({"FLAGS_seed": int(value)})
+    except ValueError:
+        pass
+    # also reseed the tracker streams deterministically
+    _rng_tracker.reseed_all(int(value))
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(states):
+    default_generator.set_state(states[0])
+
+
+def next_key():
+    return default_generator.next_key()
+
+
+class RNGStatesTracker:
+    """Named independent RNG streams (ref: fleet/meta_parallel/
+    parallel_layers/random.py RNGStatesTracker) — used for tensor-parallel
+    dropout: 'global_seed' stream shared across mp ranks, 'local_seed'
+    stream unique per rank."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def reseed_all(self, base_seed: int):
+        for i, name in enumerate(sorted(self._states)):
+            self._states[name].manual_seed(base_seed + 1000 + i)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    class _Swap:
+        def __init__(self, tracker, name):
+            self.tracker, self.name = tracker, name
+
+        def __enter__(self):
+            global default_generator
+            self._saved = default_generator
+            default_generator = self.tracker._states[self.name]
+
+        def __exit__(self, *exc):
+            global default_generator
+            default_generator = self._saved
+            return False
+
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._states:
+            self.add(name, name.__hash__() & 0x7FFFFFFF)
+        return self._Swap(self, name)
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng_tracker

@@ -1,0 +1,160 @@
+"""paddle_tpu.tensor — aggregates the op surface and monkey-patches Tensor
+methods, mirroring the reference's pattern of patching methods from
+python/paddle/tensor/__init__.py onto the C++ tensor type."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter, to_tensor, is_tensor
+from ..core.dispatch import call_op
+from .. import dtype as dtypes
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from ._helpers import ensure_tensor
+
+# re-export everything public from the op modules
+_MODULES = [creation, math, manipulation, logic, linalg, search, stat, random]
+for _m in _MODULES:
+    for _k in dir(_m):
+        if not _k.startswith("_") and callable(getattr(_m, _k)):
+            globals().setdefault(_k, getattr(_m, _k))
+
+einsum = einsum_mod.einsum
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+def _convert_index(item, shape):
+    """Normalize a paddle-style index into something jnp understands.
+    Returns (index, eager_only)."""
+    eager = False
+
+    def conv(i):
+        nonlocal eager
+        if isinstance(i, Tensor):
+            if i.dtype == dtypes.bool_:
+                eager = True
+                return np.asarray(i._data)
+            return i._data
+        if isinstance(i, np.ndarray) and i.dtype == np.bool_:
+            eager = True
+            return i
+        return i
+
+    if isinstance(item, tuple):
+        return tuple(conv(i) for i in item), eager
+    return conv(item), eager
+
+
+def _tensor_getitem(self, item):
+    idx, eager = _convert_index(item, self.shape)
+    return call_op(lambda v: v[idx], (self,), {}, op_name="getitem")
+
+
+def _tensor_setitem(self, item, value):
+    idx, _ = _convert_index(item, self.shape)
+    self._check_inplace_autograd()
+    snap = self._snapshot()
+    if isinstance(value, Tensor):
+        out = call_op(lambda v, u: v.at[idx].set(u.astype(v.dtype)),
+                      (snap, value), {}, op_name="setitem")
+    else:
+        val = jnp.asarray(value)
+        out = call_op(lambda v: v.at[idx].set(val.astype(v.dtype)), (snap,),
+                      {}, op_name="setitem")
+    self._inplace_assign(out)
+
+
+Tensor.__getitem__ = _tensor_getitem
+Tensor.__setitem__ = _tensor_setitem
+
+# ---------------------------------------------------------------------------
+# operator overloads
+# ---------------------------------------------------------------------------
+
+def _swap(fn):
+    def op(self, other):
+        return fn(other, self)
+    return op
+
+
+Tensor.__add__ = lambda s, o: math.add(s, o)
+Tensor.__radd__ = lambda s, o: math.add(o, s)
+Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+Tensor.__mod__ = lambda s, o: math.mod(s, o)
+Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+Tensor.__pow__ = lambda s, o: math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+Tensor.__rmatmul__ = lambda s, o: math.matmul(o, s)
+Tensor.__neg__ = lambda s: math.neg(s)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__invert__ = lambda s: math.logical_not(s) if s.dtype == dtypes.bool_ else math.bitwise_not(s)
+Tensor.__and__ = lambda s, o: (math.logical_and if s.dtype == dtypes.bool_ else math.bitwise_and)(s, o)
+Tensor.__or__ = lambda s, o: (math.logical_or if s.dtype == dtypes.bool_ else math.bitwise_or)(s, o)
+Tensor.__xor__ = lambda s, o: (math.logical_xor if s.dtype == dtypes.bool_ else math.bitwise_xor)(s, o)
+Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+Tensor.__hash__ = lambda s: id(s)
+
+# ---------------------------------------------------------------------------
+# method patching — the method surface mirrors the reference's tensor methods
+# ---------------------------------------------------------------------------
+_METHODS = {}
+for _m in _MODULES:
+    for _k in dir(_m):
+        if _k.startswith("_"):
+            continue
+        _fn = getattr(_m, _k)
+        if callable(_fn) and not isinstance(_fn, type):
+            _METHODS[_k] = _fn
+
+# names that clash with core Tensor members stay as-is
+_SKIP = {"Tensor", "Parameter", "to_tensor", "is_tensor", "create_parameter",
+         "numel", "clone", "shape"}
+for _name, _fn in _METHODS.items():
+    if _name in _SKIP or hasattr(Tensor, _name):
+        continue
+    setattr(Tensor, _name, _fn)
+
+# in-place wrappers generated for common arithmetic (ref pattern: x.add_(y))
+def _make_inplace(fn):
+    def inplace(self, *args, **kwargs):
+        self._check_inplace_autograd()
+        out = fn(self._snapshot(), *args, **kwargs)
+        return self._inplace_assign(out)
+    return inplace
+
+
+for _name in ["add", "subtract", "multiply", "divide", "clip", "scale",
+              "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round",
+              "abs", "sin", "cos", "tanh", "sigmoid", "pow", "remainder",
+              "mod"]:
+    if _name in _METHODS:
+        setattr(Tensor, _name + "_", _make_inplace(_METHODS[_name]))
+
+
+def _mean_method(self, axis=None, keepdim=False, name=None):
+    return math.mean(self, axis, keepdim)
+
+
+Tensor.mean = _mean_method
+Tensor.numel = lambda self: creation.numel(self)
+Tensor.clone = lambda self: creation.clone(self)
+Tensor.t = lambda self, name=None: manipulation.t(self)
+Tensor.reshape = lambda self, shape, name=None: manipulation.reshape(self, shape)
+Tensor.reshape_ = lambda self, shape, name=None: manipulation.reshape_(self, shape)
+Tensor.item_ = Tensor.item

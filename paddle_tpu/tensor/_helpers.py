@@ -1,0 +1,121 @@
+"""Shared dispatch helpers for the op surface.
+
+Ref design note: the reference generates its whole op surface from
+paddle/phi/api/yaml/ops.yaml ("the op surface is data, not code").  Here
+the same idea: op tables in each module map names → pure jnp callables and
+a factory stamps out the python functions + Tensor methods.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from .. import dtype as dtypes
+
+
+def ensure_tensor(x, ref: Optional[Tensor] = None) -> Tensor:
+    """Coerce python scalars / numpy arrays to Tensor (dtype follows ``ref``
+    for python scalars, like paddle's scalar promotion)."""
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (bool, int, float, complex)) and ref is not None:
+        rd = ref._data.dtype
+        if isinstance(x, bool):
+            dt = rd if rd == jnp.bool_ else rd
+        elif isinstance(x, int):
+            dt = rd  # int scalar follows tensor dtype (matches paddle promote)
+        elif isinstance(x, float):
+            dt = rd if jnp.issubdtype(rd, jnp.floating) or jnp.issubdtype(rd, jnp.complexfloating) \
+                else dtypes.default_float().numpy_dtype
+        else:
+            dt = jnp.complex64
+        return Tensor(jnp.asarray(x, dtype=dt))
+    return Tensor(x)
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def make_unary(jfn: Callable, name: str, doc: str = "") -> Callable:
+    def op(x, name=None):
+        x = ensure_tensor(x)
+        return call_op(jfn, (x,), {}, op_name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"paddle.{name} — elementwise {name} (jnp-lowered)."
+    return op
+
+
+def make_binary(jfn: Callable, name: str, doc: str = "") -> Callable:
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor) and isinstance(y, Tensor):
+            x = ensure_tensor(x, ref=y)
+        x = ensure_tensor(x)
+        y = ensure_tensor(y, ref=x)
+        return call_op(jfn, (x, y), {}, op_name=op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"paddle.{name} — elementwise binary {name} (jnp-lowered)."
+    return op
+
+
+def make_reduction(jfn: Callable, name: str, default_keepdim: bool = False) -> Callable:
+    def op(x, axis=None, keepdim=default_keepdim, name=None, dtype=None):
+        x = ensure_tensor(x)
+        kw = {}
+        if axis is not None:
+            if isinstance(axis, Tensor):
+                axis = tuple(int(a) for a in axis.numpy().reshape(-1))
+            elif isinstance(axis, (list, tuple)):
+                axis = tuple(int(a) for a in axis)
+            else:
+                axis = int(axis)
+        jdt = dtypes.to_jax(dtype) if dtype is not None else None
+
+        def red(v):
+            out = jfn(v, axis=axis, keepdims=keepdim)
+            return out.astype(jdt) if jdt is not None else out
+        return call_op(red, (x,), {}, op_name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"paddle.{name} — reduction over axis (jnp-lowered)."
+    return op
+
+
+def normalize_axis(axis, ndim: int):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().reshape(-1).tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) % ndim if a < 0 else int(a) for a in axis)
+    a = int(axis)
+    return a % ndim if a < 0 else a
+
+
+def shape_list(shape) -> Sequence[int]:
+    """Normalize a paddle shape argument (list/tuple/Tensor/ints)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def _inplace_op(x, fn, *args, **kwargs):
+    """Run out-of-place twin ``fn`` on a snapshot and rebind x (no self-loop)."""
+    x._check_inplace_autograd()
+    return x._inplace_assign(fn(x._snapshot(), *args, **kwargs))

@@ -1,0 +1,233 @@
+"""Tensor creation ops (ref design: python/paddle/tensor/creation.py,
+lowered to jnp instead of _C_ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa: F401
+from .. import dtype as dtypes
+from ._helpers import ensure_tensor, shape_list, unwrap
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "diag", "diagflat", "diag_embed", "tril", "triu",
+    "tril_indices", "triu_indices", "assign", "clone", "numel",
+    "create_parameter", "complex", "polar", "as_tensor", "Tensor",
+    "is_tensor",
+]
+
+
+def _dt(dtype, default=None):
+    d = dtypes.to_jax(dtype) if dtype is not None else None
+    if d is None and default is not None:
+        d = dtypes.to_jax(default)
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(shape_list(shape), dtype=_dt(dtype, dtypes.default_float())))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(shape_list(shape), dtype=_dt(dtype, dtypes.default_float())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtypes.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtypes.default_float()  # paddle full defaults to float32
+        else:
+            dtype = dtypes.default_float()
+    return Tensor(jnp.full(shape_list(shape), fill_value, dtype=_dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        pass
+    start = unwrap(start) if isinstance(start, Tensor) else start
+    end = unwrap(end) if isinstance(end, Tensor) else end
+    step = unwrap(step) if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = [np.asarray(v) for v in (start, end, step)]
+        dtype = (dtypes.default_float()
+                 if any(np.issubdtype(v.dtype, np.floating) for v in vals)
+                 else dtypes.int64)
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = unwrap(start) if isinstance(start, Tensor) else start
+    stop = unwrap(stop) if isinstance(stop, Tensor) else stop
+    num = int(unwrap(num)) if isinstance(num, Tensor) else int(num)
+    return Tensor(jnp.linspace(start, stop, num,
+                               dtype=_dt(dtype, dtypes.default_float())))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(num), base=base,
+                               dtype=_dt(dtype, dtypes.default_float())))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype, dtypes.default_float())))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    tensors = [ensure_tensor(a) for a in args]
+    outs = call_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                   tensors, {}, multi_out=True, op_name="meshgrid")
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+    return call_op(f, (x,), {}, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.diagflat(v, k=offset), (x,), {}, op_name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out_shape = v.shape[:-1] + (n, n)
+        out = jnp.zeros(out_shape, v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        ndim = len(out_shape)
+        d1, d2 = dim1 % ndim, dim2 % ndim
+        perm = [i for i in range(ndim) if i not in (ndim - 2, ndim - 1)]
+        # place last two axes at positions d1/d2
+        order = [None] * ndim
+        order[d1], order[d2] = ndim - 2, ndim - 1
+        it = iter(perm)
+        for i in range(ndim):
+            if order[i] is None:
+                order[i] = next(it)
+        return jnp.transpose(out, order)
+    return call_op(f, (x,), {}, op_name="diag_embed")
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.tril(v, k=diagonal), (x,), {}, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.triu(v, k=diagonal), (x,), {}, op_name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype=None, name=None):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, dtypes.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype=None, name=None):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, dtypes.int64)))
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x)
+    out = call_op(lambda v: v + 0 if v.dtype != jnp.bool_ else v, (x,), {},
+                  op_name="assign")
+    if output is not None:
+        output.set_value(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.copy(v), (x,), {}, op_name="clone")
+
+
+def numel(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def as_tensor(data, dtype=None, place=None):
+    return to_tensor(data, dtype=dtype, place=place)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref: paddle.create_parameter — used by custom layers."""
+    from ..nn.initializer import _apply_initializer
+    shape = shape_list(shape)
+    p = Parameter(jnp.zeros(shape, dtype=dtypes.to_jax(dtype)), name=name)
+    _apply_initializer(p, default_initializer, is_bias=is_bias, attr=attr)
+    return p
+
+
+def complex(real, imag, name=None):
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return call_op(lambda r, i: jax.lax.complex(r, i), (real, imag), {},
+                   op_name="complex")
+
+
+def polar(abs, angle, name=None):
+    abs, angle = ensure_tensor(abs), ensure_tensor(angle)
+    return call_op(lambda a, t: a * jnp.exp(1j * t).astype(
+        jnp.complex64 if a.dtype == jnp.float32 else jnp.complex128),
+        (abs, angle), {}, op_name="polar")
+
+
+import jax  # noqa: E402

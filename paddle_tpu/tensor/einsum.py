@@ -1,0 +1,15 @@
+"""Einsum (ref: python/paddle/tensor/einsum.py — here jnp.einsum which XLA
+maps straight onto MXU contractions)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op
+from ._helpers import ensure_tensor
+
+
+def einsum(equation, *operands, **kwargs):
+    tensors = [ensure_tensor(o) for o in operands]
+    return call_op(lambda *vs: jnp.einsum(equation, *vs,
+                                          precision=kwargs.get("precision")),
+                   tensors, {}, op_name="einsum")

@@ -1,0 +1,318 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py and
+paddle.linalg namespace, lowered to jnp.linalg / lax)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ._helpers import ensure_tensor, unwrap
+from .math import matmul, mm, bmm, dot, mv  # noqa: F401  (re-export)
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "norm", "vector_norm", "matrix_norm",
+    "cond", "det", "slogdet", "inv", "pinv", "solve", "triangular_solve",
+    "cholesky", "cholesky_solve", "lu", "lu_unpack", "qr", "svd", "svdvals",
+    "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
+    "multi_dot", "cross", "histogram_bin_edges", "cov", "corrcoef",
+    "tensordot", "lstsq", "ormqr", "householder_product", "pca_lowrank",
+]
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v))))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            base = jnp.abs(v)
+            return (jnp.max(base) if axis is None
+                    else jnp.max(base, axis=_ax(axis), keepdims=keepdim))
+        if p == -np.inf or p == float("-inf"):
+            base = jnp.abs(v)
+            return (jnp.min(base) if axis is None
+                    else jnp.min(base, axis=_ax(axis), keepdims=keepdim))
+        if axis is None:
+            return jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+    return call_op(f, (x,), {}, op_name="norm")
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.vector_norm(
+        v, ord=p, axis=_ax(axis), keepdims=keepdim), (x,), {},
+        op_name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(int(a) for a in axis)
+
+    def f(v):
+        if ax != (v.ndim - 2, v.ndim - 1) and ax != (-2, -1):
+            v = jnp.moveaxis(v, ax, (-2, -1))
+        out = jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim)
+        if keepdim and ax not in ((-2, -1), (v.ndim - 2, v.ndim - 1)):
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
+    return call_op(f, (x,), {}, op_name="matrix_norm")
+
+
+def cond(x, p=None, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.cond(v, p=p), (x,), {}, op_name="cond")
+
+
+def det(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(jnp.linalg.det, (x,), {}, op_name="det")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    outs = call_op(lambda v: tuple(jnp.linalg.slogdet(v)), (x,), {},
+                   multi_out=True, op_name="slogdet")
+    # paddle returns stacked [sign, logdet]
+    from .manipulation import stack
+    return stack(list(outs), axis=0)
+
+
+def inv(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(jnp.linalg.inv, (x,), {}, op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                             hermitian=hermitian), (x,), {},
+                   op_name="pinv")
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(jnp.linalg.solve, (x, y), {}, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), (x, y), {}, op_name="triangular_solve")
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.cholesky(v) if not upper
+                   else jnp.swapaxes(jnp.linalg.cholesky(v), -1, -2).conj(),
+                   (x,), {}, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda b, L: jax.scipy.linalg.cho_solve((L, not upper), b),
+                   (x, y), {}, op_name="cholesky_solve")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    outs = call_op(f, (x,), {}, multi_out=True, op_name="lu")
+    if get_infos:
+        return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
+    return outs[0], outs[1]
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(lu_mat, piv):
+        n = lu_mat.shape[-2]
+        L = jnp.tril(lu_mat, -1) + jnp.eye(*lu_mat.shape[-2:], dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat)
+        perm = jnp.arange(n)
+        pv = piv - 1
+        for i in range(n):
+            a, b = perm[i], perm[pv[i]]
+            perm = perm.at[i].set(b).at[pv[i]].set(a)
+        P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+        return P, L, U
+    outs = call_op(f, (x, y), {}, multi_out=True, op_name="lu_unpack")
+    return outs[0], outs[1], outs[2]
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return call_op(lambda v: jnp.linalg.qr(v, mode="r"), (x,), {},
+                       op_name="qr")
+    outs = call_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (x,), {},
+                   multi_out=True, op_name="qr")
+    return outs[0], outs[1]
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    outs = call_op(lambda v: tuple(jnp.linalg.svd(
+        v, full_matrices=full_matrices)), (x,), {}, multi_out=True,
+        op_name="svd")
+    u, s, vh = outs
+    # paddle.linalg.svd returns (U, S, VH) like numpy
+    return u, s, vh
+
+
+def svdvals(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.svd(v, compute_uv=False), (x,), {},
+                   op_name="svdvals")
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    w, v = np.linalg.eig(arr)  # CPU-only in the reference too
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    outs = call_op(lambda v: tuple(jnp.linalg.eigh(
+        v, symmetrize_input=False, UPLO=UPLO)), (x,), {}, multi_out=True,
+        op_name="eigh")
+    return outs[0], outs[1]
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), (x,), {},
+                   op_name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.matrix_power(v, n), (x,), {},
+                   op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.linalg.matrix_rank(
+        v, rtol=tol).astype(jnp.int64), (x,), {}, op_name="matrix_rank")
+
+
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.linalg.multi_dot(list(vs)), tensors, {},
+                   op_name="multi_dot")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis
+    if ax == 9:
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return call_op(lambda a, b: jnp.cross(a, b, axis=int(ax)), (x, y), {},
+                   op_name="cross")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+
+    def f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        return jnp.histogram_bin_edges(v, bins=bins, range=(lo, hi))
+    return call_op(f, (input,), {}, op_name="histogram_bin_edges")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.cov(v, rowvar=rowvar,
+                                     ddof=1 if ddof else 0), (x,), {},
+                   op_name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), (x,), {},
+                   op_name="corrcoef")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a.tolist()) if isinstance(a, Tensor)
+                     else (tuple(a) if isinstance(a, (list, tuple)) else a)
+                     for a in axes)
+    return call_op(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y), {},
+                   op_name="tensordot")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+    outs = call_op(f, (x, y), {}, multi_out=True, op_name="lstsq")
+    return outs
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    raise NotImplementedError("ormqr: pending (low-priority LAPACK op)")
+
+
+def householder_product(x, tau, name=None):
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        Q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[i].set(1.0)
+            H = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v.conj())
+            Q = Q @ H
+        return Q[..., :, :n]
+    return call_op(f, (x, tau), {}, op_name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    q = q if q is not None else min(6, m, n)
+
+    def f(v):
+        a = v - v.mean(axis=-2, keepdims=True) if center else v
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :, :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :, :q]
+    outs = call_op(f, (x,), {}, multi_out=True, op_name="pca_lowrank")
+    return outs[0], outs[1], outs[2]

@@ -1,0 +1,30 @@
+"""Comparison / logic ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op
+from ._helpers import ensure_tensor, make_binary
+
+_mod = sys.modules[__name__]
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+}
+for _name, _f in _CMP.items():
+    setattr(_mod, _name, make_binary(_f, _name))
+
+
+def is_empty(x, name=None):
+    from ..core.tensor import Tensor
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def logical_not(x, out=None, name=None):
+    x = ensure_tensor(x)
+    return call_op(jnp.logical_not, (x,), {}, op_name="logical_not")

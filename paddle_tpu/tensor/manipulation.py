@@ -1,0 +1,568 @@
+"""Shape/layout manipulation ops (ref design: python/paddle/tensor/
+manipulation.py, lowered to jnp)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from .. import dtype as dtypes
+from ._helpers import (_inplace_op, ensure_tensor, normalize_axis,
+                       shape_list, unwrap)
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    return x.astype(dtype)
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = shape_list(shape)
+    return call_op(lambda v: jnp.reshape(v, shp), (x,), {}, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return _inplace_op(x, reshape, shape)
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = [int(p) for p in perm]
+    return call_op(lambda v: jnp.transpose(v, perm), (x,), {},
+                   op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.moveaxis(v, source, destination), (x,), {},
+                   op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.swapaxes(v, int(axis0), int(axis1)), (x,), {},
+                   op_name="swapaxes")
+
+
+transpose_ = swapaxes
+t_api = None
+
+
+def t(input, name=None):
+    input = ensure_tensor(input)
+    if input.ndim < 2:
+        return call_op(lambda v: v, (input,), {}, op_name="t")
+    return call_op(lambda v: jnp.swapaxes(v, -1, -2), (input,), {}, op_name="t")
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return call_op(lambda *vs: jnp.concatenate(vs, axis=ax), tensors, {},
+                   op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return call_op(lambda *vs: jnp.stack(vs, axis=int(axis)), tensors, {},
+                   op_name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+    outs = call_op(
+        lambda v: tuple(jnp.squeeze(s, axis=axis)
+                        for s in jnp.split(v, n, axis=axis)),
+        (x,), {}, multi_out=True, op_name="unstack")
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {ax} (size {dim}) is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(unwrap(s)) if isinstance(s, Tensor) else int(s)
+                    for s in num_or_sections]
+        n_neg = sum(1 for s in sections if s < 0)
+        if n_neg:
+            rest = dim - sum(s for s in sections if s >= 0)
+            sections = [rest if s < 0 else s for s in sections]
+    offsets = np.cumsum([0] + sections)[:-1]
+
+    def f(v):
+        return tuple(jax.lax.slice_in_dim(v, int(o), int(o + s), axis=ax)
+                     for o, s in zip(offsets, sections))
+    outs = call_op(f, (x,), {}, multi_out=True, op_name="split")
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if ensure_tensor(x).ndim > 1 else 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = tuple(i for i, s in enumerate(x.shape) if s == 1)
+    elif isinstance(axis, (list, tuple)):
+        ax = tuple(int(a) % x.ndim for a in axis if x.shape[int(a) % x.ndim] == 1)
+    else:
+        a = int(axis) % x.ndim
+        ax = (a,) if x.shape[a] == 1 else ()
+    return call_op(lambda v: jnp.squeeze(v, axis=ax), (x,), {},
+                   op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return _inplace_op(x, squeeze, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().reshape(-1).tolist()
+    ax = tuple(int(a) for a in (axis if isinstance(axis, (list, tuple)) else [axis]))
+    return call_op(lambda v: jnp.expand_dims(v, ax), (x,), {},
+                   op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return _inplace_op(x, unsqueeze, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    s, e = start_axis % nd, stop_axis % nd
+    new_shape = x.shape[:s] + [int(np.prod(x.shape[s:e + 1]) or 1)] + x.shape[e + 1:]
+    return reshape(x, new_shape)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return _inplace_op(x, flatten, start_axis, stop_axis)
+
+
+def gather(x, index, axis=None, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    ax = 0 if axis is None else (int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis))
+    return call_op(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
+                                         axis=ax), (x, index), {},
+                   op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def f(v, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return v[flat_idx]
+    return call_op(f, (x, index), {}, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = (ensure_tensor(x), ensure_tensor(index),
+                         ensure_tensor(updates))
+
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle semantics: zero destination rows then accumulate
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return call_op(f, (x, index, updates), {}, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _inplace_op(x, scatter, index, updates, overwrite)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = (ensure_tensor(x), ensure_tensor(index),
+                         ensure_tensor(updates))
+
+    def f(v, i, u):
+        k = i.shape[-1]
+        idx = tuple(i[..., j] for j in range(k))
+        return v.at[idx].add(u)
+    return call_op(f, (x, index, updates), {}, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shp = shape_list(shape)
+
+    def f(i, u):
+        z = jnp.zeros(shp, u.dtype)
+        k = i.shape[-1]
+        idx = tuple(i[..., j] for j in range(k))
+        return z.at[idx].add(u)
+    return call_op(f, (index, updates), {}, op_name="scatter_nd")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values, ref=arr)
+
+    def f(v, i, u):
+        u = jnp.broadcast_to(u, i.shape) if u.shape != i.shape else u
+        mode = {"assign": "set", "add": "add", "multiply": "multiply",
+                "mul": "multiply", "amin": "min", "amax": "max"}[reduce]
+        return getattr(jnp, "put_along_axis", None) and None or _put(v, i, u, axis, mode)
+
+    def _put(v, i, u, ax, mode):
+        idx = []
+        for d in range(v.ndim):
+            if d == ax % v.ndim:
+                idx.append(i)
+            else:
+                sh = [1] * v.ndim
+                sh[d] = v.shape[d]
+                idx.append(jnp.arange(v.shape[d]).reshape(sh))
+        at = v.at[tuple(jnp.broadcast_arrays(*idx))]
+        return getattr(at, mode)(u)
+    return call_op(f, (arr, indices, values), {}, op_name="put_along_axis")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return call_op(lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                   (arr, indices), {}, op_name="take_along_axis")
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return call_op(lambda v, i: jnp.take(v, i, axis=int(axis)), (x, index), {},
+                   op_name="index_select")
+
+
+def index_sample(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return call_op(lambda v, i: jnp.take_along_axis(v, i, axis=1), (x, index),
+                   {}, op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def f(v, i, u):
+        v2 = jnp.moveaxis(v, axis, 0)
+        u2 = jnp.moveaxis(u, axis, 0)
+        out = v2.at[i].add(u2)
+        return jnp.moveaxis(out, 0, axis)
+    return call_op(f, (x, index, value), {}, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value, ref=x)
+    idx_tensors = [ensure_tensor(i) for i in indices]
+
+    def f(v, u, *idx):
+        at = v.at[tuple(idx)]
+        return at.add(u) if accumulate else at.set(u)
+    return call_op(f, (x, value, *idx_tensors), {}, op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # dynamic output shape — eager only (graph-break under jit, like ref's
+    # dynamic-shape ops)
+    m = np.asarray(mask._data)
+    return call_op(lambda v: v[m.nonzero()] if m.shape == tuple(x.shape)
+                   else v[np.broadcast_to(m, x.shape).nonzero()], (x,), {},
+                   op_name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = unwrap(value) if isinstance(value, Tensor) else value
+    return call_op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                   (x, mask), {}, op_name="masked_fill")
+
+
+def masked_fill_(x, mask, value, name=None):
+    return _inplace_op(x, masked_fill, mask, value)
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+    m = np.asarray(mask._data)
+    n = int(m.sum())
+
+    def f(v, mk, u):
+        flat_u = u.reshape(-1)[:n]
+        out = v.copy()
+        return out.at[jnp.where(mk)].set(flat_u)
+    return call_op(f, (x, mask, value), {}, op_name="masked_scatter")
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = shape_list(repeat_times)
+    return call_op(lambda v: jnp.tile(v, reps), (x,), {}, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = list(shape_list(shape))
+    cur = x.shape
+    # -1 means keep the dim
+    pad = len(shp) - len(cur)
+    for i, s in enumerate(shp):
+        if s == -1:
+            shp[i] = cur[i - pad]
+    return call_op(lambda v: jnp.broadcast_to(v, shp), (x,), {},
+                   op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [ensure_tensor(t) for t in inputs]
+    outs = call_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), tensors, {},
+                   multi_out=True, op_name="broadcast_tensors")
+    return list(outs)
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.roll(v, shifts, axis=axis), (x,), {},
+                   op_name="roll")
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return call_op(lambda v: jnp.flip(v, axis=tuple(int(a) for a in ax)),
+                   (x,), {}, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), (x,), {},
+                   op_name="rot90")
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    # dynamic shapes: compute on host (eager-only op, like ref's unique)
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    idt = dtypes.to_jax(dtype)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(res[0]))]
+    for extra in res[1:]:
+        outs.append(Tensor(jnp.asarray(extra.astype(idt))))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if arr.size == 0:
+        vals = arr
+        counts = np.zeros((0,), dtype=np.int64)
+        inverse = np.zeros((0,), dtype=np.int64)
+    else:
+        sl = [slice(None)] * arr.ndim
+        first = np.ones(arr.shape[ax], dtype=bool)
+        if arr.shape[ax] > 1:
+            a1 = np.take(arr, range(1, arr.shape[ax]), axis=ax)
+            a0 = np.take(arr, range(0, arr.shape[ax] - 1), axis=ax)
+            neq = (a1 != a0)
+            other = tuple(i for i in range(arr.ndim) if i != ax)
+            first[1:] = neq.any(axis=other) if arr.ndim > 1 else neq
+        vals = np.compress(first, arr, axis=ax)
+        group = np.cumsum(first) - 1
+        inverse = group
+        counts = np.bincount(group)
+    outs = [Tensor(jnp.asarray(vals))]
+    idt = dtypes.to_jax(dtype)
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inverse.astype(idt))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(idt))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        return call_op(lambda v, r: jnp.repeat(
+            v if axis is not None else v.reshape(-1), r, axis=axis or 0,
+            total_repeat_length=int(np.asarray(repeats._data).sum())),
+            (x, repeats), {}, op_name="repeat_interleave")
+    return call_op(lambda v: jnp.repeat(
+        v if axis is not None else v.reshape(-1), repeats, axis=axis or 0),
+        (x,), {}, op_name="repeat_interleave")
+
+
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    starts = shape_list(starts)
+    ends = shape_list(ends)
+
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            dim = v.shape[ax]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            idx[ax] = builtins_slice(s2, e2)
+        return v[tuple(idx)]
+    return call_op(f, (input,), {}, op_name="slice")
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    starts, ends, strides = shape_list(starts), shape_list(ends), shape_list(strides)
+
+    def f(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(s, e, st)
+        return v[tuple(idx)]
+    return call_op(f, (x,), {}, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = shape_list(shape)
+    offs = shape_list(offsets) if offsets is not None else [0] * x.ndim
+    shp = [x.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+
+    def f(v):
+        return jax.lax.dynamic_slice(v, offs, shp)
+    return call_op(f, (x,), {}, op_name="crop")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        n = min(v.shape[-2], v.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        return v.at[..., r, c].set(jnp.asarray(value, v.dtype))
+    x._check_inplace_autograd()
+    out = call_op(f, (x._snapshot(),), {}, op_name="fill_diagonal_")
+    return x._inplace_assign(out)
+
+
+def fill_(x, value):
+    x._replace_value(jnp.full_like(x._data, value))
+    return x
+
+
+def zero_(x):
+    return fill_(x, 0)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(ensure_tensor(i), [-1]) if ensure_tensor(i).ndim == 0
+            else ensure_tensor(i) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for i in inputs:
+        t = ensure_tensor(i)
+        outs.append(call_op(jnp.atleast_2d, (t,), {}, op_name="atleast_2d"))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for i in inputs:
+        t = ensure_tensor(i)
+        outs.append(call_op(jnp.atleast_3d, (t,), {}, op_name="atleast_3d"))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                   (x,), {}, op_name="as_real")
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,), {},
+                   op_name="as_complex")
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(num_or_indices, int):
+        arrs = np.array_split(np.arange(x.shape[axis]), num_or_indices)
+        sections = [len(a) for a in arrs]
+        return split(x, sections, axis)
+    idx = [0] + list(num_or_indices) + [x.shape[axis]]
+    sections = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sections, axis)
+
+
+def flip_(x, axis, name=None):
+    return _inplace_op(x, flip, axis)

@@ -1,0 +1,459 @@
+"""Math ops (ref design: python/paddle/tensor/math.py ~7k LoC, here
+table-generated onto jnp — the op table plays the role of ops.yaml)."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from .. import dtype as dtypes
+from ._helpers import (ensure_tensor, make_binary, make_reduction, make_unary,
+                       normalize_axis, unwrap)
+
+_mod = sys.modules[__name__]
+
+# ---------------------------------------------------------------------------
+# table-generated elementwise unary ops
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs, "acos": jnp.arccos, "acosh": jnp.arccosh,
+    "asin": jnp.arcsin, "asinh": jnp.arcsinh, "atan": jnp.arctan,
+    "atanh": jnp.arctanh, "ceil": jnp.ceil, "cos": jnp.cos,
+    "cosh": jnp.cosh, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv, "exp": jnp.exp,
+    "expm1": jnp.expm1, "floor": jnp.floor, "log": jnp.log,
+    "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "neg": jnp.negative, "reciprocal": lambda x: 1.0 / x,
+    "round": jnp.round, "rsqrt": jax.lax.rsqrt, "sign": jnp.sign,
+    "sin": jnp.sin, "sinh": jnp.sinh, "sqrt": jnp.sqrt,
+    "square": jnp.square, "tan": jnp.tan, "tanh": jnp.tanh,
+    "trunc": jnp.trunc, "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln, "i0": jnp.i0,
+    "angle": jnp.angle, "conj": jnp.conj, "frac": lambda x: x - jnp.trunc(x),
+    "sigmoid": jax.nn.sigmoid, "logit": jax.scipy.special.logit,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "bitwise_not": jnp.bitwise_not, "logical_not": jnp.logical_not,
+    "real": jnp.real, "imag": jnp.imag,
+}
+for _name, _f in _UNARY.items():
+    setattr(_mod, _name, make_unary(_f, _name))
+
+# ---------------------------------------------------------------------------
+# table-generated elementwise binary ops
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "hypot": jnp.hypot, "logaddexp": jnp.logaddexp,
+    "nextafter": jnp.nextafter, "copysign": jnp.copysign,
+    "heaviside": jnp.heaviside, "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "ldexp": lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)),
+}
+for _name, _f in _BINARY.items():
+    setattr(_mod, _name, make_binary(_f, _name))
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+_REDUCE = {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+    "max": jnp.max, "min": jnp.min, "amax": jnp.max, "amin": jnp.min,
+    "nansum": jnp.nansum, "nanmean": jnp.nanmean,
+    "all": jnp.all, "any": jnp.any, "logsumexp": jax.scipy.special.logsumexp,
+}
+for _name, _f in _REDUCE.items():
+    setattr(_mod, _name, make_reduction(_f, _name))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return call_op(lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim)
+                   .astype(jnp.int64), (x,), {}, op_name="count_nonzero")
+
+
+# ---------------------------------------------------------------------------
+# arithmetic specials
+# ---------------------------------------------------------------------------
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s = unwrap(scale)
+
+    def f(v):
+        sv = jnp.asarray(s, v.dtype) if not hasattr(s, "dtype") else s.astype(v.dtype)
+        out = v * sv + bias if bias_after_scale else (v + bias) * sv
+        return out
+    out = call_op(f, (x,), {}, op_name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    from ._helpers import _inplace_op
+    x = ensure_tensor(x)
+    return _inplace_op(
+        x, lambda xs: call_op(lambda v: v + jnp.asarray(value, v.dtype),
+                              (xs,), {}, op_name="increment"))
+
+
+def multiplex(inputs, index, name=None):
+    tensors = [ensure_tensor(t) for t in inputs] + [ensure_tensor(index)]
+
+    def f(*args):
+        *ins, idx = args
+        stacked = jnp.stack(ins, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return call_op(f, tensors, {}, op_name="multiplex")
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return call_op(lambda v: jnp.clip(v, lo, hi), (x,), {}, op_name="clip")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: scale_b * jnp.tanh(scale_a * v), (x,), {},
+                   op_name="stanh")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y),
+                   {}, op_name="addmm")
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.outer(a, b), (x, y), {}, op_name="outer")
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.inner(a, b), (x, y), {}, op_name="inner")
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(jnp.kron, (x, y), {}, op_name="kron")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype) if dtype else None
+
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=jdt)
+        return jnp.cumsum(v, axis=int(axis), dtype=jdt)
+    return call_op(f, (x,), {}, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype) if dtype else None
+    return call_op(lambda v: jnp.cumprod(v, axis=int(dim), dtype=jdt), (x,),
+                   {}, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        # index = first position achieving the running max
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        eq = (vv == vals)
+        first = jnp.where(eq, ar, n)
+        idxs = jax.lax.associative_scan(jnp.minimum, first, axis=ax)
+        return vals, idxs.astype(dtypes.to_jax(dtype))
+    return call_op(f, (x,), {}, multi_out=True, op_name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
+        eq = (vv == vals)
+        first = jnp.where(eq, ar, n)
+        idxs = jax.lax.associative_scan(jnp.minimum, first, axis=ax)
+        return vals, idxs.astype(dtypes.to_jax(dtype))
+    return call_op(f, (x,), {}, multi_out=True, op_name="cummin")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+    return call_op(f, (x,), {}, op_name="logcumsumexp")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [ensure_tensor(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        tensors.append(ensure_tensor(prepend))
+    if has_app:
+        tensors.append(ensure_tensor(append))
+
+    def f(*args):
+        v, rest = args[0], list(args[1:])
+        pre = rest.pop(0) if has_pre else None
+        app = rest.pop(0) if has_app else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return call_op(f, tensors, {}, op_name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                       axis2=axis2), (x,), {}, op_name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                          axis2=axis2), (x,), {},
+                   op_name="diagonal")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return call_op(lambda a, b, w: a + w * (b - a), (x, y, weight), {},
+                       op_name="lerp")
+    return call_op(lambda a, b: a + weight * (b - a), (x, y), {},
+                   op_name="lerp")
+
+
+def rad2deg(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.degrees(v.astype(jnp.float32)
+                                         if jnp.issubdtype(v.dtype, jnp.integer)
+                                         else v), (x,), {}, op_name="rad2deg")
+
+
+def deg2rad(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.radians(v.astype(jnp.float32)
+                                         if jnp.issubdtype(v.dtype, jnp.integer)
+                                         else v), (x,), {}, op_name="deg2rad")
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return call_op(lambda v, i: jnp.take(v.reshape(-1), i, mode=jmode),
+                   (x, index), {}, op_name="take")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), (x, y), {},
+                   op_name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), (x, y), {},
+                   op_name="allclose")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                            neginf=neginf), (x,), {},
+                   op_name="nan_to_num")
+
+
+def gammaln(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(jax.scipy.special.gammaln, (x,), {}, op_name="gammaln")
+
+
+def polygamma(x, n, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jax.scipy.special.polygamma(n, v), (x,), {},
+                   op_name="polygamma")
+
+
+def exp2(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(jnp.exp2, (x,), {}, op_name="exp2")
+
+
+def expit(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(jax.scipy.special.expit, (x,), {}, op_name="expit")
+
+
+def softmax_(x, axis=-1):
+    return call_op(lambda v: jax.nn.softmax(v, axis=axis), (ensure_tensor(x),),
+                   {}, op_name="softmax")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return call_op(f, (x,), {}, op_name="renorm")
+
+
+def inverse(x, name=None):
+    x = ensure_tensor(x)
+    return call_op(jnp.linalg.inv, (x,), {}, op_name="inverse")
+
+
+# matmul lives in linalg but paddle exposes paddle.matmul / mm / bmm too
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return call_op(f, (x, y), {}, op_name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: jnp.sum(a * b, axis=-1), (x, y), {},
+                   op_name="dot")
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdim)
+        # min mode: lower median value
+        ax = -1 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        n = vv.shape[ax]
+        s = jnp.sort(vv, axis=ax)
+        val = jnp.take(s, (n - 1) // 2, axis=ax)
+        if keepdim and axis is not None:
+            val = jnp.expand_dims(val, ax)
+        return val
+    return call_op(f, (x,), {}, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                   (x,), {}, op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.quantile(v, jnp.asarray(q), axis=axis,
+                                          keepdims=keepdim,
+                                          method=interpolation),
+                   (x,), {}, op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return call_op(lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=axis,
+                                             keepdims=keepdim), (x,), {},
+                   op_name="nanquantile")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+
+    def f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return call_op(f, (input,), {}, op_name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    if weights is not None:
+        w = ensure_tensor(weights)
+        return call_op(lambda v, wv: jnp.bincount(v, weights=wv,
+                                                  minlength=minlength,
+                                                  length=None),
+                       (x, w), {}, op_name="bincount")
+    return call_op(lambda v: jnp.bincount(v, minlength=minlength), (x,), {},
+                   op_name="bincount")
+
+
+def add_n(inputs, name=None):
+    tensors = [ensure_tensor(t) for t in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return call_op(lambda *xs: sum(xs[1:], xs[0]), tensors, {}, op_name="add_n")
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(pred, lab):
+        topk = jax.lax.top_k(pred, k)[1]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk == lab2, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return call_op(f, (input, label), {}, op_name="accuracy")
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return call_op(lambda a, b: jnp.all(a == b), (x, y), {}, op_name="equal_all")

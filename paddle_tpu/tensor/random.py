@@ -1,0 +1,174 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py).
+
+All draws go through the stateful global Generator (paddle_tpu.random_state)
+so eager code is reproducible under paddle.seed and traced code gets the
+key threaded through the jitted step by the functionalizer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from .. import dtype as dtypes
+from .. import random_state
+from ._helpers import ensure_tensor, shape_list, unwrap
+
+
+def _dt(dtype, default):
+    return dtypes.to_jax(dtype) if dtype is not None else dtypes.to_jax(default)
+
+
+def rand(shape, dtype=None, name=None):
+    key = random_state.next_key()
+    return Tensor(jax.random.uniform(key, shape_list(shape),
+                                     dtype=_dt(dtype, dtypes.default_float())))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = (jax.random.PRNGKey(seed) if seed else random_state.next_key())
+    lo = float(unwrap(min)) if isinstance(min, Tensor) else float(min)
+    hi = float(unwrap(max)) if isinstance(max, Tensor) else float(max)
+    return Tensor(jax.random.uniform(key, shape_list(shape),
+                                     dtype=_dt(dtype, dtypes.default_float()),
+                                     minval=lo, maxval=hi))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._replace_value(uniform(x.shape, dtype=x.dtype, min=min, max=max,
+                             seed=seed)._data)
+    x._grad_node = None
+    return x
+
+
+def randn(shape, dtype=None, name=None):
+    key = random_state.next_key()
+    return Tensor(jax.random.normal(key, shape_list(shape),
+                                    dtype=_dt(dtype, dtypes.default_float())))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = random_state.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)
+        s = ensure_tensor(std, ref=m)
+        shp = tuple(np.broadcast_shapes(tuple(m.shape), tuple(s.shape)))
+        eps = jax.random.normal(key, shp, dtype=m._data.dtype
+                                if jnp.issubdtype(m._data.dtype, jnp.floating)
+                                else jnp.float32)
+        return call_op(lambda mm, ss: mm + ss * eps, (m, s), {},
+                       op_name="normal")
+    shp = shape_list(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(
+        key, shp, dtype=dtypes.to_jax(dtypes.default_float())))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = random_state.next_key()
+    x._replace_value(mean + std * jax.random.normal(key, tuple(x.shape),
+                                                    dtype=x._data.dtype))
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = (jax.random.PRNGKey(seed) if seed else random_state.next_key())
+    return Tensor(mean + std * jax.random.normal(
+        key, shape_list(shape), dtype=_dt(dtype, dtypes.default_float())))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_state.next_key()
+    return Tensor(jax.random.randint(key, shape_list(shape), int(low),
+                                     int(high),
+                                     dtype=_dt(dtype, dtypes.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = random_state.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(
+        dtypes.to_jax(dtype)))
+
+
+def shuffle(x, axis=0):
+    x = ensure_tensor(x)
+    key = random_state.next_key()
+    perm = jax.random.permutation(key, x.shape[axis])
+    return call_op(lambda v: jnp.take(v, perm, axis=axis), (x,), {},
+                   op_name="shuffle")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = random_state.next_key()
+
+    def f(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(*v.shape[:-1], num_samples)
+                if v.ndim > 1 else (num_samples,)).astype(jnp.int64)
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(key, v.shape, dtype=jnp.float32)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return call_op(f, (x,), {}, op_name="multinomial")
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = random_state.next_key()
+    return call_op(lambda v: jax.random.bernoulli(key, v).astype(v.dtype),
+                   (x,), {}, op_name="bernoulli")
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = random_state.next_key()
+    x._replace_value(jax.random.bernoulli(key, p, tuple(x.shape)).astype(
+        x._data.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = random_state.next_key()
+    return call_op(lambda v: jax.random.poisson(key, v).astype(v.dtype),
+                   (x,), {}, op_name="poisson")
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = random_state.next_key()
+    x._replace_value(jax.random.exponential(
+        key, tuple(x.shape), dtype=x._data.dtype) / lam)
+    return x
+
+
+def binomial(count, prob, name=None):
+    count, prob = ensure_tensor(count), ensure_tensor(prob)
+    key = random_state.next_key()
+    return call_op(lambda n, p: jax.random.binomial(
+        key, n.astype(jnp.float32), p).astype(jnp.int64),
+        (count, prob), {}, op_name="binomial")
+
+
+def rand_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return rand(x.shape, dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randn(x.shape, dtype or x.dtype)

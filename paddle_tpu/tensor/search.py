@@ -1,0 +1,158 @@
+"""Search / sort / index ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from .. import dtype as dtypes
+from ._helpers import ensure_tensor, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype)
+    return call_op(lambda v: jnp.argmax(v, axis=axis, keepdims=keepdim if axis is not None else False)
+                   .astype(jdt), (x,), {}, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype)
+    return call_op(lambda v: jnp.argmin(v, axis=axis, keepdims=keepdim if axis is not None else False)
+                   .astype(jdt), (x,), {}, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=True, descending=descending)
+        return idx.astype(jnp.int64)
+    return call_op(f, (x,), {}, op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        out = jnp.sort(v, axis=axis, stable=True, descending=descending)
+        return out
+    return call_op(f, (x,), {}, op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    kk = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+
+    def f(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return call_op(f, (x,), {}, multi_out=True, op_name="topk")
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x = ensure_tensor(x, ref=y if isinstance(y, Tensor) else None)
+    y = ensure_tensor(y, ref=x)
+    return call_op(lambda c, a, b: jnp.where(c, a, b), (condition, x, y), {},
+                   op_name="where")
+
+
+def where_(condition, x=None, y=None, name=None):
+    if isinstance(x, Tensor):
+        from ._helpers import _inplace_op
+        return _inplace_op(x, lambda xs: where(condition, xs, y))
+    return where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)  # dynamic shape → host (eager-only)
+    nz = arr.nonzero()
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64)).reshape(-1, 1))
+                     for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, values = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+    idt = jnp.int32 if out_int32 else jnp.int64
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(idt)
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(flat_s, flat_v)
+        return out.reshape(v.shape).astype(idt)
+    return call_op(f, (ss, values), {}, op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        s = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax, stable=True)
+        vals = jnp.take(s, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+    return call_op(f, (x,), {}, multi_out=True, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        ax = axis % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        n = vv.shape[-1]
+        # count occurrences of each element (n is a trailing data axis; O(n^2)
+        # compare is fine for the op's typical small last dim)
+        cnt = jnp.sum(vv[..., :, None] == vv[..., None, :], axis=-1)
+        maxcnt = jnp.max(cnt, axis=-1, keepdims=True)
+        cand = jnp.where(cnt == maxcnt, vv, -jnp.inf)
+        val = jnp.max(cand, axis=-1)
+        # last index of the chosen value (matches reference tie-breaking)
+        idx = jnp.argmax(jnp.where(vv == val[..., None], 1, 0)
+                         * jnp.arange(1, n + 1), axis=-1)
+        if keepdim:
+            val = jnp.expand_dims(val, -1)
+            idx = jnp.expand_dims(idx, -1)
+            val = jnp.moveaxis(val, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return val, idx.astype(jnp.int64)
+    return call_op(f, (x,), {}, multi_out=True, op_name="mode")

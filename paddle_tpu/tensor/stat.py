@@ -1,0 +1,33 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op
+from ._helpers import ensure_tensor, normalize_axis
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    from .math import mean as _m
+    return _m(x, axis, keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return call_op(lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0,
+                                     keepdims=keepdim), (x,), {},
+                   op_name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    return call_op(lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0,
+                                     keepdims=keepdim), (x,), {},
+                   op_name="std")
+
+
+def numel(x, name=None):
+    from .creation import numel as _n
+    return _n(x)

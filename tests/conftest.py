@@ -1,0 +1,30 @@
+"""Test bootstrap: force an 8-device virtual CPU platform.
+
+This is the adopted version of the reference's fake-device trick
+(test/custom_runtime/ custom_cpu plugin — run backend tests without the
+hardware): 8 virtual CPU devices give real collectives/sharding with no TPU.
+
+NOTE: the session's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon already in the env, so the env var alone is too late —
+jax.config.update is required, plus XLA_FLAGS before backend init.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+assert jax.device_count() == 8
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,78 @@
+"""OpTest-style harness (adopted from the reference's
+test/legacy_test/op_test.py design): run an op, compare against a numpy
+reference, and check analytic gradients against finite differences."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+_TOL = {
+    "float32": dict(rtol=2e-4, atol=1e-4),
+    "float64": dict(rtol=1e-7, atol=1e-9),
+    "float16": dict(rtol=1e-2, atol=1e-3),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2),
+}
+
+
+def check_forward(pd_fn, np_fn, inputs, rtol=None, atol=None, **kwargs):
+    """inputs: list of numpy arrays. Compares pd_fn(*tensors) with np_fn(*arrays)."""
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    got = pd_fn(*tensors, **kwargs)
+    want = np_fn(*inputs, **kwargs)
+    _assert_tree_close(got, want, rtol, atol)
+    return got
+
+
+def _assert_tree_close(got, want, rtol=None, atol=None):
+    if isinstance(want, (tuple, list)):
+        assert isinstance(got, (tuple, list)) and len(got) == len(want)
+        for g, w in zip(got, want):
+            _assert_tree_close(g, w, rtol, atol)
+        return
+    g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+    w = np.asarray(want)
+    tol = _TOL.get(str(w.dtype), dict(rtol=1e-5, atol=1e-6))
+    np.testing.assert_allclose(
+        np.asarray(g, dtype=np.float64) if g.dtype.kind in "fc" else g,
+        np.asarray(w, dtype=np.float64) if w.dtype.kind in "fc" else w,
+        rtol=rtol or tol["rtol"], atol=atol or tol["atol"])
+
+
+def check_grad(pd_fn, inputs, grad_input_idx=None, eps=1e-4, rtol=5e-3,
+               atol=1e-4, **kwargs):
+    """Numeric-vs-analytic gradient check (the reference's key op oracle).
+
+    pd_fn maps tensors → single tensor; gradient of sum(output) is compared
+    against central finite differences for each selected input.
+    """
+    inputs = [np.asarray(a, dtype=np.float64) for a in inputs]
+    idxs = range(len(inputs)) if grad_input_idx is None else grad_input_idx
+
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in inputs]
+    out = pd_fn(*tensors, **kwargs)
+    loss = out.sum()
+    loss.backward()
+
+    for i in idxs:
+        analytic = tensors[i].grad.numpy()
+        numeric = np.zeros_like(inputs[i])
+        flat = inputs[i].reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            hi = _eval_sum(pd_fn, inputs, kwargs)
+            flat[j] = orig - eps
+            lo = _eval_sum(pd_fn, inputs, kwargs)
+            flat[j] = orig
+            num_flat[j] = (hi - lo) / (2 * eps)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")
+
+
+def _eval_sum(pd_fn, inputs, kwargs):
+    with paddle.no_grad():
+        tensors = [paddle.to_tensor(a) for a in inputs]
+        return float(pd_fn(*tensors, **kwargs).sum().numpy())

@@ -1,0 +1,212 @@
+"""Autograd engine tests: tape backward, accumulation, hooks, paddle.grad,
+numeric-vs-analytic checks (the reference's OpTest grad oracle)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+class TestBackwardBasics:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_diamond(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        y = a * b  # y = 12 x^2, dy/dx = 24x = 48
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 48.0)
+
+    def test_reuse_tensor_twice_in_one_op(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True by default
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = x * 3 + y
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    def test_backward_twice_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_non_scalar_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 5).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0])
+
+    def test_hook_modifies_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 2)
+        (x * 5).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+    def test_retain_grads_non_leaf(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.retain_grads()
+        (y * 3).sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 3
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, z])
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+
+class TestNumericGrad:
+    @pytest.mark.parametrize("name", ["exp", "log", "sqrt", "tanh", "sigmoid",
+                                      "sin", "square"])
+    def test_unary_grads(self, name):
+        x = np.random.RandomState(0).uniform(0.2, 1.5, (2, 3))
+        check_grad(getattr(paddle, name), [x])
+
+    def test_matmul_grad(self):
+        r = np.random.RandomState(1)
+        check_grad(paddle.matmul, [r.randn(3, 4), r.randn(4, 2)])
+
+    def test_mean_sum_grad(self):
+        r = np.random.RandomState(2)
+        check_grad(lambda x: paddle.mean(x, axis=1), [r.randn(3, 4)])
+        check_grad(lambda x: x.sum(axis=0), [r.randn(3, 4)])
+
+    def test_softmax_ce_like_pipeline_grad(self):
+        r = np.random.RandomState(3)
+        logits = r.randn(4, 5)
+
+        def f(x):
+            e = paddle.exp(x - x.max(axis=1, keepdim=True))
+            p = e / e.sum(axis=1, keepdim=True)
+            return -(paddle.log(p) * p).sum()
+        check_grad(f, [logits])
+
+    def test_gather_grad(self):
+        r = np.random.RandomState(4)
+        x = r.randn(5, 3)
+
+        def f(t):
+            return paddle.gather(t, paddle.to_tensor(np.array([0, 2, 2])))
+        check_grad(f, [x])
+
+    def test_indexing_grad(self):
+        r = np.random.RandomState(5)
+        check_grad(lambda t: t[1:, :2] * 2, [r.randn(3, 3)])
+
+    def test_concat_split_grad(self):
+        r = np.random.RandomState(6)
+
+        def f(a, b):
+            c = paddle.concat([a, b], axis=0)
+            p1, p2 = paddle.split(c, 2, axis=0)
+            return p1 * p2
+        check_grad(f, [r.randn(2, 3), r.randn(2, 3)])
+
+
+class TestInplace:
+    def test_add_(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+
+    def test_inplace_autograd(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 3      # y = 3x
+        y.add_(paddle.to_tensor([1.0]))  # y = 3x + 1
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    def test_setitem_grad(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        y = x * 2
+        y[0] = 0.0
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+class TestMixedDtypeGraph:
+    def test_int_output_edge_does_not_drop_grads(self):
+        # regression: topk's int index output consumed by gather must not
+        # desync the dependency count and drop the float path's gradient
+        x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+        vals, idx = paddle.topk(x, 2)
+        loss = vals.sum() + paddle.gather(x, idx).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+
+    def test_grad_does_not_pollute_other_leaves(self):
+        w = paddle.to_tensor([5.0], stop_gradient=False)
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        y = w * a
+        (ga,) = paddle.grad(y, a)
+        np.testing.assert_allclose(ga.numpy(), [5.0])
+        assert w.grad is None
+        assert a.grad is None
+
+    def test_split_non_divisible_raises(self):
+        with pytest.raises(ValueError):
+            paddle.split(paddle.ones([5]), 2)

@@ -1,0 +1,272 @@
+"""Op parity tests vs numpy (OpTest-style, ref test/legacy_test design)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle.float32
+        np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_int_default_dtype(self):
+        assert paddle.to_tensor([1, 2]).dtype == paddle.int64
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3], dtype="int32").dtype == paddle.int32
+        f = paddle.full([2], 7.0)
+        np.testing.assert_array_equal(f.numpy(), [7, 7])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.arange(5).dtype == paddle.int64
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+
+    def test_tril_triu_diag(self):
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        check_forward(paddle.tril, np.tril, [a])
+        check_forward(paddle.triu, np.triu, [a])
+        v = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        np.testing.assert_array_equal(paddle.diag(paddle.to_tensor(v)).numpy(), np.diag(v))
+
+
+class TestMath:
+    @pytest.mark.parametrize("name", ["exp", "log", "sqrt", "tanh", "sin",
+                                      "cos", "abs", "floor", "ceil",
+                                      "sigmoid", "square"])
+    def test_unary_parity(self, name):
+        x = np.random.RandomState(0).uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+        np_map = {"sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+                  "square": np.square}
+        np_fn = np_map[name] if name in np_map else getattr(np, name)
+        check_forward(getattr(paddle, name), np_fn, [x])
+
+    @pytest.mark.parametrize("name,npf", [("add", np.add),
+                                          ("subtract", np.subtract),
+                                          ("multiply", np.multiply),
+                                          ("divide", np.divide),
+                                          ("maximum", np.maximum),
+                                          ("minimum", np.minimum),
+                                          ("pow", np.power)])
+    def test_binary_parity(self, name, npf):
+        r = np.random.RandomState(1)
+        x = r.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+        y = r.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+        check_forward(getattr(paddle, name), npf, [x, y])
+
+    def test_broadcasting(self):
+        x = np.ones((3, 1, 4), np.float32)
+        y = np.arange(2, dtype=np.float32).reshape(2, 1)
+        check_forward(paddle.add, np.add, [x, y])
+
+    def test_scalar_promotion(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        assert (t + 1).dtype == paddle.float32
+        assert (1 - t).numpy().tolist() == [0.0, -1.0]
+        assert (t * 2.0).dtype == paddle.float32
+        ti = paddle.to_tensor([1, 2])
+        assert (ti + 1).dtype == paddle.int64
+
+    @pytest.mark.parametrize("name,npf", [("sum", np.sum), ("mean", np.mean),
+                                          ("max", np.max), ("min", np.min),
+                                          ("prod", np.prod)])
+    def test_reductions(self, name, npf):
+        x = np.random.RandomState(2).randn(2, 3, 4).astype(np.float32)
+        check_forward(getattr(paddle, name), npf, [x])
+        got = getattr(paddle, name)(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(got.numpy(), npf(x, axis=1), rtol=1e-5)
+        got = getattr(paddle, name)(paddle.to_tensor(x), axis=[0, 2], keepdim=True)
+        np.testing.assert_allclose(got.numpy(), npf(x, axis=(0, 2), keepdims=True), rtol=1e-5)
+
+    def test_matmul(self):
+        r = np.random.RandomState(3)
+        a = r.randn(4, 5).astype(np.float32)
+        b = r.randn(5, 6).astype(np.float32)
+        check_forward(paddle.matmul, np.matmul, [a, b], rtol=1e-4, atol=1e-5)
+        # transpose flags
+        got = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                            transpose_y=True)
+        np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_cumsum_clip_trace(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.cumsum(x, axis=1))
+        np.testing.assert_allclose(paddle.clip(paddle.to_tensor(x), 1.0, 4.0).numpy(),
+                                   np.clip(x, 1.0, 4.0))
+        np.testing.assert_allclose(paddle.trace(paddle.to_tensor(x)).numpy(), np.trace(x))
+
+    def test_logsumexp_allclose(self):
+        x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as slse
+        got = paddle.logsumexp(paddle.to_tensor(x), axis=1)
+        np.testing.assert_allclose(got.numpy(), slse(x, axis=1), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(x)
+        assert t.reshape([4, 6]).shape == [4, 6]
+        assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+
+    def test_concat_stack_split(self):
+        a = np.ones((2, 3), np.float32)
+        b = np.zeros((2, 3), np.float32)
+        c = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        assert s.shape == [2, 2, 3]
+        parts = paddle.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+        parts = paddle.split(c, [1, 3], axis=0)
+        assert parts[1].shape == [3, 3]
+        parts = paddle.split(c, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = paddle.ones([1, 3, 1, 4])
+        assert paddle.squeeze(x).shape == [3, 4]
+        assert paddle.squeeze(x, axis=0).shape == [3, 1, 4]
+        assert paddle.unsqueeze(x, [0, 2]).shape == [1, 1, 1, 3, 1, 4]
+        assert paddle.flatten(x).shape == [12]
+        assert paddle.flatten(x, 1, 2).shape == [1, 3, 4]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        got = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_array_equal(got.numpy(), x[[0, 2]])
+        upd = np.full((2, 3), -1, np.float32)
+        got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        want = x.copy(); want[[0, 2]] = -1
+        np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_gather_nd(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.array([[0, 1], [1, 2]])
+        got = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_array_equal(got.numpy(), x[[0, 1], [1, 2]])
+
+    def test_indexing(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(t[0].numpy(), x[0])
+        np.testing.assert_array_equal(t[:, 1].numpy(), x[:, 1])
+        np.testing.assert_array_equal(t[..., -1].numpy(), x[..., -1])
+        np.testing.assert_array_equal(t[0, 1:3, ::2].numpy(), x[0, 1:3, ::2])
+        mask = x[..., 0] > 5
+        np.testing.assert_array_equal(
+            t[paddle.to_tensor(mask)].numpy(), x[mask])
+
+    def test_setitem(self):
+        x = np.zeros((3, 3), np.float32)
+        t = paddle.to_tensor(x)
+        t[1] = 5.0
+        assert t.numpy()[1].tolist() == [5, 5, 5]
+        t[0, 0] = paddle.to_tensor(2.0)
+        assert t.numpy()[0, 0] == 2.0
+
+    def test_tile_expand_flip(self):
+        x = np.array([[1, 2]], dtype=np.float32)
+        assert paddle.tile(paddle.to_tensor(x), [2, 3]).shape == [2, 6]
+        assert paddle.expand(paddle.to_tensor(x), [4, 2]).shape == [4, 2]
+        np.testing.assert_array_equal(
+            paddle.flip(paddle.to_tensor(x), axis=1).numpy(), x[:, ::-1])
+
+    def test_unique(self):
+        x = np.array([3, 1, 2, 1, 3])
+        u = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+        u, inv, cnt = paddle.unique(paddle.to_tensor(x), return_inverse=True,
+                                    return_counts=True)
+        np.testing.assert_array_equal(cnt.numpy(), [2, 1, 2])
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        t = paddle.to_tensor(x)
+        assert paddle.argmax(t).item() == 4
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), [0, 1])
+        vals, idx = paddle.topk(t, 2, axis=1)
+        np.testing.assert_array_equal(idx.numpy(), [[0, 2], [1, 2]])
+        np.testing.assert_array_equal(paddle.sort(t, axis=1).numpy(), np.sort(x, axis=1))
+        np.testing.assert_array_equal(paddle.argsort(t, axis=1).numpy(),
+                                      np.argsort(x, axis=1))
+
+    def test_where_nonzero(self):
+        x = np.array([1.0, -1.0, 2.0], np.float32)
+        t = paddle.to_tensor(x)
+        got = paddle.where(t > 0, t, paddle.zeros_like(t))
+        np.testing.assert_array_equal(got.numpy(), [1, 0, 2])
+        nz = paddle.nonzero(t > 0)
+        np.testing.assert_array_equal(nz.numpy(), [[0], [2]])
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        r = np.random.RandomState(5)
+        a = (r.randn(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.linalg.norm(t).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(t).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(t).numpy(),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr_eigh(self):
+        r = np.random.RandomState(6)
+        a = r.randn(4, 3).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), a,
+                                   rtol=1e-3, atol=1e-4)
+        q, rr = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ rr.numpy(), a, rtol=1e-3, atol=1e-4)
+        sym = (a.T @ a).astype(np.float32)
+        w, v = paddle.linalg.eigh(paddle.to_tensor(sym))
+        np.testing.assert_allclose(v.numpy() @ np.diag(w.numpy()) @ v.numpy().T,
+                                   sym, rtol=1e-3, atol=1e-3)
+
+    def test_einsum(self):
+        r = np.random.RandomState(7)
+        a = r.randn(2, 3).astype(np.float32)
+        b = r.randn(3, 4).astype(np.float32)
+        got = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-4, atol=1e-5)
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.rand([4, 4])
+        paddle.seed(42)
+        b = paddle.rand([4, 4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_distributions_rough(self):
+        paddle.seed(0)
+        u = paddle.uniform([10000], min=0.0, max=1.0)
+        assert 0.45 < float(u.mean()) < 0.55
+        n = paddle.randn([10000])
+        assert abs(float(n.mean())) < 0.05
+        assert 0.9 < float(n.std()) < 1.1
+        r = paddle.randint(0, 10, [1000])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(100)
+        assert sorted(p.numpy().tolist()) == list(range(100))
+
+    def test_dtype_cast(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert x.astype("int32").dtype == paddle.int32
+        assert x.astype(paddle.float16).dtype == paddle.float16
+        assert x.cast("bool").dtype == paddle.bool_
