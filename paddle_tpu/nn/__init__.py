@@ -1,0 +1,44 @@
+"""paddle.nn (ref: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+
+from .layer.layers import Layer  # noqa: F401
+from .layer.container import (Sequential, LayerList, LayerDict,  # noqa: F401
+                              ParameterList)
+from .layer.common import (  # noqa: F401
+    Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
+    Flatten, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D, Bilinear,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, CosineSimilarity, PairwiseDistance,
+    Unfold, Fold, PixelShuffle, PixelUnshuffle, ChannelShuffle)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Tanhshrink, Silu, Swish, Mish,
+    Softsign, Hardswish, GELU, ELU, CELU, SELU, LeakyReLU, PReLU, RReLU,
+    Hardshrink, Softshrink, Hardtanh, Hardsigmoid, Softplus, ThresholdedReLU,
+    Softmax, LogSoftmax, Maxout, GLU)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    LPPool1D, LPPool2D, AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+    AdaptiveMaxPool3D)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
+    MultiLabelSoftMarginLoss, SoftMarginLoss, CTCLoss)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer)
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU)
+from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                   ClipGradByGlobalNorm)
+
+from . import utils  # noqa: F401

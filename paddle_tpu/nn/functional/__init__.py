@@ -1,0 +1,33 @@
+"""paddle.nn.functional (ref: python/paddle/nn/functional/__init__.py)."""
+from .activation import (  # noqa: F401
+    relu, relu_, relu6, gelu, silu, swish, sigmoid, log_sigmoid, tanh,
+    tanhshrink, hardshrink, softshrink, hardtanh, hardsigmoid, hardswish,
+    elu, elu_, celu, selu, leaky_relu, prelu, rrelu, softplus, softsign,
+    mish, thresholded_relu, softmax, softmax_, log_softmax, gumbel_softmax,
+    maxout, glu)
+from .common import (  # noqa: F401
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, pad, zeropad2d,
+    cosine_similarity, pixel_shuffle, pixel_unshuffle, channel_shuffle,
+    interpolate, upsample, unfold, fold, bilinear, label_smooth)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    lp_pool1d, lp_pool2d, adaptive_avg_pool1d, adaptive_avg_pool2d,
+    adaptive_avg_pool3d, adaptive_max_pool1d, adaptive_max_pool2d,
+    adaptive_max_pool3d)
+from .norm import (  # noqa: F401
+    batch_norm, layer_norm, rms_norm, instance_norm, group_norm,
+    local_response_norm, normalize)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
+    triplet_margin_loss, sigmoid_focal_loss, square_error_cost, log_loss,
+    dice_loss, poisson_nll_loss, gaussian_nll_loss,
+    multi_label_soft_margin_loss, soft_margin_loss, ctc_loss)
+from .input import embedding, one_hot  # noqa: F401
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
+    sequence_mask)

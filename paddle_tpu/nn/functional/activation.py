@@ -1,0 +1,229 @@
+"""paddle.nn.functional activations (ref: python/paddle/nn/functional/activation.py).
+
+All activations are pure jnp functions dispatched through the autograd tape;
+XLA fuses them into surrounding matmuls on TPU, so there is no need for the
+reference's hand-fused CUDA activation kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+from ... import dtype as dtypes
+
+
+def _unary(jfn, x, name):
+    x = ensure_tensor(x)
+    return call_op(jfn, (x,), {}, op_name=name)
+
+
+def relu(x, name=None):
+    return _unary(lambda v: jnp.maximum(v, 0), x, "relu")
+
+
+def relu_(x, name=None):
+    x._check_inplace_autograd()
+    return x._inplace_assign(relu(x._snapshot()))
+
+
+def relu6(x, name=None):
+    return _unary(lambda v: jnp.clip(v, 0, 6), x, "relu6")
+
+
+def gelu(x, approximate: bool = False, name=None):
+    return _unary(lambda v: jax.nn.gelu(v, approximate=approximate), x, "gelu")
+
+
+def silu(x, name=None):
+    return _unary(jax.nn.silu, x, "silu")
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return _unary(jax.nn.log_sigmoid, x, "log_sigmoid")
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x, "tanh")
+
+
+def tanhshrink(x, name=None):
+    return _unary(lambda v: v - jnp.tanh(v), x, "tanhshrink")
+
+
+def hardshrink(x, threshold: float = 0.5, name=None):
+    return _unary(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x,
+                  "hardshrink")
+
+
+def softshrink(x, threshold: float = 0.5, name=None):
+    def f(v):
+        return jnp.where(v > threshold, v - threshold,
+                         jnp.where(v < -threshold, v + threshold, 0.0))
+    return _unary(f, x, "softshrink")
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0, name=None):
+    return _unary(lambda v: jnp.clip(v, min, max), x, "hardtanh")
+
+
+def hardsigmoid(x, slope: float = 0.1666667, offset: float = 0.5, name=None):
+    return _unary(lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), x,
+                  "hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return _unary(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x,
+                  "hardswish")
+
+
+def elu(x, alpha: float = 1.0, name=None):
+    return _unary(lambda v: jax.nn.elu(v, alpha), x, "elu")
+
+
+def elu_(x, alpha: float = 1.0, name=None):
+    x._check_inplace_autograd()
+    return x._inplace_assign(elu(x._snapshot(), alpha))
+
+
+def celu(x, alpha: float = 1.0, name=None):
+    return _unary(lambda v: jax.nn.celu(v, alpha), x, "celu")
+
+
+def selu(x,
+         scale: float = 1.0507009873554804934193349852946,
+         alpha: float = 1.6732632423543772848170429916717,
+         name=None):
+    return _unary(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                  x, "selu")
+
+
+def leaky_relu(x, negative_slope: float = 0.01, name=None):
+    return _unary(lambda v: jnp.where(v >= 0, v, negative_slope * v), x,
+                  "leaky_relu")
+
+
+def prelu(x, weight, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def f(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            # per-channel slope, broadcast along the channel axis
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(v >= 0, v, wb * v)
+    return call_op(f, (x, weight), {}, op_name="prelu")
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = True, name=None):
+    from ...random_state import next_key
+    x = ensure_tensor(x)
+    if not training:
+        slope = (lower + upper) / 2.0
+        return leaky_relu(x, slope)
+    key = next_key()
+
+    def f(v):
+        a = jax.random.uniform(key, v.shape, dtype=jnp.float32,
+                               minval=lower, maxval=upper).astype(v.dtype)
+        return jnp.where(v >= 0, v, a * v)
+    return call_op(f, (x,), {}, op_name="rrelu")
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0, name=None):
+    def f(v):
+        bv = beta * v
+        return jnp.where(bv > threshold, v, jnp.logaddexp(bv, 0.0) / beta)
+    return _unary(f, x, "softplus")
+
+
+def softsign(x, name=None):
+    return _unary(lambda v: v / (1 + jnp.abs(v)), x, "softsign")
+
+
+def mish(x, name=None):
+    return _unary(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, "mish")
+
+
+def thresholded_relu(x, threshold: float = 1.0, value: float = 0.0, name=None):
+    return _unary(lambda v: jnp.where(v > threshold, v, value), x,
+                  "thresholded_relu")
+
+
+def softmax(x, axis: int = -1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype) if dtype is not None else None
+
+    def f(v):
+        if jdt is not None:
+            v = v.astype(jdt)
+        return jax.nn.softmax(v, axis=axis)
+    return call_op(f, (x,), {}, op_name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._check_inplace_autograd()
+    return x._inplace_assign(softmax(x._snapshot(), axis, dtype))
+
+
+def log_softmax(x, axis: int = -1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype) if dtype is not None else None
+
+    def f(v):
+        if jdt is not None:
+            v = v.astype(jdt)
+        return jax.nn.log_softmax(v, axis=axis)
+    return call_op(f, (x,), {}, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1, name=None):
+    from ...random_state import next_key
+    x = ensure_tensor(x)
+    key = next_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, dtype=jnp.float32).astype(v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                        inplace=False)
+            # straight-through estimator
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return call_op(f, (x,), {}, op_name="gumbel_softmax")
+
+
+def maxout(x, groups: int, axis: int = 1, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        if c % groups:
+            raise ValueError("channels must be divisible by groups")
+        new_shape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return call_op(f, (x,), {}, op_name="maxout")
+
+
+def glu(x, axis: int = -1, name=None):
+    return _unary(lambda v: jax.nn.glu(v, axis=axis), x, "glu")

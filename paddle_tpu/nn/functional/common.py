@@ -1,0 +1,362 @@
+"""paddle.nn.functional common ops (ref: python/paddle/nn/functional/common.py).
+
+linear/dropout/pad/interpolate etc. as pure jnp ops over the tape.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+from ...random_state import next_key
+from ... import dtype as dtypes
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, weight stored [in_features, out_features] like the
+    reference (ref: nn/functional/common.py linear)."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        return call_op(lambda v, w, b: jnp.matmul(v, w) + b,
+                       (x, weight, bias), {}, op_name="linear")
+    return call_op(lambda v, w: jnp.matmul(v, w), (x, weight), {},
+                   op_name="linear")
+
+
+def dropout(x, p: float = 0.5, axis=None, training: bool = True,
+            mode: str = "upscale_in_train", name=None):
+    """ref: nn/functional/common.py dropout — both modes preserved:
+    'upscale_in_train' (scale by 1/keep in train) and 'downscale_in_infer'
+    (scale by keep at infer)."""
+    x = ensure_tensor(x)
+    if p == 0.0 and mode == "upscale_in_train":
+        return x
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    if not 0 <= p <= 1:
+        raise ValueError("dropout p must be in [0, 1]")
+    keep = 1.0 - p
+    if not training:
+        if mode == "downscale_in_infer":
+            return call_op(lambda v: v * keep, (x,), {}, op_name="dropout")
+        return x
+    if p == 1.0:
+        return call_op(lambda v: jnp.zeros_like(v), (x,), {}, op_name="dropout")
+    key = next_key()
+    axes = None
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+
+    def f(v):
+        mshape = list(v.shape)
+        if axes is not None:
+            mshape = [v.shape[i] if i in axes else 1 for i in range(v.ndim)]
+        mask = jax.random.bernoulli(key, keep, tuple(mshape))
+        out = jnp.where(mask, v, jnp.zeros((), v.dtype))
+        if mode == "upscale_in_train":
+            out = out / keep
+        return out
+    return call_op(f, (x,), {}, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (ref: functional/common.py alpha_dropout)."""
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    key = next_key()
+
+    def f(v):
+        mask = jax.random.bernoulli(key, keep, v.shape)
+        out = jnp.where(mask, v, jnp.asarray(alpha_p, v.dtype))
+        return a * out + b
+    return call_op(f, (x,), {}, op_name="alpha_dropout")
+
+
+def _normalize_pad(pad, ndim, data_format):
+    """paddle pad list is [last_dim_lo, last_dim_hi, 2nd_last_lo, ...]
+    over the *spatial* dims when x is 3/4/5-D."""
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().reshape(-1).tolist()
+    pad = [int(p) for p in pad]
+    return pad
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW", pad_from_left_axis: bool = True, name=None):
+    """ref: nn/functional/common.py pad. Supports constant/reflect/replicate/
+    circular; pad is per-spatial-dim pairs for 3/4/5-D inputs, or a full
+    2*ndim list for the generic case."""
+    x = ensure_tensor(x)
+    nd = x.ndim
+    plist = _normalize_pad(pad, nd, data_format)
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    if len(plist) == 2 * nd:
+        # full-rank pad: paddle semantics here pair up per axis.
+        # pad_from_left_axis=True (default) means pairs are given from the
+        # first axis; False means from the last axis backwards.
+        pairs = [(plist[2 * i], plist[2 * i + 1]) for i in range(nd)]
+        if not pad_from_left_axis:
+            pairs = pairs[::-1]
+        widths = pairs
+    else:
+        n_spatial = len(plist) // 2
+        channel_last = data_format[-1] == "C"
+        widths = [(0, 0)] * nd
+        # pad list runs from the LAST spatial dim backwards (paddle order)
+        spatial_axes = (list(range(2, nd)) if not channel_last
+                        else list(range(1, nd - 1)))
+        for i in range(n_spatial):
+            ax = spatial_axes[len(spatial_axes) - 1 - i]
+            widths[ax] = (plist[2 * i], plist[2 * i + 1])
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+    return call_op(f, (x,), {}, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return call_op(f, (x1, x2), {}, op_name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return call_op(f, (x,), {}, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return call_op(f, (x,), {}, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return call_op(f, (x,), {}, op_name="channel_shuffle")
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, align_mode: int = 0,
+                data_format: str = "NCHW", name=None):
+    """ref: nn/functional/common.py interpolate — nearest/bilinear/bicubic/
+    trilinear/area/linear via jax.image.resize (XLA-lowered gather)."""
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    nd = x.ndim
+    spatial_axes = (list(range(1, nd - 1)) if channel_last
+                    else list(range(2, nd)))
+    in_spatial = [x.shape[a] for a in spatial_axes]
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.numpy().reshape(-1).tolist()
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                       for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(in_spatial)
+        if isinstance(scale_factor, Tensor):
+            scale_factor = scale_factor.numpy().reshape(-1).tolist()
+        out_spatial = [int(s * f) for s, f in zip(in_spatial, scale_factor)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(v):
+        out_shape = list(v.shape)
+        for a, s in zip(spatial_axes, out_spatial):
+            out_shape[a] = s
+        if align_corners and jmode != "nearest":
+            # align_corners resize: explicit coordinate map via gather
+            ret = v
+            for a, s_out in zip(spatial_axes, out_spatial):
+                s_in = ret.shape[a]
+                if s_out == 1 or s_in == 1:
+                    idx = jnp.zeros((s_out,), jnp.float32)
+                else:
+                    idx = jnp.linspace(0.0, s_in - 1, s_out)
+                i0 = jnp.floor(idx).astype(jnp.int32)
+                i1 = jnp.minimum(i0 + 1, s_in - 1)
+                w = (idx - i0).astype(v.dtype)
+                g0 = jnp.take(ret, i0, axis=a)
+                g1 = jnp.take(ret, i1, axis=a)
+                bshape = [1] * ret.ndim
+                bshape[a] = s_out
+                w = w.reshape(bshape)
+                ret = g0 * (1 - w) + g1 * w
+            return ret
+        return jax.image.resize(v, tuple(out_shape), method=jmode)
+    return call_op(f, (x,), {}, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: functional/common.py unfold). NCHW only."""
+    x = ensure_tensor(x)
+
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt, pl = paddings
+        pb, pr = paddings
+    else:
+        pt, pl, pb, pr = paddings
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (v.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (v.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return call_op(f, (x,), {}, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — the adjoint of unfold, computed as a VJP of the im2col
+    patch extraction so it matches exactly."""
+    x = ensure_tensor(x)
+
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    elif len(paddings) == 2:
+        pt, pl = paddings
+        pb, pr = paddings
+    else:
+        pt, pl, pb, pr = paddings
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+
+        def unfold_arr(img):
+            img = jnp.pad(img, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+            p = jax.lax.conv_general_dilated_patches(
+                img, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return p.reshape(n, ckk, -1)
+        zero = jnp.zeros((n, c, oh, ow), v.dtype)
+        _, vjp = jax.vjp(unfold_arr, zero)
+        (out,) = vjp(v)
+        return out
+    return call_op(f, (x,), {}, op_name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, o] = x1[b, i] W[o, i, j] x2[b, j] + bias (ref: common.py bilinear)."""
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+    args = [x1, x2, weight]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    return call_op(f, tuple(args), {}, op_name="bilinear")
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        prior_dist = ensure_tensor(prior_dist)
+
+        def f(l, p):
+            return (1 - epsilon) * l + epsilon * p.reshape((1,) * (l.ndim - 1) + (-1,))
+        return call_op(f, (label, prior_dist), {}, op_name="label_smooth")
+
+    def f(l):
+        k = l.shape[-1]
+        return (1 - epsilon) * l + epsilon / k
+    return call_op(f, (label,), {}, op_name="label_smooth")

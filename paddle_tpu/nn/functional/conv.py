@@ -1,0 +1,205 @@
+"""paddle.nn.functional conv ops (ref: python/paddle/nn/functional/conv.py).
+
+Convolutions lower to jax.lax.conv_general_dilated, which XLA maps straight
+onto the MXU — there is no cuDNN-style algorithm selection layer to rebuild.
+Weight layout matches the reference: [out_c, in_c/groups, *kernel] (OIHW).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+def _resolve_padding(padding, n, strides, dilations, kernel):
+    """Returns (lax_padding, same_str_or_pairs). Paddle accepts int, list of
+    ints (per-dim), list of pairs, or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # list of pairs
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             data_format, op_name):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    channel_last = data_format[-1] == "C"
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    kernel = tuple(weight.shape[2:])
+    pad = _resolve_padding(padding, n, strides, dilations, kernel)
+    dn = _dim_numbers(n, channel_last)
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def f(v, w, *rest):
+        if channel_last:
+            # weight stays OIHW in storage; transpose to lax's expected layout
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = w.transpose(perm)
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+    return call_op(f, tuple(args), {}, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format: str = "NCL", name=None):
+    df = "NLC" if data_format == "NLC" else "NCL"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    "NWC" if df == "NLC" else "NCW", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format: str = "NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format: str = "NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format, "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, data_format, output_size, op_name):
+    """Transposed conv as the gradient of conv (lax.conv_transpose semantics
+    differ; use conv_general_dilated with lhs_dilation = stride, which is the
+    standard deconv lowering).  Weight layout follows the reference:
+    [in_c, out_c/groups, *kernel] for transpose convs."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    channel_last = data_format[-1] == "C"
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    out_pad = _tuple(output_padding, n)
+    kernel = tuple(weight.shape[2:])
+    dn = _dim_numbers(n, channel_last)
+
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            pads = [(0, 0)] * n
+        else:  # SAME
+            pads = []
+            for i in range(n):
+                eff_k = (kernel[i] - 1) * dilations[i] + 1
+                total = max(eff_k - strides[i], 0)
+                pads.append((total // 2, total - total // 2))
+    else:
+        pads = _resolve_padding(padding, n, strides, dilations, kernel)
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    def f(v, w, *rest):
+        # deconv = conv with lhs_dilation=stride, flipped kernel, swapped IO
+        eff_k = [(kernel[i] - 1) * dilations[i] + 1 for i in range(n)]
+        lax_pad = []
+        for i in range(n):
+            lo = eff_k[i] - 1 - pads[i][0]
+            hi = eff_k[i] - 1 - pads[i][1] + out_pad[i]
+            lax_pad.append((lo, hi))
+        # weight [in_c, out_c/groups, *k] → flip spatial, make OIHW with
+        # O=out_c, I=in_c/groups
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            wt = jnp.swapaxes(wf, 0, 1)
+        else:
+            in_c = w.shape[0]
+            ocg = w.shape[1]
+            wf2 = wf.reshape((groups, in_c // groups, ocg) + kernel)
+            wt = jnp.swapaxes(wf2, 1, 2).reshape(
+                (groups * ocg, in_c // groups) + kernel)
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            wt = wt.transpose(perm)
+        out = jax.lax.conv_general_dilated(
+            v, wt, window_strides=(1,) * n, padding=lax_pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+    out = call_op(f, tuple(args), {}, op_name=op_name)
+    if output_size is not None:
+        want = _tuple(output_size, n)
+        spatial = out.shape[1:-1] if channel_last else out.shape[2:]
+        if tuple(spatial) != tuple(want):
+            extra = [w_ - s for w_, s in zip(want, spatial)]
+            widths = [(0, 0), (0, 0)] + [(0, e) for e in extra]
+            if channel_last:
+                widths = [(0, 0)] + [(0, e) for e in extra] + [(0, 0)]
+            out = call_op(lambda v: jnp.pad(v, widths), (out,), {},
+                          op_name=op_name + "_outsize")
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format: str = "NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1,
+                              "NWC" if data_format == "NLC" else "NCW",
+                              output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format: str = "NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format, output_size,
+                              "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format: str = "NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format, output_size,
+                              "conv3d_transpose")

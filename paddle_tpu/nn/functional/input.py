@@ -1,0 +1,34 @@
+"""paddle.nn.functional input ops (ref: python/paddle/nn/functional/input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+from ... import dtype as dtypes
+
+
+def embedding(x, weight, padding_idx=None, sparse: bool = False, name=None):
+    """Gather rows of ``weight`` by index.  ``sparse`` is accepted for API
+    parity; on TPU the gather lowers to XLA dynamic-gather either way."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (idx == pi)
+            out = jnp.where(mask[..., None], jnp.zeros((), out.dtype), out)
+        return out
+    return call_op(f, (x, weight), {}, op_name="embedding")
+
+
+def one_hot(x, num_classes: int, name=None):
+    x = ensure_tensor(x)
+    return call_op(
+        lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes,
+                                 dtype=dtypes.default_float().numpy_dtype),
+        (x,), {}, op_name="one_hot")

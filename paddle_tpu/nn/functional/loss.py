@@ -1,0 +1,450 @@
+"""paddle.nn.functional losses (ref: python/paddle/nn/functional/loss.py).
+
+cross_entropy keeps the reference's full contract: hard/soft labels,
+ignore_index, class weights, reduction modes, use_softmax toggle, label
+smoothing.  Log-softmax-based formulation is numerically safe in bf16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False,
+                  axis: int = -1, use_softmax: bool = True,
+                  label_smoothing: float = 0.0, name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    args = [input, label]
+    has_w = weight is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+
+    def f(logits, lab, *rest):
+        ax = axis % logits.ndim
+        n_classes = logits.shape[ax]
+        logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
+                if use_softmax else jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30)))
+
+        is_soft = soft_label or label_smoothing > 0.0
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+        elif label_smoothing > 0.0:
+            li = lab
+            if li.ndim == logits.ndim and li.shape[ax] == 1:
+                li = jnp.squeeze(li, axis=ax)
+            onehot = jax.nn.one_hot(li, n_classes, axis=ax, dtype=jnp.float32)
+            soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+        if is_soft:
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if has_w and not soft_label:
+                w = rest[0].astype(jnp.float32)
+                li = lab
+                if li.ndim == logits.ndim and li.shape[ax] == 1:
+                    li = jnp.squeeze(li, axis=ax)
+                wsel = jnp.take(w, jnp.clip(li, 0, n_classes - 1))
+                loss = loss * wsel
+            return _reduce(loss, reduction).astype(logits.dtype)
+
+        li = lab
+        if li.ndim == logits.ndim and li.shape[ax] == 1:
+            li = jnp.squeeze(li, axis=ax)
+        li = li.astype(jnp.int32)
+        valid = li != ignore_index
+        safe = jnp.clip(li, 0, n_classes - 1)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, ax), axis=ax)
+        loss = -jnp.squeeze(picked, axis=ax)
+        if has_w:
+            w = rest[0].astype(jnp.float32)
+            wsel = jnp.take(w, safe)
+            loss = loss * wsel
+            wsum = jnp.sum(jnp.where(valid, wsel, 0.0))
+        else:
+            wsum = jnp.sum(valid.astype(jnp.float32))
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return (jnp.sum(loss) / jnp.maximum(wsum, 1e-12)).astype(logits.dtype)
+        if reduction == "sum":
+            return jnp.sum(loss).astype(logits.dtype)
+        return loss.astype(logits.dtype)
+    return call_op(f, tuple(args), {}, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100,
+                               numeric_stable_mode: bool = True,
+                               return_softmax: bool = False, axis: int = -1,
+                               name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # the legacy op keeps a trailing 1-dim on the loss
+    from ...tensor import manipulation
+    loss = manipulation.unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean", name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    args = [input, label]
+    has_w = weight is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+
+    def f(logp, lab, *rest):
+        n_classes = logp.shape[1]
+        li = lab.astype(jnp.int32)
+        valid = li != ignore_index
+        safe = jnp.clip(li, 0, n_classes - 1)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        if has_w:
+            wsel = jnp.take(rest[0], safe)
+            loss = loss * wsel
+            wsum = jnp.sum(jnp.where(valid, wsel, 0.0))
+        else:
+            wsum = jnp.sum(valid.astype(logp.dtype))
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(wsum, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return call_op(f, tuple(args), {}, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                   (input, label), {}, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                   (input, label), {}, op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0,
+                   name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return call_op(f, (input, label), {}, op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean",
+                         name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    args = [input, label]
+    has_w = weight is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+
+    def f(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    return call_op(f, tuple(args), {}, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction: str = "mean",
+                                     pos_weight=None, name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    args = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_pw:
+        args.append(ensure_tensor(pos_weight))
+
+    def f(x, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if has_w:
+            w = rest[i]
+            i += 1
+        if has_pw:
+            pw = rest[i]
+        # stable: max(x,0) - x*y + log(1+exp(-|x|)), with pos_weight folded in
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_w * (jnp.logaddexp(0.0, -jnp.abs(x))
+                                          + jnp.maximum(-x, 0.0))
+        else:
+            loss = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return call_op(f, tuple(args), {}, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction: str = "mean", log_target: bool = False,
+           name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = jnp.where(y > 0, y * (jnp.log(jnp.clip(y, 1e-30)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return call_op(f, (input, label), {}, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean", name=None):
+    input, other, label = (ensure_tensor(input), ensure_tensor(other),
+                           ensure_tensor(label))
+
+    def f(a, b, y):
+        loss = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+    return call_op(f, (input, other, label), {}, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(loss, reduction)
+    return call_op(f, (input, label), {}, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean", name=None):
+    input1, input2, label = (ensure_tensor(input1), ensure_tensor(input2),
+                             ensure_tensor(label))
+
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return call_op(f, (input1, input2, label), {},
+                   op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6,
+                        swap: bool = False, reduction: str = "mean", name=None):
+    input, positive, negative = (ensure_tensor(input), ensure_tensor(positive),
+                                 ensure_tensor(negative))
+
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+    def f(a, pos, neg):
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+        return _reduce(loss, reduction)
+    return call_op(f, (input, positive, negative), {},
+                   op_name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    args = [logit, label]
+    has_n = normalizer is not None
+    if has_n:
+        args.append(ensure_tensor(normalizer))
+
+    def f(x, y, *rest):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if has_n:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    return call_op(f, tuple(args), {}, op_name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return call_op(lambda a, b: jnp.square(a - b), (input, label), {},
+                   op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+    return call_op(f, (input, label), {}, op_name="log_loss")
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(p, y):
+        n_classes = p.shape[-1]
+        y1 = jnp.squeeze(y, axis=-1) if y.shape[-1] == 1 else y
+        onehot = jax.nn.one_hot(y1, n_classes, dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * onehot, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(onehot, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return call_op(f, (input, label), {}, op_name="dice_loss")
+
+
+def poisson_nll_loss(input, label, log_input: bool = True, full: bool = False,
+                     epsilon: float = 1e-8, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * np.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return call_op(f, (input, label), {}, op_name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean",
+                      name=None):
+    input, label, variance = (ensure_tensor(input), ensure_tensor(label),
+                              ensure_tensor(variance))
+
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce(loss, reduction)
+    return call_op(f, (input, label, variance), {}, op_name="gaussian_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    args = [input, label]
+    has_w = weight is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+
+    def f(x, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if has_w:
+            loss = loss * rest[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+    return call_op(f, tuple(args), {}, op_name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return call_op(f, (input, label), {}, op_name="soft_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False, name=None):
+    """CTC forward alpha recursion via lax.scan over time (ref: warpctc).
+    log_probs: [T, B, C] (paddle's `logits` convention with time major);
+    labels: [B, S] padded int labels."""
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label seq: blank, l1, blank, l2, ... blank → length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+        neg_inf = jnp.float32(-1e30)
+
+        # can-skip mask: alpha[s] may come from s-2 when ext[s]!=blank and
+        # ext[s]!=ext[s-2]
+        ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :-2]
+        can_skip = (ext != blank) & (ext != ext_prev2)
+
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(S > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                              constant_values=neg_inf)[:, :-1]
+            a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                              constant_values=neg_inf)[:, :-2]
+            a = jnp.logaddexp(alpha, a_prev1)
+            a = jnp.where(can_skip, jnp.logaddexp(a, a_prev2), a)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return a + emit, None
+
+        def masked_step(carry, inp):
+            alpha, t = carry
+            lp_t = inp
+            new_alpha, _ = step(alpha, lp_t)
+            # freeze once past this sample's input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return (new_alpha, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.int32(1)),
+                                     lp[1:])
+        idx_last = jnp.maximum(ext_len - 1, 0)
+        idx_prev = jnp.maximum(ext_len - 2, 0)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0])
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return call_op(f, (log_probs, labels, input_lengths, label_lengths), {},
+                   op_name="ctc_loss")

@@ -1,0 +1,240 @@
+"""paddle.nn.functional normalization (ref: python/paddle/nn/functional/norm.py).
+
+batch_norm keeps the running-stat mutation contract of the reference (the
+running mean/var Tensors passed in are updated in place during training).
+rms_norm matches the reference's fused incubate kernel semantics — on TPU
+XLA fuses the whole thing, so it is written as plain jnp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...core.autograd_state import no_grad
+from ...tensor._helpers import ensure_tensor
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_format: str = "NCHW",
+               use_global_stats=None, name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    use_batch_stats = training and not (use_global_stats is True)
+
+    if use_batch_stats:
+        # compute batch stats once (shared by normalization and the running
+        # update); mean/var as stop-gradient side outputs for the update
+        def stats(v):
+            m = jnp.mean(v, axis=red_axes)
+            var = jnp.var(v, axis=red_axes)
+            return m, var
+        mean_t, var_t = call_op(stats, (x,), {}, multi_out=True,
+                                op_name="bn_stats")
+        with no_grad():
+            if running_mean is not None:
+                running_mean.set_value(
+                    momentum * running_mean._data
+                    + (1 - momentum) * mean_t._data.astype(running_mean._data.dtype))
+            if running_var is not None:
+                n = int(np.prod([x.shape[a] for a in red_axes]))
+                unbiased = var_t._data * (n / max(n - 1, 1))
+                running_var.set_value(
+                    momentum * running_var._data
+                    + (1 - momentum) * unbiased.astype(running_var._data.dtype))
+        mean_src, var_src = mean_t, var_t
+    else:
+        mean_src, var_src = ensure_tensor(running_mean), ensure_tensor(running_var)
+
+    args = [x, mean_src, var_src]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def f(v, m, var, *rest):
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon).astype(v.dtype)
+        out = (v - m.reshape(shape).astype(v.dtype)) * inv.reshape(shape)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape)
+        return out
+    return call_op(f, tuple(args), {}, op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None,
+               epsilon: float = 1e-5, name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_norm, x.ndim))
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def f(v, *rest):
+        # fp32 statistics regardless of input dtype (bf16-safe, matches the
+        # reference's float accumulation)
+        v32 = v.astype(jnp.float32)
+        m = jnp.mean(v32, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v32 - m), axis=axes, keepdims=True)
+        out = ((v32 - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * rest[i]
+            i += 1
+        if has_b:
+            out = out + rest[i]
+        return out
+    return call_op(f, tuple(args), {}, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, bias=None, epsilon: float = 1e-6, axis: int = -1,
+             name=None):
+    """ref: paddle.incubate.nn.functional.fused_rms_norm — XLA fuses this on
+    TPU so no custom kernel is needed; fp32 accumulation preserved."""
+    x = ensure_tensor(x)
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def f(v, *rest):
+        v32 = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(v32), axis=axis, keepdims=True)
+        out = (v32 * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * rest[i]
+            i += 1
+        if has_b:
+            out = out + rest[i]
+        return out
+    return call_op(f, tuple(args), {}, op_name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats: bool = True,
+                  momentum: float = 0.9, eps: float = 1e-5,
+                  data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    ch_axis = x.ndim - 1 if channel_last else 1
+    red_axes = tuple(i for i in range(2, x.ndim)) if not channel_last else \
+        tuple(i for i in range(1, x.ndim - 1))
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def f(v, *rest):
+        v32 = v.astype(jnp.float32)
+        m = jnp.mean(v32, axis=red_axes, keepdims=True)
+        var = jnp.var(v32, axis=red_axes, keepdims=True)
+        out = ((v32 - m) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape)
+        return out
+    return call_op(f, tuple(args), {}, op_name="instance_norm")
+
+
+def group_norm(x, num_groups: int, epsilon: float = 1e-5, weight=None,
+               bias=None, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def f(v, *rest):
+        if channel_last:
+            v_c = jnp.moveaxis(v, -1, 1)
+        else:
+            v_c = v
+        n, c = v_c.shape[:2]
+        spatial = v_c.shape[2:]
+        g = v_c.reshape((n, num_groups, c // num_groups) + spatial).astype(jnp.float32)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(v_c.shape).astype(v.dtype)
+        shape = [1, c] + [1] * len(spatial)
+        i = 0
+        if has_w:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + rest[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return call_op(f, tuple(args), {}, op_name="group_norm")
+
+
+def local_response_norm(x, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                        k: float = 1.0, data_format: str = "NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    ch_axis = x.ndim - 1 if channel_last else 1
+
+    def f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        pad_widths = [(0, 0)] * v.ndim
+        pad_widths[ch_axis] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pad_widths)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + v.shape[ch_axis],
+                                             axis=ch_axis)
+        div = jnp.power(k + alpha * acc, beta)
+        return v / div
+    return call_op(f, (x,), {}, op_name="local_response_norm")
+
+
+def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        if p == 2:
+            nrm = jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=True))
+        else:
+            nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+    return call_op(f, (x,), {}, op_name="normalize")

@@ -1,0 +1,327 @@
+"""paddle.nn.functional pooling (ref: python/paddle/nn/functional/pooling.py).
+
+Pooling lowers to lax.reduce_window — XLA's windowed reduction maps to the
+TPU vector unit directly; no cuDNN pooling descriptors to model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t * n if len(t) == 1 else t
+
+
+def _pool_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if all(isinstance(p, (int, np.integer)) for p in padding):
+        if len(padding) == n:
+            return [(int(p), int(p)) for p in padding]
+        if len(padding) == 2 * n:
+            return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                    for i in range(n)]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _window_dims(n, channel_last, kernel, strides):
+    if channel_last:
+        wd = (1,) + kernel + (1,)
+        ws = (1,) + strides + (1,)
+    else:
+        wd = (1, 1) + kernel
+        ws = (1, 1) + strides
+    return wd, ws
+
+
+def _full_pad(pad, n, channel_last):
+    if isinstance(pad, str):
+        return pad
+    if channel_last:
+        return [(0, 0)] + list(pad) + [(0, 0)]
+    return [(0, 0), (0, 0)] + list(pad)
+
+
+def _max_pool(x, kernel_size, stride, padding, ceil_mode, n, data_format,
+              op_name):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    kernel = _tuple(kernel_size, n)
+    strides = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _pool_pad(padding, n)
+    wd, ws = _window_dims(n, channel_last, kernel, strides)
+
+    def f(v):
+        p = pad
+        if not isinstance(p, str) and ceil_mode:
+            p = []
+            spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+            for i in range(n):
+                lo, hi = pad[i]
+                size = spatial[i] + lo + hi
+                rem = (size - kernel[i]) % strides[i]
+                extra = (strides[i] - rem) % strides[i] if rem else 0
+                p.append((lo, hi + extra))
+        fp = _full_pad(p, n, channel_last)
+        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                          else jnp.iinfo(v.dtype).min, v.dtype)
+        return jax.lax.reduce_window(v, neg, jax.lax.max, wd, ws, fp)
+    return call_op(f, (x,), {}, op_name=op_name)
+
+
+def _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, n,
+              data_format, op_name, divisor_override=None):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    kernel = _tuple(kernel_size, n)
+    strides = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _pool_pad(padding, n)
+    wd, ws = _window_dims(n, channel_last, kernel, strides)
+
+    def f(v):
+        p = pad
+        if not isinstance(p, str) and ceil_mode:
+            p2 = []
+            spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+            for i in range(n):
+                lo, hi = pad[i]
+                size = spatial[i] + lo + hi
+                rem = (size - kernel[i]) % strides[i]
+                extra = (strides[i] - rem) % strides[i] if rem else 0
+                p2.append((lo, hi + extra))
+            p = p2
+        fp = _full_pad(p, n, channel_last)
+        s = jax.lax.reduce_window(v, jnp.zeros((), v.dtype), jax.lax.add,
+                                  wd, ws, fp)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive and not isinstance(p, str):
+            ones = jnp.ones_like(v)
+            cnt = jax.lax.reduce_window(ones, jnp.zeros((), v.dtype),
+                                        jax.lax.add, wd, ws, fp)
+            return s / cnt
+        return s / float(np.prod(kernel))
+    return call_op(f, (x,), {}, op_name=op_name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    out = _max_pool(x, kernel_size, stride, padding, ceil_mode, 1, df,
+                    "max_pool1d")
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, df)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _max_pool(x, kernel_size, stride, padding, ceil_mode, 2,
+                    data_format, "max_pool2d")
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2,
+                               data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _max_pool(x, kernel_size, stride, padding, ceil_mode, 3,
+                    data_format, "max_pool3d")
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3,
+                               data_format)
+    return out
+
+
+def _pool_mask(x, out, kernel_size, stride, padding, n, data_format):
+    """Argmax indices for return_mask=True (flattened spatial index, like
+    the reference)."""
+    channel_last = data_format[-1] == "C"
+    kernel = _tuple(kernel_size, n)
+    strides = _tuple(stride if stride is not None else kernel_size, n)
+    pad = _pool_pad(padding, n)
+
+    def f(v):
+        spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+        flat = np.prod(spatial)
+        idx = jnp.arange(flat, dtype=jnp.int32).reshape(spatial)
+        bshape = (1,) + spatial + (1,) if channel_last else (1, 1) + spatial
+        idx = jnp.broadcast_to(idx.reshape(bshape), v.shape)
+        wd, ws = _window_dims(n, channel_last, kernel, strides)
+        fp = _full_pad(pad if not isinstance(pad, str) else pad, n, channel_last)
+        neg = jnp.asarray(-jnp.inf, v.dtype)
+
+        def reducer(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take = cv > av
+            return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+        vals, idxs = jax.lax.reduce_window(
+            (v, idx), (neg, jnp.asarray(-1, jnp.int32)), reducer, wd, ws, fp)
+        return idxs
+    return call_op(f, (ensure_tensor(x),), {}, op_name="pool_mask")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, 1,
+                     df, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, 2,
+                     data_format, "avg_pool2d", divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, 3,
+                     data_format, "avg_pool3d", divisor_override)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+    xp = call_op(lambda v: jnp.abs(v) ** p, (x,), {}, op_name="lp_pow")
+    s = _avg_pool(xp, kernel_size, stride, padding, ceil_mode, False, 1,
+                  "NWC" if data_format == "NLC" else "NCW", "lp_pool1d")
+    k = _tuple(kernel_size, 1)
+    return call_op(lambda v: (v * float(np.prod(k))) ** (1.0 / p), (s,), {},
+                   op_name="lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    p = float(norm_type)
+    xp = call_op(lambda v: jnp.abs(v) ** p, (x,), {}, op_name="lp_pow")
+    s = _avg_pool(xp, kernel_size, stride, padding, ceil_mode, False, 2,
+                  data_format, "lp_pool2d")
+    k = _tuple(kernel_size, 2)
+    return call_op(lambda v: (v * float(np.prod(k))) ** (1.0 / p), (s,), {},
+                   op_name="lp_root")
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling — static output size, so emit per-output-window slices
+# (shapes static under jit, XLA folds them)
+# ---------------------------------------------------------------------------
+
+def _adaptive_windows(in_size, out_size):
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, reduce_fn, op_name):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    out_sizes = _tuple(output_size, n)
+    spatial_axes = (list(range(1, 1 + n)) if channel_last
+                    else list(range(2, 2 + n)))
+
+    def f(v):
+        ret = v
+        for dim, ax in enumerate(spatial_axes):
+            in_size = ret.shape[ax]
+            osz = out_sizes[dim]
+            if osz is None:
+                continue
+            if in_size % osz == 0:
+                # uniform windows → reshape + reduce (fast path)
+                k = in_size // osz
+                new_shape = ret.shape[:ax] + (osz, k) + ret.shape[ax + 1:]
+                ret = reduce_fn(ret.reshape(new_shape), axis=ax + 1)
+            else:
+                starts, ends = _adaptive_windows(in_size, osz)
+                slices = [reduce_fn(jax.lax.slice_in_dim(ret, s, e, axis=ax),
+                                    axis=ax, keepdims=True)
+                          for s, e in zip(starts, ends)]
+                ret = jnp.concatenate(slices, axis=ax)
+        return ret
+    return call_op(f, (x,), {}, op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", jnp.mean,
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, jnp.mean,
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, jnp.mean,
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", jnp.max,
+                         "adaptive_max_pool1d")
+    if return_mask:
+        return out, _adaptive_mask(x, output_size, 1, "NCW")
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", jnp.max,
+                         "adaptive_max_pool2d")
+    if return_mask:
+        return out, _adaptive_mask(x, output_size, 2, "NCHW")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", jnp.max,
+                         "adaptive_max_pool3d")
+    if return_mask:
+        return out, _adaptive_mask(x, output_size, 3, "NCDHW")
+    return out
+
+
+def _adaptive_mask(x, output_size, n, data_format):
+    x = ensure_tensor(x)
+    out_sizes = _tuple(output_size, n)
+
+    def f(v):
+        spatial = v.shape[2:]
+        flat = int(np.prod(spatial))
+        idx = jnp.arange(flat, dtype=jnp.int32).reshape(spatial)
+        idx = jnp.broadcast_to(idx.reshape((1, 1) + spatial), v.shape)
+        ret_v, ret_i = v, idx
+        for dim in range(n):
+            ax = 2 + dim
+            in_size = ret_v.shape[ax]
+            osz = out_sizes[dim]
+            starts, ends = _adaptive_windows(in_size, osz)
+            vs, is_ = [], []
+            for s, e in zip(starts, ends):
+                sv = jax.lax.slice_in_dim(ret_v, s, e, axis=ax)
+                si = jax.lax.slice_in_dim(ret_i, s, e, axis=ax)
+                am = jnp.argmax(sv, axis=ax, keepdims=True)
+                vs.append(jnp.take_along_axis(sv, am, axis=ax))
+                is_.append(jnp.take_along_axis(si, am, axis=ax))
+            ret_v = jnp.concatenate(vs, axis=ax)
+            ret_i = jnp.concatenate(is_, axis=ax)
+        return ret_i
+    return call_op(f, (x,), {}, op_name="adaptive_mask")

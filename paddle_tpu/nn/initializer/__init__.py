@@ -1,0 +1,267 @@
+"""paddle.nn.initializer — parameter initializers.
+
+TPU-native re-design of the reference's initializer ops
+(ref: python/paddle/nn/initializer/ — Constant/Normal/Xavier/Kaiming...;
+implemented there as fill ops run inside a startup program).  Here an
+initializer is a pure function ``(shape, dtype, key) -> jnp array`` drawn
+from the framework's stateful jax PRNG, applied at Parameter creation —
+no startup program needed since there is no static graph to seed.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import dtype as dtypes
+from ...core.tensor import Tensor
+from ...random_state import default_generator
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """paddle.nn.initializer.calculate_gain"""
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "conv1d_transpose": 1.0,
+        "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fan_in_out(shape: Sequence[int]):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # matches the reference convention: weight stored [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    """Base initializer. Subclasses implement ``_generate(shape, jdt, key)``."""
+
+    def _generate(self, shape, jdt, key):
+        raise NotImplementedError
+
+    def __call__(self, shape, dtype=None, block=None):
+        """Produce a jnp array for the given shape/dtype."""
+        jdt = dtypes.to_jax(dtype) if dtype is not None else dtypes.default_float().numpy_dtype
+        needs_key = self._needs_key()
+        key = default_generator.next_key() if needs_key else None
+        # random draws happen in float32 then cast — bf16 param init must not
+        # quantize the sampling distribution itself
+        return self._generate(tuple(int(s) for s in shape), jdt, key)
+
+    def _needs_key(self) -> bool:
+        return True
+
+    def apply_(self, t: Tensor):
+        """Re-initialize an existing tensor in place."""
+        t._data = self(t.shape, t.dtype)
+        return t
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def _needs_key(self):
+        return False
+
+    def _generate(self, shape, jdt, key):
+        return jnp.full(shape, self.value, dtype=jdt)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, jdt, key):
+        x = jax.random.normal(key, shape, dtype=jnp.float32) * self.std + self.mean
+        return x.astype(jdt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _generate(self, shape, jdt, key):
+        lo = (self.a - self.mean) / self.std
+        hi = (self.b - self.mean) / self.std
+        x = jax.random.truncated_normal(key, lo, hi, shape, dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(jdt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, name=None):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, jdt, key):
+        x = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=self.low, maxval=self.high)
+        return x.astype(jdt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, jdt, key):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        x = jax.random.normal(key, shape, dtype=jnp.float32) * std
+        return x.astype(jdt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, jdt, key):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        x = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(jdt)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, jdt, key):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else calculate_gain(self.nonlinearity)
+        std = gain / math.sqrt(fi)
+        x = jax.random.normal(key, shape, dtype=jnp.float32) * std
+        return x.astype(jdt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, jdt, key):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else calculate_gain(self.nonlinearity)
+        limit = gain * math.sqrt(3.0 / fi)
+        x = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(jdt)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        if isinstance(value, Tensor):
+            value = np.asarray(value._data)
+        self.value = np.asarray(value)
+
+    def _needs_key(self):
+        return False
+
+    def _generate(self, shape, jdt, key):
+        v = jnp.asarray(self.value, dtype=jdt)
+        if tuple(v.shape) != tuple(shape):
+            v = v.reshape(shape)
+        return v
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def _generate(self, shape, jdt, key):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs >=2 dims")
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(key, (max(rows, cols), min(rows, cols)),
+                                 dtype=jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(jdt)
+
+
+class Dirac(Initializer):
+    """Identity-preserving init for conv weights (ref: initializer/dirac.py)."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def _needs_key(self):
+        return False
+
+    def _generate(self, shape, jdt, key):
+        if len(shape) not in (3, 4, 5):
+            raise ValueError("Dirac initializer needs 3/4/5-D conv weight")
+        out_c, in_c = shape[0], shape[1]
+        arr = np.zeros(shape, dtype=np.float32)
+        min_dim = min(out_c // self.groups, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for d in range(min_dim):
+                idx = (g * (out_c // self.groups) + d, d) + tuple(centers)
+                arr[idx] = 1.0
+        return jnp.asarray(arr, dtype=jdt)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """paddle.nn.initializer.set_global_initializer"""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+_global_weight_init: Optional[Initializer] = None
+_global_bias_init: Optional[Initializer] = None
+
+
+def _default_weight_init() -> Initializer:
+    return _global_weight_init if _global_weight_init is not None else XavierNormal()
+
+
+def _default_bias_init() -> Initializer:
+    return _global_bias_init if _global_bias_init is not None else Constant(0.0)
+
+
+# lowercase aliases exposed by the reference
+constant = Constant
+normal = Normal
+uniform = Uniform

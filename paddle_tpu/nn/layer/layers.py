@@ -1,0 +1,410 @@
+"""The Layer system (ref: python/paddle/nn/layer/layers.py — ~3k lines).
+
+TPU-native notes: parameters are eager Tensors over jax.Arrays; ``to()``
+moves via device_put; state_dict values are the live Parameter objects
+(saved as numpy by paddle.save).  The pytree of (parameters, buffers) is
+what the jit functionalizer lifts into traced-function inputs.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import dtype as dtypes
+from ...core.tensor import Tensor, Parameter
+from ...framework.param_attr import ParamAttr
+from ..initializer import (Initializer, Constant, _default_weight_init,
+                           _default_bias_init)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+_layer_name_counters: Dict[str, int] = {}
+
+
+def _unique_layer_name(prefix: str) -> str:
+    n = _layer_name_counters.get(prefix, 0)
+    _layer_name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    """Base class for all neural network layers."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._full_name = _unique_layer_name(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self._parameters: "collections.OrderedDict[str, Optional[Parameter]]" = \
+            collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Optional[Layer]]" = \
+            collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, Optional[Tensor]]" = \
+            collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks: "collections.OrderedDict[int, Callable]" = \
+            collections.OrderedDict()
+        self._forward_post_hooks: "collections.OrderedDict[int, Callable]" = \
+            collections.OrderedDict()
+        self._hook_id = 0
+        self._casted_by_pure_fp16 = False
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """ref: layers.py create_parameter — honors ParamAttr/initializer
+        conventions (None→default, False→no param)."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            return None
+        dtype = dtype or self._dtype or dtypes.get_default_dtype()
+        init = attr.initializer or default_initializer or (
+            _default_bias_init() if is_bias else _default_weight_init())
+        if not isinstance(init, Initializer):
+            raise TypeError("initializer must be a paddle.nn.initializer type")
+        value = init(shape, dtype)
+        p = Parameter(value, name=attr.name or "", trainable=attr.trainable)
+        p._paddle_attrs = attr
+        if not attr.trainable:
+            p.stop_gradient = True
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        dtype = dtype or self._dtype or dtypes.get_default_dtype()
+        t = Tensor(jnp.zeros((), dtypes.to_jax(dtype)), name=name or "")
+        t.persistable = persistable
+        return t
+
+    create_variable = create_tensor
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Tensor):
+            raise TypeError("parameter must be a Tensor/Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: Optional["Layer"]):
+        if sublayer is not None and not isinstance(sublayer, Layer):
+            raise TypeError("sublayer must be a Layer")
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if persistable:
+            self._non_persistable_buffer_names_set.discard(name)
+        else:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    # ------------------------------------------------------------------
+    # attribute magic
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter) or (isinstance(value, Tensor)
+                                            and getattr(value, "_is_param", False)):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                # assigning a raw array to an existing buffer updates its value
+                buffers[name] = Tensor(value)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                    return
+                raise TypeError(
+                    f"cannot assign non-parameter to parameter slot {name!r}")
+            if layers is not None and name in layers and value is None:
+                layers[name] = None
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                self._non_persistable_buffer_names_set.discard(name)
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None:
+                continue
+            p = prefix + ("." if prefix else "") + name
+            yield from l.named_sublayers(prefix=p, include_self=True,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "",
+                         include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        gen = (self.named_sublayers(prefix=prefix, include_self=True)
+               if include_sublayers else [(prefix, self)])
+        for lp, layer in gen:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield lp + ("." if lp else "") + name, p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        gen = (self.named_sublayers(prefix=prefix, include_self=True)
+               if include_sublayers else [(prefix, self)])
+        for lp, layer in gen:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield lp + ("." if lp else "") + name, b
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # ------------------------------------------------------------------
+    # hooks
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        gen = (self.named_sublayers(prefix=structured_name_prefix.rstrip("."),
+                                    include_self=True)
+               if include_sublayers else [(structured_name_prefix.rstrip("."), self)])
+        for lp, layer in gen:
+            for name, b in layer._buffers.items():
+                if b is None or name in layer._non_persistable_buffer_names_set:
+                    continue
+                dest[lp + ("." if lp else "") + name] = b
+        return dest
+
+    to_static_state_dict = state_dict
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Returns (missing_keys, unexpected_keys) like the reference."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            t = own[k]
+            if isinstance(v, Tensor):
+                v = v._data
+            v = jnp.asarray(np.asarray(v), dtype=t._data.dtype)
+            if tuple(v.shape) != tuple(t._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: loaded {tuple(v.shape)} vs "
+                    f"expected {tuple(t._data.shape)}")
+            t._data = v
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # dtype / device movement
+    # ------------------------------------------------------------------
+    def _transform(self, fn):
+        for _, p in self.named_parameters():
+            p._data = fn(p._data)
+        for _, b in self.named_buffers():
+            b._data = fn(b._data)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            jdt = dtypes.to_jax(dtype)
+            self._transform(
+                lambda a: a.astype(jdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a)
+            self._dtype = dtypes.convert_dtype(jdt).name
+        if device is not None:
+            from ...device import _parse, jax_device
+            place = device if not isinstance(device, str) else _parse(device)
+            dev = jax_device(place)
+            self._transform(lambda a: jax.device_put(a, dev))
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self, excluded_layers=None):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def float16(self, excluded_layers=None):
+        return self.to(dtype="float16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ------------------------------------------------------------------
+    # repr
+    # ------------------------------------------------------------------
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            body = repr(l).split("\n")
+            body = [body[0]] + ["  " + b for b in body[1:]]
+            lines.append(f"  ({name}): " + "\n".join(body))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"

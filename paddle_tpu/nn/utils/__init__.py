@@ -1,0 +1,127 @@
+"""paddle.nn.utils (ref: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._data = data[offset:offset + n].reshape(p._data.shape).astype(
+            p._data.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize ``layer.weight`` as g * v/|v| recomputed each forward
+    (ref: nn/utils/weight_norm_hook.py)."""
+    import jax
+    from ...core.dispatch import call_op
+    from ...core.tensor import Parameter
+
+    w = getattr(layer, name)
+    wd = w._data
+    if dim is None:
+        norm = jnp.linalg.norm(wd)
+    else:
+        axes = tuple(i for i in range(wd.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(wd), axis=axes))
+    g = Parameter(norm)
+    v = Parameter(wd)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        def f(gv, vv):
+            if dim is None:
+                return gv * vv / jnp.linalg.norm(vv)
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            n = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return gv.reshape(shape) * vv / n
+        w_new = call_op(f, (lyr._parameters[name + "_g"],
+                            lyr._parameters[name + "_v"]), {},
+                        op_name="weight_norm")
+        object.__setattr__(lyr, "_wn_cached", w_new)
+        lyr._buffers[name] = w_new
+        return None
+
+    layer.register_buffer(name, Tensor(wd), persistable=False)
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ...core.tensor import Parameter
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    if hasattr(layer, "_wn_hook"):
+        layer._wn_hook.remove()
+    axes_w = v._data
+    if name in layer._buffers:
+        del layer._buffers[name]
+    import jax.numpy as jnp
+    # recompute the effective weight once and store as a plain parameter
+    dim0_norm = jnp.sqrt(jnp.sum(jnp.square(axes_w),
+                                 axis=tuple(range(1, axes_w.ndim)),
+                                 keepdims=True))
+    shape = [1] * axes_w.ndim
+    shape[0] = -1
+    w = g._data.reshape(shape) * axes_w / dim0_norm
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """ref: nn/utils/spectral_norm_hook.py — power-iteration reparam."""
+    import jax
+    from ...core.dispatch import call_op
+    from ...core.tensor import Parameter
+    from ...random_state import next_key
+
+    w = getattr(layer, name)
+    wd = w._data
+    if dim is None:
+        dim = 0
+    wm = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+    h, wcols = wm.shape
+    u0 = jax.random.normal(next_key(), (h,))
+    u0 = u0 / (jnp.linalg.norm(u0) + eps)
+    v = Parameter(wd)
+    layer.add_parameter(name + "_orig", v)
+    del layer._parameters[name]
+    layer.register_buffer(name + "_u", Tensor(u0), persistable=True)
+    layer.register_buffer(name, Tensor(wd), persistable=False)
+
+    def hook(lyr, inputs):
+        def f(vv):
+            m = jnp.moveaxis(vv, dim, 0).reshape(vv.shape[dim], -1)
+            u = lyr._buffers[name + "_u"]._data
+            for _ in range(n_power_iterations):
+                vvec = m.T @ u
+                vvec = vvec / (jnp.linalg.norm(vvec) + eps)
+                u = m @ vvec
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ (m @ vvec)
+            return vv / sigma
+        w_new = call_op(f, (lyr._parameters[name + "_orig"],), {},
+                        op_name="spectral_norm")
+        lyr._buffers[name] = w_new
+        return None
+
+    layer._sn_hook = layer.register_forward_pre_hook(hook)
+    return layer
