@@ -44,7 +44,7 @@ class _GradCtx:
             raise TypeError("no_grad/enable_grad used as decorator needs a callable")
         @functools.wraps(func)
         def wrapper(*args, **kwargs):
-            with type(self)(self._mode):
+            with _GradCtx(self._mode):
                 return func(*args, **kwargs)
         return wrapper
 

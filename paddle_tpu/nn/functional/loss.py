@@ -41,23 +41,38 @@ def cross_entropy(input, label, weight=None, ignore_index: int = -100,
                 if use_softmax else jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30)))
 
         is_soft = soft_label or label_smoothing > 0.0
+        valid = None
         if soft_label:
             soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
         elif label_smoothing > 0.0:
             li = lab
             if li.ndim == logits.ndim and li.shape[ax] == 1:
                 li = jnp.squeeze(li, axis=ax)
-            onehot = jax.nn.one_hot(li, n_classes, axis=ax, dtype=jnp.float32)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            onehot = jax.nn.one_hot(jnp.clip(li, 0, n_classes - 1), n_classes,
+                                    axis=ax, dtype=jnp.float32)
             soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes
         if is_soft:
             loss = -jnp.sum(soft * logp, axis=ax)
-            if has_w and not soft_label:
+            if has_w:
+                # per-position weight = sum_c w_c * soft_c (reduces to w[label]
+                # for one-hot labels, generalizes for soft labels)
                 w = rest[0].astype(jnp.float32)
-                li = lab
-                if li.ndim == logits.ndim and li.shape[ax] == 1:
-                    li = jnp.squeeze(li, axis=ax)
-                wsel = jnp.take(w, jnp.clip(li, 0, n_classes - 1))
+                wshape = [1] * logits.ndim
+                wshape[ax] = n_classes
+                wsel = jnp.sum(soft * w.reshape(wshape), axis=ax)
                 loss = loss * wsel
+            else:
+                wsel = jnp.ones_like(loss)
+            if valid is not None:
+                loss = jnp.where(valid, loss, 0.0)
+                wsel = jnp.where(valid, wsel, 0.0)
+            if reduction == "mean":
+                return (jnp.sum(loss)
+                        / jnp.maximum(jnp.sum(wsel), 1e-12)).astype(logits.dtype)
             return _reduce(loss, reduction).astype(logits.dtype)
 
         li = lab

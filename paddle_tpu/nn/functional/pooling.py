@@ -122,7 +122,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _max_pool(x, kernel_size, stride, padding, ceil_mode, 1, df,
                     "max_pool1d")
     if return_mask:
-        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, df)
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, df,
+                               ceil_mode)
     return out
 
 
@@ -132,7 +133,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                     data_format, "max_pool2d")
     if return_mask:
         return out, _pool_mask(x, out, kernel_size, stride, padding, 2,
-                               data_format)
+                               data_format, ceil_mode)
     return out
 
 
@@ -142,11 +143,12 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                     data_format, "max_pool3d")
     if return_mask:
         return out, _pool_mask(x, out, kernel_size, stride, padding, 3,
-                               data_format)
+                               data_format, ceil_mode)
     return out
 
 
-def _pool_mask(x, out, kernel_size, stride, padding, n, data_format):
+def _pool_mask(x, out, kernel_size, stride, padding, n, data_format,
+               ceil_mode=False):
     """Argmax indices for return_mask=True (flattened spatial index, like
     the reference)."""
     channel_last = data_format[-1] == "C"
@@ -161,7 +163,16 @@ def _pool_mask(x, out, kernel_size, stride, padding, n, data_format):
         bshape = (1,) + spatial + (1,) if channel_last else (1, 1) + spatial
         idx = jnp.broadcast_to(idx.reshape(bshape), v.shape)
         wd, ws = _window_dims(n, channel_last, kernel, strides)
-        fp = _full_pad(pad if not isinstance(pad, str) else pad, n, channel_last)
+        p = pad
+        if not isinstance(p, str) and ceil_mode:
+            p = []
+            for i in range(n):
+                lo, hi = pad[i]
+                size = spatial[i] + lo + hi
+                rem = (size - kernel[i]) % strides[i]
+                extra = (strides[i] - rem) % strides[i] if rem else 0
+                p.append((lo, hi + extra))
+        fp = _full_pad(p, n, channel_last)
         neg = jnp.asarray(-jnp.inf, v.dtype)
 
         def reducer(acc, cur):

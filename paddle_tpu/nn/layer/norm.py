@@ -1,6 +1,7 @@
 """Normalization layers (ref: python/paddle/nn/layer/norm.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -261,16 +262,23 @@ class SpectralNorm(Layer):
     def forward(self, weight):
         from ...core.dispatch import call_op
         dim, iters, eps = self._dim, self._power_iters, self._eps
+        # power iteration advances the persistent u/v estimate (no grad);
+        # sigma itself is computed on-tape so grads flow through the weight
+        wd = weight._data
+        if not isinstance(wd, jax.core.Tracer):
+            wm_c = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+            u, v = self.weight_u._data, self.weight_v._data
+            for _ in range(iters):
+                v = wm_c.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm_c @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            self.weight_u._data = u
+            self.weight_v._data = v
         u0, v0 = self.weight_u._data, self.weight_v._data
 
         def f(w):
             wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
-            u, v = u0, v0
-            for _ in range(iters):
-                v = wm.T @ u
-                v = v / (jnp.linalg.norm(v) + eps)
-                u = wm @ v
-                u = u / (jnp.linalg.norm(u) + eps)
-            sigma = u @ (wm @ v)
+            sigma = u0 @ (wm @ v0)
             return w / sigma
         return call_op(f, (weight,), {}, op_name="spectral_norm")

@@ -162,29 +162,63 @@ class GRUCell(RNNCellBase):
         return (self.hidden_size,)
 
 
-def _scan_layer(cell_kind, x, init_states, weights, time_major, reverse):
-    """One recurrent layer as a single lax.scan op over the tape."""
+def _scan_layer(cell_kind, x, init_states, weights, time_major, reverse,
+                seq_len=None):
+    """One recurrent layer as a single lax.scan op over the tape.
+
+    With ``seq_len`` (shape [B]) padded steps are masked: states freeze at
+    each sequence's true end, padded outputs are zero, and the reverse
+    direction runs over each sequence's valid region only (per-batch
+    involutive time reindexing, so no ragged shapes enter the scan)."""
     n_w = len(weights)
+    has_len = seq_len is not None
 
     def f(xv, *rest):
+        if has_len:
+            sl = rest[0].astype(jnp.int32)
+            rest = rest[1:]
         states = rest[:len(rest) - n_w]
         ws = rest[len(rest) - n_w:]
         wi, wh, bi, bh = ws
         xs = xv if time_major else jnp.swapaxes(xv, 0, 1)  # [T, B, I]
+        T, B = xs.shape[0], xs.shape[1]
+
+        def reindex(a):
+            # idx[t,b] = L_b-1-t for t < L_b else t : reverses the valid
+            # region per batch, identity on padding; involutive
+            t_idx = jnp.arange(T)[:, None]
+            idx = jnp.where(t_idx < sl[None, :], sl[None, :] - 1 - t_idx,
+                            t_idx)
+            return jnp.take_along_axis(a, idx[..., None], axis=0)
+
         if reverse:
-            xs = jnp.flip(xs, 0)
+            xs = reindex(xs) if has_len else jnp.flip(xs, 0)
+
+        if has_len:
+            xs_in = (xs, jnp.arange(T))
+        else:
+            xs_in = xs
+
+        def mask_step(t, new, old):
+            if not has_len:
+                return new
+            keep = (t < sl)[:, None]
+            return jnp.where(keep, new, old)
 
         if cell_kind == "lstm":
-            def step(carry, xt):
+            def step(carry, inp):
+                xt, t = inp if has_len else (inp, None)
                 h, c = carry
                 gates = xt @ wi.T + bi + h @ wh.T + bh
                 i, fg, g, o = jnp.split(gates, 4, axis=-1)
                 c_new = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
                 h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
-                return (h_new, c_new), h_new
-            carry, ys = jax.lax.scan(step, tuple(states), xs)
+                h_out = mask_step(t, h_new, jnp.zeros_like(h_new))
+                return (mask_step(t, h_new, h), mask_step(t, c_new, c)), h_out
+            carry, ys = jax.lax.scan(step, tuple(states), xs_in)
         elif cell_kind == "gru":
-            def step(h, xt):
+            def step(h, inp):
+                xt, t = inp if has_len else (inp, None)
                 xg = xt @ wi.T + bi
                 hg = h @ wh.T + bh
                 xr, xz, xc = jnp.split(xg, 3, axis=-1)
@@ -193,26 +227,31 @@ def _scan_layer(cell_kind, x, init_states, weights, time_major, reverse):
                 z = jax.nn.sigmoid(xz + hz)
                 c = jnp.tanh(xc + r * hc)
                 h_new = z * h + (1 - z) * c
-                return h_new, h_new
-            carry, ys = jax.lax.scan(step, states[0], xs)
+                return (mask_step(t, h_new, h),
+                        mask_step(t, h_new, jnp.zeros_like(h_new)))
+            carry, ys = jax.lax.scan(step, states[0], xs_in)
             carry = (carry,)
         else:
             act = jnp.tanh if cell_kind == "tanh" else (
                 lambda v: jnp.maximum(v, 0))
 
-            def step(h, xt):
+            def step(h, inp):
+                xt, t = inp if has_len else (inp, None)
                 h_new = act(xt @ wi.T + bi + h @ wh.T + bh)
-                return h_new, h_new
-            carry, ys = jax.lax.scan(step, states[0], xs)
+                return (mask_step(t, h_new, h),
+                        mask_step(t, h_new, jnp.zeros_like(h_new)))
+            carry, ys = jax.lax.scan(step, states[0], xs_in)
             carry = (carry,)
         if reverse:
-            ys = jnp.flip(ys, 0)
+            ys = reindex(ys) if has_len else jnp.flip(ys, 0)
         if not time_major:
             ys = jnp.swapaxes(ys, 0, 1)
         return (ys,) + tuple(carry)
 
-    outs = call_op(f, tuple([x] + list(init_states) + list(weights)), {},
-                   multi_out=True, op_name=f"{cell_kind}_layer")
+    args = [x] + ([seq_len] if has_len else []) + list(init_states) + \
+        list(weights)
+    outs = call_op(f, tuple(args), {}, multi_out=True,
+                   op_name=f"{cell_kind}_layer")
     return outs[0], outs[1:]
 
 
@@ -232,16 +271,67 @@ class RNN(Layer):
         T = inputs.shape[time_axis]
         states = initial_states
         outputs = []
-        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        sl = sequence_length
+        if sl is not None and not isinstance(sl, Tensor):
+            sl = ensure_tensor(sl)
+
+        if sl is not None and self.is_reverse:
+            # reverse each sequence's valid region (involutive reindex),
+            # then run the forward masked loop
+            def rev(v, lens):
+                ta = time_axis
+                t_idx = jnp.arange(T)
+                shape = [1] * v.ndim
+                shape[ta] = T
+                lb = jnp.expand_dims(lens.astype(jnp.int32),
+                                     tuple(i for i in range(v.ndim) if i != 1 - ta)) \
+                    if v.ndim > 1 else lens
+                # build idx [T, B] then broadcast
+                l2 = lens.astype(jnp.int32)
+                idx = jnp.where(t_idx[:, None] < l2[None, :],
+                                l2[None, :] - 1 - t_idx[:, None],
+                                t_idx[:, None])
+                if ta == 1:
+                    idx = idx.T  # [B, T]
+                idx = idx.reshape(idx.shape + (1,) * (v.ndim - 2))
+                return jnp.take_along_axis(v, idx, axis=ta)
+            inputs = call_op(lambda v, l: rev(v, l), (inputs, sl), {},
+                             op_name="rnn_rev")
+
+        def mask_state(new_s, old_s, keep_t):
+            if old_s is None:
+                return new_s
+            if isinstance(new_s, (tuple, list)):
+                return type(new_s)(mask_state(n, o, keep_t)
+                                   for n, o in zip(new_s, old_s))
+            return call_op(
+                lambda n, o, l: jnp.where((keep_t < l.astype(jnp.int32))[:, None],
+                                          n, o),
+                (new_s, old_s, sl), {}, op_name="rnn_mask")
+
+        steps = range(T - 1, -1, -1) if (self.is_reverse and sl is None) \
+            else range(T)
         for t in steps:
             xt = call_op(
                 lambda v, tt=t: jax.lax.index_in_dim(v, tt, time_axis, False),
                 (inputs,), {}, op_name="rnn_slice")
-            out, states = self.cell(xt, states)
+            out, new_states = self.cell(xt, states)
+            if sl is not None:
+                out = call_op(
+                    lambda o, l, tt=t: jnp.where(
+                        (tt < l.astype(jnp.int32))[:, None], o,
+                        jnp.zeros((), o.dtype)),
+                    (out, sl), {}, op_name="rnn_mask_out")
+                new_states = mask_state(new_states, states, t) \
+                    if states is not None else new_states
+            states = new_states
             outputs.append(out)
-        if self.is_reverse:
+        if self.is_reverse and sl is None:
             outputs = outputs[::-1]
         outs = manipulation.stack(outputs, axis=time_axis)
+        if self.is_reverse and sl is not None:
+            outs = call_op(
+                lambda v, l: rev(v, l), (outs, sl), {}, op_name="rnn_rev")
         return outs, states
 
 
@@ -338,8 +428,11 @@ class _RNNBase(Layer):
                     states = [h0, c0_all[idx]]
                 kind = self.mode if self.mode in ("lstm", "gru") else \
                     getattr(self, "activation", "tanh")
+                sl = sequence_length
+                if sl is not None and not isinstance(sl, Tensor):
+                    sl = ensure_tensor(sl)
                 y, last = _scan_layer(kind, x, states, weights,
-                                      self.time_major, d == 1)
+                                      self.time_major, d == 1, sl)
                 outs_dir.append(y)
                 final_h.append(last[0])
                 if is_lstm:

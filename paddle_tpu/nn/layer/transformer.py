@@ -21,6 +21,16 @@ from .norm import LayerNorm
 from .container import LayerList
 
 
+def _clone_layer(layer):
+    """Build a fresh instance with independent init when the prototype
+    recorded its constructor config; fall back to deepcopy otherwise."""
+    cfg = getattr(layer, "_config", None)
+    if cfg is not None:
+        return type(layer)(**cfg)
+    import copy
+    return copy.deepcopy(layer)
+
+
 def _convert_attention_mask(attn_mask, dtype):
     if attn_mask is None:
         return None
@@ -93,6 +103,9 @@ class MultiHeadAttention(Layer):
             args.append(ensure_tensor(attn_mask))
 
         import jax
+        from ...random_state import next_key
+        drop_p = self.dropout if (self.dropout and self.training) else 0.0
+        drop_key = next_key() if drop_p else None
 
         def f(qa, ka, va, *rest):
             logits = jnp.einsum("bhsd,bhtd->bhst", qa, ka).astype(jnp.float32) * scale
@@ -103,12 +116,15 @@ class MultiHeadAttention(Layer):
                 else:
                     logits = logits + m.astype(jnp.float32)
             probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
-            return jnp.einsum("bhst,bhtd->bhsd", probs, va), probs
+            # dropout on the attention probabilities (reference semantics)
+            dropped = probs
+            if drop_p:
+                keep = 1.0 - drop_p
+                mask = jax.random.bernoulli(drop_key, keep, probs.shape)
+                dropped = jnp.where(mask, probs / keep, 0.0).astype(qa.dtype)
+            return jnp.einsum("bhst,bhtd->bhsd", dropped, va), probs
         out, weights = call_op(f, tuple(args), {}, multi_out=True,
                                op_name="attention")
-        if self.dropout:
-            out = F.dropout(out, self.dropout, training=self.training,
-                            mode="upscale_in_train")
         return out, weights
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
@@ -140,6 +156,12 @@ class TransformerEncoderLayer(Layer):
                  normalize_before=False, weight_attr=None, bias_attr=None,
                  layer_norm_eps=1e-5):
         super().__init__()
+        self._config = dict(
+            d_model=d_model, nhead=nhead, dim_feedforward=dim_feedforward,
+            dropout=dropout, activation=activation, attn_dropout=attn_dropout,
+            act_dropout=act_dropout, normalize_before=normalize_before,
+            weight_attr=weight_attr, bias_attr=bias_attr,
+            layer_norm_eps=layer_norm_eps)
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
@@ -182,11 +204,10 @@ class TransformerEncoderLayer(Layer):
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None):
         super().__init__()
-        import copy
+        # fresh-construct each stacked layer from the prototype's config so
+        # every layer gets independent initial weights (reference behavior)
         self.layers = LayerList([encoder_layer] + [
-            copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
-        # deepcopy of a Layer clones params; re-randomize clones so layers
-        # don't start identical (matches reference behavior of per-layer init)
+            _clone_layer(encoder_layer) for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
 
@@ -213,6 +234,12 @@ class TransformerDecoderLayer(Layer):
                  normalize_before=False, weight_attr=None, bias_attr=None,
                  layer_norm_eps=1e-5):
         super().__init__()
+        self._config = dict(
+            d_model=d_model, nhead=nhead, dim_feedforward=dim_feedforward,
+            dropout=dropout, activation=activation, attn_dropout=attn_dropout,
+            act_dropout=act_dropout, normalize_before=normalize_before,
+            weight_attr=weight_attr, bias_attr=bias_attr,
+            layer_norm_eps=layer_norm_eps)
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
@@ -281,9 +308,8 @@ class TransformerDecoderLayer(Layer):
 class TransformerDecoder(Layer):
     def __init__(self, decoder_layer, num_layers, norm=None):
         super().__init__()
-        import copy
         self.layers = LayerList([decoder_layer] + [
-            copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+            _clone_layer(decoder_layer) for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
 

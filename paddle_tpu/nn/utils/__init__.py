@@ -61,6 +61,7 @@ def weight_norm(layer, name="weight", dim=0):
 
     layer.register_buffer(name, Tensor(wd), persistable=False)
     layer._wn_hook = layer.register_forward_pre_hook(hook)
+    layer._wn_dim = dim
     return layer
 
 
@@ -70,17 +71,20 @@ def remove_weight_norm(layer, name="weight"):
     v = layer._parameters.pop(name + "_v")
     if hasattr(layer, "_wn_hook"):
         layer._wn_hook.remove()
-    axes_w = v._data
+    dim = getattr(layer, "_wn_dim", 0)
+    vv = v._data
     if name in layer._buffers:
         del layer._buffers[name]
     import jax.numpy as jnp
     # recompute the effective weight once and store as a plain parameter
-    dim0_norm = jnp.sqrt(jnp.sum(jnp.square(axes_w),
-                                 axis=tuple(range(1, axes_w.ndim)),
-                                 keepdims=True))
-    shape = [1] * axes_w.ndim
-    shape[0] = -1
-    w = g._data.reshape(shape) * axes_w / dim0_norm
+    if dim is None:
+        w = g._data * vv / jnp.linalg.norm(vv)
+    else:
+        axes = tuple(i for i in range(vv.ndim) if i != dim)
+        n = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+        shape = [1] * vv.ndim
+        shape[dim] = -1
+        w = g._data.reshape(shape) * vv / n
     layer.add_parameter(name, Parameter(w))
     return layer
 
@@ -108,18 +112,27 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     layer.register_buffer(name, Tensor(wd), persistable=False)
 
     def hook(lyr, inputs):
-        def f(vv):
-            m = jnp.moveaxis(vv, dim, 0).reshape(vv.shape[dim], -1)
+        worig = lyr._parameters[name + "_orig"]
+        wd = worig._data
+        # advance the persistent power-iteration estimate eagerly (no grad)
+        if not isinstance(wd, jax.core.Tracer):
+            m_c = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
             u = lyr._buffers[name + "_u"]._data
             for _ in range(n_power_iterations):
-                vvec = m.T @ u
+                vvec = m_c.T @ u
                 vvec = vvec / (jnp.linalg.norm(vvec) + eps)
-                u = m @ vvec
+                u = m_c @ vvec
                 u = u / (jnp.linalg.norm(u) + eps)
-            sigma = u @ (m @ vvec)
+            lyr._buffers[name + "_u"]._data = u
+        u0 = lyr._buffers[name + "_u"]._data
+
+        def f(vv):
+            m = jnp.moveaxis(vv, dim, 0).reshape(vv.shape[dim], -1)
+            vvec = m.T @ u0
+            vvec = vvec / (jnp.linalg.norm(vvec) + eps)
+            sigma = u0 @ (m @ vvec)
             return vv / sigma
-        w_new = call_op(f, (lyr._parameters[name + "_orig"],), {},
-                        op_name="spectral_norm")
+        w_new = call_op(f, (worig,), {}, op_name="spectral_norm")
         lyr._buffers[name] = w_new
         return None
 

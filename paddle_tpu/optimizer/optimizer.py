@@ -1,0 +1,249 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py ~2.5k LoC).
+
+TPU-native design: the update rule of each optimizer is a pure jnp function
+``_update(param, grad, state, lr) -> (new_param, new_state)``.  Eagerly it
+runs per-parameter; under the jit functionalizer the whole step (all params)
+traces into one XLA program, which is where fused multi-tensor updates come
+from on TPU — no hand-written multi_tensor CUDA kernel needed.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.autograd_state import no_grad
+from ..regularizer import L1Decay, L2Decay
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+def _param_key(p: Tensor, idx: int) -> str:
+    return p.name if p.name else f"param_{idx}"
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._name = name
+
+        if weight_decay is None:
+            self._regularization = None
+        elif isinstance(weight_decay, (L1Decay, L2Decay)):
+            self._regularization = weight_decay
+        else:
+            self._regularization = L2Decay(float(weight_decay))
+
+        # parameter groups (list of dicts) or flat list
+        self._param_groups: List[dict] = []
+        self._parameter_list: List[Tensor] = []
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                for g in parameters:
+                    self._add_param_group(dict(g))
+            else:
+                self._parameter_list = parameters
+                self._param_groups = [{"params": parameters}]
+        # accumulators: name -> {param_key: jnp array}
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = \
+            defaultdict(dict)
+        self._master_weights: Dict[str, jnp.ndarray] = {}
+        self._global_step = 0
+
+    # ------------------------------------------------------------------
+    def _add_param_group(self, group: dict):
+        params = list(group["params"])
+        group["params"] = params
+        self._parameter_list.extend(params)
+        self._param_groups.append(group)
+
+    def _append_params(self, parameters):
+        """Used by fleet wrappers to rebind parameter lists."""
+        self._parameter_list = list(parameters)
+        self._param_groups = [{"params": self._parameter_list}]
+
+    # ------------------------------------------------------------------
+    # lr plumbing
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._learning_rate = scheduler
+
+    def _group_lr(self, group: dict) -> float:
+        base = self.get_lr()
+        return base * float(group.get("learning_rate", 1.0))
+
+    # ------------------------------------------------------------------
+    # accumulators
+    # ------------------------------------------------------------------
+    def _get_accumulator(self, name: str, p: Tensor, idx: int,
+                         fill: float = 0.0, dtype=None, shape=None):
+        key = _param_key(p, idx)
+        store = self._accumulators[name]
+        if key not in store:
+            dt = dtype or (jnp.float32 if self._use_master(p) else p._data.dtype)
+            shp = tuple(shape) if shape is not None else p._data.shape
+            store[key] = jnp.full(shp, fill, dtype=dt)
+        return store[key]
+
+    def _set_accumulator(self, name: str, p: Tensor, idx: int, value):
+        self._accumulators[name][_param_key(p, idx)] = value
+
+    def _use_master(self, p: Tensor) -> bool:
+        return self._multi_precision and p._data.dtype in (
+            jnp.float16, jnp.bfloat16)
+
+    def _get_master(self, p: Tensor, idx: int):
+        key = _param_key(p, idx)
+        if key not in self._master_weights:
+            self._master_weights[key] = p._data.astype(jnp.float32)
+        return self._master_weights[key]
+
+    # ------------------------------------------------------------------
+    # step
+    # ------------------------------------------------------------------
+    def _collect_params_grads(self):
+        out = []
+        idx = 0
+        for group in self._param_groups:
+            for p in group["params"]:
+                g = p._grad
+                out.append((p, g, group, idx))
+                idx += 1
+        return out
+
+    def _apply_regularization(self, p: Tensor, g, group: dict):
+        reg = group.get("weight_decay", self._regularization)
+        if reg is None:
+            return g
+        if not isinstance(reg, (L1Decay, L2Decay)):
+            reg = L2Decay(float(reg))
+        # per-param regularizer attr wins (ParamAttr.regularizer)
+        attrs = getattr(p, "_paddle_attrs", None)
+        if attrs is not None and attrs.regularizer is not None:
+            reg = attrs.regularizer
+        if isinstance(reg, L2Decay) and reg.coeff:
+            return g + reg.coeff * p._data.astype(g.dtype)
+        if isinstance(reg, L1Decay) and reg.coeff:
+            return g + reg.coeff * jnp.sign(p._data).astype(g.dtype)
+        return g
+
+    # subclasses with decoupled decay (AdamW/Lamb) skip grad-coupled reg
+    _decoupled_decay = False
+
+    @no_grad()
+    def step(self):
+        self._global_step += 1
+        entries = self._collect_params_grads()
+        # grad clip over the whole set (matches reference semantics)
+        if self._grad_clip is not None:
+            pg = [(p, g) for p, g, _, _ in entries]
+            clipped = self._grad_clip(pg)
+            entries = [(p, cg, grp, i) for (p, g, grp, i), (_, cg)
+                       in zip(entries, clipped)]
+        for p, g, group, idx in entries:
+            if g is None or p.stop_gradient:
+                continue
+            gv = g._data if isinstance(g, Tensor) else g
+            use_master = self._use_master(p)
+            pv = self._get_master(p, idx) if use_master else p._data
+            gv = gv.astype(pv.dtype)
+            if not self._decoupled_decay:
+                gv = self._apply_regularization(p, gv, group)
+            lr = self._group_lr(group)
+            new_p = self._update_param(p, pv, gv, lr, group, idx)
+            if use_master:
+                self._master_weights[_param_key(p, idx)] = new_p
+                p._data = new_p.astype(p._data.dtype)
+            else:
+                p._data = new_p
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        raise NotImplementedError
+
+    minimize_return = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = True):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero=False)
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd = {}
+        for name, store in self._accumulators.items():
+            for key, v in store.items():
+                sd[f"{key}_{name}"] = Tensor(v)
+        if self._master_weights:
+            sd["master_weights"] = {k: Tensor(v) for k, v
+                                    in self._master_weights.items()}
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["global_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        state_dict = dict(state_dict)
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict.pop("LR_Scheduler"))
+        self._global_step = int(state_dict.pop("global_step", 0))
+        mw = state_dict.pop("master_weights", None)
+        if mw:
+            self._master_weights = {
+                k: (v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v)))
+                for k, v in mw.items()}
+        candidates = list(dict.fromkeys(
+            list(self._accumulators.keys()) + self._accumulator_names()))
+        # longest suffix first so "moment1" wins over "moment"
+        candidates.sort(key=len, reverse=True)
+        for full_key, v in state_dict.items():
+            vv = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            # split "<param_key>_<acc_name>" on last known acc name
+            for name in candidates:
+                suffix = "_" + name
+                if full_key.endswith(suffix):
+                    self._accumulators[name][full_key[:-len(suffix)]] = vv
+                    break
+
+    def _accumulator_names(self):
+        return ["moment", "moment1", "moment2", "beta1_pow", "beta2_pow",
+                "velocity", "inf_norm", "mean_square", "mean_grad",
+                "avg_squared_grad", "avg_squared_update"]
+
+    def get_opti_var_name_list(self):
+        return [f"{k}_{n}" for n, store in self._accumulators.items()
+                for k in store]
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.get_lr()})"

@@ -1,0 +1,345 @@
+"""The optimizer zoo (ref: python/paddle/optimizer/{sgd,momentum,adam,adamw,
+adamax,adagrad,rmsprop,adadelta,lamb,asgd,nadam,radam,rprop}.py).
+
+Each optimizer implements ``_update_param`` as pure jnp math; fp32 master
+weights are handled by the base.  Bias-correction uses running beta-power
+accumulators exactly like the reference (scalar state, not step counters).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer, _param_key
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        return pv - lr * gv
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rescale_grad = rescale_grad
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        gv = gv * self._rescale_grad
+        v = self._get_accumulator("velocity", p, idx)
+        v_new = self._momentum * v + gv
+        self._set_accumulator("velocity", p, idx, v_new)
+        if self._use_nesterov:
+            return pv - lr * (gv + self._momentum * v_new)
+        return pv - lr * v_new
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _adam_update(self, p, pv, gv, lr, idx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = self._get_accumulator("moment1", p, idx)
+        v = self._get_accumulator("moment2", p, idx)
+        b1p = self._get_accumulator("beta1_pow", p, idx, fill=1.0, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, idx, fill=1.0, shape=())
+        b1p = b1p * b1
+        b2p = b2p * b2
+        m = b1 * m + (1 - b1) * gv
+        v = b2 * v + (1 - b2) * jnp.square(gv)
+        self._set_accumulator("moment1", p, idx, m)
+        self._set_accumulator("beta1_pow", p, idx, b1p)
+        self._set_accumulator("beta2_pow", p, idx, b2p)
+        if self._amsgrad:
+            vmax = self._get_accumulator("moment2_max", p, idx)
+            vmax = jnp.maximum(vmax, v)
+            self._set_accumulator("moment2_max", p, idx, vmax)
+            self._set_accumulator("moment2", p, idx, v)
+            v_eff = vmax
+        else:
+            self._set_accumulator("moment2", p, idx, v)
+            v_eff = v
+        m_hat = m / (1 - b1p)
+        v_hat = v_eff / (1 - b2p)
+        return pv - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        return self._adam_update(p, pv, gv, lr, idx)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    _decoupled_decay = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._wd = weight_decay if not hasattr(weight_decay, "coeff") else \
+            weight_decay.coeff
+        self._lr_ratio = lr_ratio
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        wd = group.get("weight_decay", self._wd)
+        if hasattr(wd, "coeff"):
+            wd = wd.coeff
+        decay = True
+        if self._apply_decay_param_fun is not None:
+            decay = self._apply_decay_param_fun(p.name)
+        if decay and wd:
+            pv = pv * (1.0 - lr * wd)
+        return self._adam_update(p, pv, gv, lr, idx)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = self._get_accumulator("moment", p, idx)
+        u = self._get_accumulator("inf_norm", p, idx)
+        b1p = self._get_accumulator("beta1_pow", p, idx, fill=1.0, shape=())
+        b1p = b1p * b1
+        m = b1 * m + (1 - b1) * gv
+        u = jnp.maximum(b2 * u, jnp.abs(gv))
+        self._set_accumulator("moment", p, idx, m)
+        self._set_accumulator("inf_norm", p, idx, u)
+        self._set_accumulator("beta1_pow", p, idx, b1p)
+        return pv - (lr / (1 - b1p)) * m / (u + eps)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        acc = self._get_accumulator("moment", p, idx, fill=self._init_acc)
+        acc = acc + jnp.square(gv)
+        self._set_accumulator("moment", p, idx, acc)
+        return pv - lr * gv / (jnp.sqrt(acc) + self._epsilon)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        rho, eps = self._rho, self._epsilon
+        ms = self._get_accumulator("mean_square", p, idx)
+        ms = rho * ms + (1 - rho) * jnp.square(gv)
+        self._set_accumulator("mean_square", p, idx, ms)
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p, idx)
+            mg = rho * mg + (1 - rho) * gv
+            self._set_accumulator("mean_grad", p, idx, mg)
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._get_accumulator("velocity", p, idx)
+        mom = self._momentum * mom + lr * gv / denom
+        self._set_accumulator("velocity", p, idx, mom)
+        return pv - mom
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        rho, eps = self._rho, self._epsilon
+        g2 = self._get_accumulator("avg_squared_grad", p, idx)
+        d2 = self._get_accumulator("avg_squared_update", p, idx)
+        g2 = rho * g2 + (1 - rho) * jnp.square(gv)
+        upd = jnp.sqrt(d2 + eps) / jnp.sqrt(g2 + eps) * gv
+        d2 = rho * d2 + (1 - rho) * jnp.square(upd)
+        self._set_accumulator("avg_squared_grad", p, idx, g2)
+        self._set_accumulator("avg_squared_update", p, idx, d2)
+        return pv - lr * upd
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (ref: python/paddle/optimizer/lamb.py)."""
+
+    _decoupled_decay = True
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = self._get_accumulator("moment1", p, idx)
+        v = self._get_accumulator("moment2", p, idx)
+        b1p = self._get_accumulator("beta1_pow", p, idx, fill=1.0, shape=())
+        b2p = self._get_accumulator("beta2_pow", p, idx, fill=1.0, shape=())
+        b1p, b2p = b1p * b1, b2p * b2
+        m = b1 * m + (1 - b1) * gv
+        v = b2 * v + (1 - b2) * jnp.square(gv)
+        self._set_accumulator("moment1", p, idx, m)
+        self._set_accumulator("moment2", p, idx, v)
+        self._set_accumulator("beta1_pow", p, idx, b1p)
+        self._set_accumulator("beta2_pow", p, idx, b2p)
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps)
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        r = r + wd * pv
+        p_norm = jnp.linalg.norm(pv)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return pv - lr * trust * r
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._batch_num = batch_num
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        # paddle ASGD: running average of last batch_num grads
+        d = self._get_accumulator("d", p, idx)
+        ys = self._get_accumulator("ys", p, idx)
+        d = d - ys + gv
+        self._set_accumulator("d", p, idx, d)
+        self._set_accumulator("ys", p, idx, gv)
+        return pv - lr * d / self._batch_num
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = self._global_step
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = self._get_accumulator("mu_prod", p, idx, fill=1.0, shape=())
+        mu_prod_t = mu_prod * mu_t
+        self._set_accumulator("mu_prod", p, idx, mu_prod_t)
+        m = self._get_accumulator("moment1", p, idx)
+        v = self._get_accumulator("moment2", p, idx)
+        m = b1 * m + (1 - b1) * gv
+        v = b2 * v + (1 - b2) * jnp.square(gv)
+        self._set_accumulator("moment1", p, idx, m)
+        self._set_accumulator("moment2", p, idx, v)
+        m_hat = mu_t1 * m / (1 - mu_prod_t * mu_t1) + \
+            (1 - mu_t) * gv / (1 - mu_prod_t)
+        v_hat = v / (1 - b2 ** t)
+        return pv - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = self._global_step
+        m = self._get_accumulator("moment1", p, idx)
+        v = self._get_accumulator("moment2", p, idx)
+        m = b1 * m + (1 - b1) * gv
+        v = b2 * v + (1 - b2) * jnp.square(gv)
+        self._set_accumulator("moment1", p, idx, m)
+        self._set_accumulator("moment2", p, idx, v)
+        rho_inf = 2 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * (b2 ** t) / (1 - b2 ** t)
+        m_hat = m / (1 - b1 ** t)
+        if rho_t > 5:
+            lt = jnp.sqrt((1 - b2 ** t)) / (jnp.sqrt(v) + eps)
+            rt = ((rho_t - 4) * (rho_t - 2) * rho_inf /
+                  ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            return pv - lr * m_hat * rt * lt
+        return pv - lr * m_hat
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _update_param(self, p, pv, gv, lr, group, idx):
+        prev_g = self._get_accumulator("prev_grad", p, idx)
+        lrs = self._get_accumulator("lrs", p, idx, fill=lr)
+        sign = jnp.sign(gv * prev_g)
+        lrs = jnp.where(sign > 0, jnp.minimum(lrs * self._etas[1],
+                                              self._lr_range[1]),
+                        jnp.where(sign < 0,
+                                  jnp.maximum(lrs * self._etas[0],
+                                              self._lr_range[0]), lrs))
+        gv_eff = jnp.where(sign < 0, 0.0, gv)
+        self._set_accumulator("prev_grad", p, idx, gv_eff)
+        self._set_accumulator("lrs", p, idx, lrs)
+        return pv - lrs * jnp.sign(gv_eff)

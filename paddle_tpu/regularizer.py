@@ -1,0 +1,22 @@
+"""Weight-decay regularizers (ref: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

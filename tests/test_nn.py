@@ -332,3 +332,75 @@ def test_weight_norm_util():
     y = lin(x)
     np.testing.assert_allclose(y.numpy(), x.numpy() @ orig + lin.bias.numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_smoothing_respects_ignore_index():
+    logits = paddle.to_tensor(np.random.randn(4, 3).astype("float32"))
+    labels = paddle.to_tensor(np.array([0, 1, -100, 2]))
+    l_s = F.cross_entropy(logits, labels, ignore_index=-100,
+                          label_smoothing=0.1)
+    # manual: smoothing loss over the 3 valid rows only
+    l_np = logits.numpy()
+    logp = l_np - l_np.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    soft = np.full((4, 3), 0.1 / 3, dtype=np.float32)
+    for i, lab in enumerate([0, 1, 0, 2]):
+        soft[i, lab] += 0.9
+    want = -(soft * logp).sum(-1)[[0, 1, 3]].mean()
+    np.testing.assert_allclose(float(l_s.numpy()), want, rtol=1e-5)
+
+
+def test_pool_mask_ceil_mode_shapes_match():
+    x = paddle.to_tensor(np.random.randn(1, 1, 6, 6).astype("float32"))
+    out, mask = F.max_pool2d(x, 3, stride=2, ceil_mode=True,
+                             return_mask=True)
+    assert out.shape == mask.shape
+
+
+def test_transformer_stacked_layers_independent_init():
+    enc_layer = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 3)
+    w0 = enc.layers[0].linear1.weight.numpy()
+    w1 = enc.layers[1].linear1.weight.numpy()
+    assert not np.allclose(w0, w1)
+
+
+def test_lstm_sequence_length_masks_states():
+    paddle.seed(5)
+    lstm = nn.LSTM(3, 4)
+    x_np = np.random.randn(2, 6, 3).astype("float32")
+    x = paddle.to_tensor(x_np)
+    lens = paddle.to_tensor(np.array([6, 3]))
+    y, (h, c) = lstm(x, sequence_length=lens)
+    # outputs past each length are zero
+    np.testing.assert_allclose(y.numpy()[1, 3:], 0.0, atol=1e-7)
+    # final state of sample 1 equals running only its first 3 steps
+    y3, (h3, c3) = lstm(paddle.to_tensor(x_np[1:2, :3]))
+    np.testing.assert_allclose(h.numpy()[0, 1], h3.numpy()[0, 0], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bidirectional_sequence_length_reverse_valid_region():
+    paddle.seed(6)
+    gru = nn.GRU(3, 4, direction="bidirect")
+    x_np = np.random.randn(2, 5, 3).astype("float32")
+    lens = np.array([5, 2])
+    y, h = gru(paddle.to_tensor(x_np), sequence_length=paddle.to_tensor(lens))
+    # reverse-direction output at t=0 for sample 1 should equal reverse pass
+    # over its 2 valid steps only
+    y_ref, h_ref = gru(paddle.to_tensor(x_np[1:2, :2]))
+    np.testing.assert_allclose(y.numpy()[1, :2], y_ref.numpy()[0], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(y.numpy()[1, 2:], 0.0, atol=1e-7)
+
+
+def test_spectral_norm_converges_to_unit_sigma():
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = nn.Linear(16, 16)
+    spectral_norm(lin, "weight", n_power_iterations=2)
+    x = paddle.to_tensor(np.random.randn(1, 16).astype("float32"))
+    for _ in range(30):
+        lin(x)
+    w = lin._buffers["weight"].numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)

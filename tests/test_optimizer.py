@@ -1,0 +1,205 @@
+"""Optimizer tests — update-rule parity vs closed-form numpy references,
+end-to-end convergence oracle (loss decreases), state_dict roundtrip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(99)
+    np.random.seed(99)
+
+
+def _quadratic_step(optimizer_ctor, n_steps=60, **kw):
+    """Minimize ||Wx - y||^2 — returns (first_loss, last_loss, model)."""
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.randn(16, 4).astype("float32"))
+    y = paddle.to_tensor(np.random.randn(16, 4).astype("float32"))
+    o = optimizer_ctor(parameters=lin.parameters(), **kw)
+    losses = []
+    for _ in range(n_steps):
+        out = lin(x)
+        loss = F.mse_loss(out, y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses[0], losses[-1], lin, o
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (opt.SGD, {"learning_rate": 0.1}),
+    (opt.Momentum, {"learning_rate": 0.05, "momentum": 0.9}),
+    (opt.Adam, {"learning_rate": 0.05}),
+    (opt.AdamW, {"learning_rate": 0.05}),
+    (opt.Adamax, {"learning_rate": 0.05}),
+    (opt.Adagrad, {"learning_rate": 0.3}),
+    (opt.RMSProp, {"learning_rate": 0.01}),
+    (opt.Adadelta, {"learning_rate": 1.0, "n_steps": 300}),
+    (opt.Lamb, {"learning_rate": 0.05}),
+    (opt.NAdam, {"learning_rate": 0.05}),
+    (opt.RAdam, {"learning_rate": 0.05}),
+])
+def test_optimizers_converge(ctor, kw):
+    kw = dict(kw)
+    n_steps = kw.pop("n_steps", 60)
+    first, last, _, _ = _quadratic_step(ctor, n_steps=n_steps, **kw)
+    assert last < first * 0.5, f"{ctor.__name__}: {first} -> {last}"
+
+
+def test_sgd_exact_update():
+    p = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"),
+                         stop_gradient=False)
+    loss = (p * p).sum()
+    loss.backward()
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    o.step()
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2, 2.0 - 0.1 * 4],
+                               rtol=1e-6)
+
+
+def test_adam_matches_numpy_reference():
+    np.random.seed(0)
+    w0 = np.random.randn(5).astype("float32")
+    g_seq = [np.random.randn(5).astype("float32") for _ in range(4)]
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    o = opt.Adam(learning_rate=0.01, parameters=[p])
+    # numpy adam
+    m = np.zeros(5); v = np.zeros(5); b1 = 0.9; b2 = 0.999; eps = 1e-8
+    w = w0.copy().astype(np.float64)
+    for t, g in enumerate(g_seq, 1):
+        p.grad = paddle.to_tensor(g)
+        o.step()
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        w = w - 0.01 * mh / (np.sqrt(vh) + eps)
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-4, atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w0 = np.ones(3, dtype="float32")
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    o = opt.AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+    p.grad = paddle.to_tensor(np.zeros(3, dtype="float32"))
+    o.step()
+    # zero grad → update is pure decay: w *= (1 - lr*wd)
+    np.testing.assert_allclose(p.numpy(), w0 * (1 - 0.1 * 0.5), rtol=1e-5)
+
+
+def test_weight_decay_coupled_l2():
+    p = paddle.to_tensor(np.array([2.0], dtype="float32"),
+                         stop_gradient=False)
+    o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+    p.grad = paddle.to_tensor(np.array([0.0], dtype="float32"))
+    o.step()
+    # g_eff = 0 + 0.1*2 = 0.2 → p = 2 - 0.1*0.2
+    np.testing.assert_allclose(p.numpy(), [2.0 - 0.02], rtol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    p = paddle.to_tensor(np.zeros(2, dtype="float32"), stop_gradient=False)
+    o = opt.SGD(learning_rate=1.0, parameters=[p],
+                grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    p.grad = paddle.to_tensor(np.array([3.0, 4.0], dtype="float32"))
+    o.step()
+    np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-5)
+
+
+def test_param_groups_different_lr():
+    a = paddle.to_tensor(np.ones(2, dtype="float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.ones(2, dtype="float32"), stop_gradient=False)
+    o = opt.SGD(learning_rate=0.1, parameters=[
+        {"params": [a]},
+        {"params": [b], "learning_rate": 0.1},  # 10x smaller (multiplier)
+    ])
+    g = paddle.to_tensor(np.ones(2, dtype="float32"))
+    a.grad = g
+    b.grad = g
+    o.step()
+    np.testing.assert_allclose(a.numpy(), 1 - 0.1, rtol=1e-6)
+    np.testing.assert_allclose(b.numpy(), 1 - 0.01, rtol=1e-6)
+
+
+def test_multi_precision_master_weights():
+    w0 = np.array([1.0, -1.0], dtype="float32")
+    p = paddle.to_tensor(w0, dtype="bfloat16", stop_gradient=False)
+    o = opt.AdamW(learning_rate=1e-4, parameters=[p], multi_precision=True)
+    for _ in range(3):
+        p.grad = paddle.to_tensor(np.array([1e-3, 1e-3], dtype="float32"))
+        o.step()
+    # master weights exist in fp32
+    assert len(o._master_weights) == 1
+    mw = list(o._master_weights.values())[0]
+    assert str(mw.dtype) == "float32"
+
+
+def test_lr_scheduler_integration():
+    p = paddle.to_tensor(np.ones(1, dtype="float32"), stop_gradient=False)
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert abs(o.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(o.get_lr() - 0.05) < 1e-9
+
+
+def test_lr_schedules_values():
+    s = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    vals = []
+    for _ in range(11):
+        vals.append(s())
+        s.step()
+    assert abs(vals[0] - 1.0) < 1e-9
+    assert abs(vals[10] - 0.0) < 1e-9
+    w = opt.lr.LinearWarmup(learning_rate=0.1, warmup_steps=5, start_lr=0.0,
+                            end_lr=0.1)
+    ws = []
+    for _ in range(7):
+        ws.append(w())
+        w.step()
+    np.testing.assert_allclose(ws[:5], [0.0, 0.02, 0.04, 0.06, 0.08],
+                               rtol=1e-6)
+    assert abs(ws[6] - 0.1) < 1e-9
+    n = opt.lr.NoamDecay(d_model=64, warmup_steps=100, learning_rate=1.0)
+    n.step(50)
+    assert n() > 0
+    pw = opt.lr.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1])
+    pw.step(4)
+    assert abs(pw() - 0.5) < 1e-9
+
+
+def test_reduce_on_plateau():
+    s = opt.lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.1)
+    s.step(1.0)
+    s.step(1.0)
+    s.step(1.0)
+    assert abs(s() - 0.1) < 1e-9
+
+
+def test_optimizer_state_dict_roundtrip():
+    _, _, lin, o = _quadratic_step(opt.Adam, n_steps=3, learning_rate=0.01)
+    sd = o.state_dict()
+    o2 = opt.Adam(learning_rate=0.01, parameters=lin.parameters())
+    o2.set_state_dict(sd)
+    assert o2._global_step == o._global_step
+    for name, store in o._accumulators.items():
+        for k, v in store.items():
+            np.testing.assert_allclose(np.asarray(o2._accumulators[name][k]),
+                                       np.asarray(v), rtol=1e-6)
+
+
+def test_minimize_api():
+    lin = nn.Linear(2, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.randn(4, 2).astype("float32"))
+    loss = lin(x).sum()
+    before = lin.weight.numpy().copy()
+    o.minimize(loss)
+    assert not np.allclose(before, lin.weight.numpy())
