@@ -41,6 +41,10 @@ from .core.dispatch import grad
 from .random_state import seed, get_rng_state, set_rng_state, Generator
 from .random_state import get_rng_state_tracker as _get_rng_state_tracker
 
+from .framework.param_attr import ParamAttr
+from .framework.io import save, load
+from .regularizer import L1Decay, L2Decay
+
 # op surface
 from .tensor import *  # noqa: F401,F403
 from .tensor import einsum

@@ -1,0 +1,277 @@
+"""paddle.amp (ref: python/paddle/amp/ — auto_cast.py, grad_scaler.py).
+
+O1: per-op auto-cast via a dispatch hook (white list runs in fp16/bf16,
+black list forced to fp32).  O2: whole-model cast with fp32 master weights
+in the optimizer.  On TPU bf16 is the native fast dtype, so default O2
+dtype is bfloat16 when unspecified by the user config.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+from .. import dtype as dtypes
+
+# ref: python/paddle/amp/auto_cast.py white/black lists
+WHITE_LIST = {
+    "conv2d", "conv1d", "conv3d", "matmul", "mul", "linear", "einsum",
+    "attention", "scaled_dot_product_attention", "bmm", "mm",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2", "log_softmax", "layer_norm",
+    "batch_norm", "rms_norm", "reduce_mean", "reduce_sum", "norm",
+    "cumsum", "logsumexp", "erfinv", "pow",
+}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.float16
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def _is_f32(a):
+    return hasattr(a, "dtype") and a.dtype == jnp.float32
+
+
+def _is_low(a):
+    return hasattr(a, "dtype") and a.dtype in (jnp.float16, jnp.bfloat16)
+
+
+def _amp_cast_hook(op_name: str, arrays):
+    if not _state.enabled:
+        return arrays
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    black = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    if _state.level == "O2":
+        if op_name in black:
+            return [a.astype(jnp.float32) if _is_low(a) else a
+                    for a in arrays]
+        return arrays
+    # O1
+    if op_name in white:
+        return [a.astype(_state.dtype) if _is_f32(a) else a for a in arrays]
+    if op_name in black:
+        return [a.astype(jnp.float32) if _is_low(a) else a for a in arrays]
+    # gray: promote to the widest float present (matches reference promote)
+    if any(_is_f32(a) for a in arrays) and any(_is_low(a) for a in arrays):
+        return [a.astype(jnp.float32) if _is_low(a) else a for a in arrays]
+    return arrays
+
+
+# install hook into the dispatcher
+_dispatch._amp_hook = _amp_cast_hook
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "float16", use_promote: bool = True):
+    """paddle.amp.auto_cast (ref: amp/auto_cast.py)."""
+    prev = (_state.enabled, _state.level, _state.dtype, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enable
+    _state.level = level
+    _state.dtype = dtypes.to_jax(dtype)
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+_FP32_KEEP_LAYERS = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                     "SyncBatchNorm", "RMSNorm")
+
+
+def decorate(models, optimizers=None, level: str = "O2",
+             dtype: str = "float16", master_weight: Optional[bool] = None,
+             save_dtype: Optional[str] = None, master_grad: bool = False,
+             excluded_layers=None):
+    """paddle.amp.decorate — O2 whole-model cast with norm layers kept fp32
+    (ref: amp/auto_cast.py amp_decorate)."""
+    from ..nn import Layer
+    jdt = dtypes.to_jax(dtype)
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    excluded = tuple(excluded_layers or ())
+
+    if level == "O2":
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                tname = type(layer).__name__
+                if any(k in tname for k in _FP32_KEEP_LAYERS):
+                    continue
+                if excluded and isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p._data.dtype == jnp.float32:
+                        p._data = p._data.astype(jdt)
+
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list if not single_model else model_list[0], \
+            opt_list if not single_opt else opt_list[0]
+    return model_list[0] if single_model else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py)."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_count = 0
+        self._decr_count = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..tensor import math as tmath
+        return tmath.multiply(var, Tensor(jnp.asarray(
+            self._scale, var._data.dtype)))
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad is None:
+                continue
+            g = p._grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p._grad._data = g.astype(p._grad._data.dtype) \
+                if p._grad._data.dtype != jnp.float32 else g
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._cache_founds = self._found_inf
+
+    def update(self):
+        if not self._enable or not self._use_dynamic:
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._decr_count += 1
+            self._incr_count = 0
+            if self._decr_count >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._decr_count = 0
+        else:
+            self._incr_count += 1
+            self._decr_count = 0
+            if self._incr_count >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._incr_count = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_count": self._incr_count,
+                "decr_count": self._decr_count,
+                "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "use_dynamic_loss_scaling": self._use_dynamic}
+
+    def set_state_dict(self, state):
+        self._scale = float(state.get("scale", self._scale))
+        self._incr_count = int(state.get("incr_count", 0))
+        self._decr_count = int(state.get("decr_count", 0))
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True
+
+
+class debugging:
+    """paddle.amp.debugging shim — nan/inf checks route through
+    FLAGS_check_nan_inf (see core.dispatch._check_numerics)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def enable_tensor_checker(config=None):
+        from ..flags import set_flags
+        set_flags({"FLAGS_check_nan_inf": True})
+
+    @staticmethod
+    def disable_tensor_checker():
+        from ..flags import set_flags
+        set_flags({"FLAGS_check_nan_inf": False})

@@ -33,6 +33,10 @@ def _is_float_dtype(d) -> bool:
             or jnp.issubdtype(d, jnp.complexfloating))
 
 
+# installed by paddle_tpu.amp: (op_name, arrays) -> arrays with AMP casts
+_amp_hook = None
+
+
 class GradNode:
     """One recorded op on the tape."""
 
@@ -94,6 +98,8 @@ def call_op(fn: Callable, tensor_args: Sequence[Tensor],
     """
     kwargs = kwargs or {}
     arrays = [t._data for t in tensor_args]
+    if _amp_hook is not None:
+        arrays = _amp_hook(op_name or getattr(fn, "__name__", ""), arrays)
 
     needs_grad = (grad_enabled()
                   and any(not t.stop_gradient for t in tensor_args)

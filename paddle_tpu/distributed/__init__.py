@@ -1,0 +1,6 @@
+"""paddle.distributed (ref: python/paddle/distributed/).
+
+Built out in stages (SURVEY.md §7 stage 4-7): env/collectives first, then
+fleet hybrid parallel, then auto_parallel.
+"""
+from .env import ParallelEnv, get_rank, get_world_size, is_initialized  # noqa: F401

@@ -1,0 +1,80 @@
+"""Distributed environment (ref: paddle env-var contract in
+python/paddle/distributed/parallel.py — PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT).
+
+On TPU the process grid comes from jax.distributed (one process per host);
+the env-var contract is preserved so launchers and user code keep working.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class ParallelEnv:
+    """ref: python/paddle/base/dygraph/parallel.py ParallelEnv."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus", "0")))
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def device_id(self) -> int:
+        return self._device_id
+
+    @property
+    def current_endpoint(self) -> str:
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        return self._trainer_endpoints
+
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+_parallel_env: Optional[ParallelEnv] = None
+_initialized = False
+
+
+def _env() -> ParallelEnv:
+    global _parallel_env
+    if _parallel_env is None:
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(_env().rank)
+    return _env().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return _env().world_size
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def _mark_initialized():
+    global _initialized
+    _initialized = True

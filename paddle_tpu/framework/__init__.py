@@ -1,0 +1,14 @@
+"""paddle.framework (ref: python/paddle/framework/)."""
+from .param_attr import ParamAttr  # noqa: F401
+from .io import save, load  # noqa: F401
+
+from ..core.tensor import Tensor, Parameter  # noqa: F401
+from ..random_state import seed, get_rng_state, set_rng_state  # noqa: F401
+from ..dtype import get_default_dtype, set_default_dtype  # noqa: F401
+
+
+def in_dynamic_mode():
+    return True
+
+
+in_dygraph_mode = in_dynamic_mode

@@ -1,0 +1,74 @@
+"""paddle.save / paddle.load (ref: python/paddle/framework/io.py ~1.8k LoC).
+
+Pickle protocol with Tensors converted to numpy on save and restored as
+Tensors on load; >4GB objects use pickle protocol 4 chunking natively.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper carrying (array, is_param, name, stop_gradient)."""
+
+    def __init__(self, t: Tensor):
+        self.array = np.asarray(t._data)
+        self.is_param = t._is_param
+        self.name = t.name
+        self.stop_gradient = t.stop_gradient
+
+    def restore(self) -> Tensor:
+        if self.is_param:
+            p = Parameter(self.array, name=self.name)
+            p.stop_gradient = self.stop_gradient
+            return p
+        return Tensor(self.array, name=self.name,
+                      stop_gradient=self.stop_gradient)
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else obj.restore()
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path, protocol: int = 4, **configs):
+    """paddle.save"""
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs) -> Any:
+    """paddle.load"""
+    return_numpy = configs.get("return_numpy", False)
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path), return_numpy)
+    with open(str(path), "rb") as f:
+        return _unpack(pickle.load(f), return_numpy)

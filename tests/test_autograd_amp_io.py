@@ -1,0 +1,273 @@
+"""Tests for paddle.autograd (PyLayer, functional), paddle.amp, paddle.io,
+paddle.save/load."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.autograd import PyLayer, jvp, vjp, hessian, jacobian
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(11)
+    np.random.seed(11)
+
+
+# ---------------------------------------------------------------------------
+# PyLayer
+# ---------------------------------------------------------------------------
+
+def test_pylayer_custom_backward():
+    class DoubleGrad(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 10.0  # deliberately not the true grad
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"),
+                         stop_gradient=False)
+    y = DoubleGrad.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])
+
+
+def test_pylayer_multiple_inputs_outputs():
+    class MulAdd(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b, a + b
+
+        @staticmethod
+        def backward(ctx, ga, gb):
+            a, b = ctx.saved_tensor
+            return ga * b + gb, ga * a + gb
+
+    a = paddle.to_tensor(np.array([2.0], dtype="float32"),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.array([3.0], dtype="float32"),
+                         stop_gradient=False)
+    p, s = MulAdd.apply(a, b)
+    (p + s).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])  # b + 1
+    np.testing.assert_allclose(b.grad.numpy(), [3.0])  # a + 1
+
+
+def test_pylayer_inside_network():
+    class MyReLU(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return paddle.maximum(x, paddle.zeros_like(x))
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor
+            return g * (x > 0).astype("float32")
+
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    out = MyReLU.apply(lin(x))
+    out.sum().backward()
+    assert lin.weight.grad is not None
+
+
+# ---------------------------------------------------------------------------
+# functional autodiff
+# ---------------------------------------------------------------------------
+
+def test_jvp_vjp():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(np.array([3.0], dtype="float32"))
+    v = paddle.to_tensor(np.array([1.0], dtype="float32"))
+    out, tangent = jvp(f, x, v)
+    np.testing.assert_allclose(tangent.numpy(), [6.0])
+    out, g = vjp(f, x, v)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+
+
+def test_hessian():
+    def f(x):
+        return (x * x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), atol=1e-5)
+
+
+def test_jacobian_function_form():
+    def f(x):
+        return x * paddle.to_tensor(np.array([2.0, 3.0], dtype="float32"))
+
+    x = paddle.to_tensor(np.array([1.0, 1.0], dtype="float32"))
+    j = jacobian(f, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2.0, 3.0]), atol=1e-6)
+
+
+def test_paddle_grad_double_use():
+    x = paddle.to_tensor(np.array([2.0], dtype="float32"),
+                         stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, [x], retain_graph=True)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # paddle.grad must not touch .grad slots
+
+
+# ---------------------------------------------------------------------------
+# AMP
+# ---------------------------------------------------------------------------
+
+def test_autocast_o1_matmul_dtype():
+    import paddle_tpu.amp as amp
+    a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        c = paddle.matmul(a, b)
+        assert c.dtype == paddle.bfloat16
+        # black-listed op stays fp32
+        s = F.softmax(a)
+        assert s.dtype == paddle.float32
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == paddle.float32
+
+
+def test_grad_scaler_dynamic():
+    import paddle_tpu.amp as amp
+    lin = nn.Linear(4, 4)
+    o = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=128.0,
+                            decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    loss = lin(x).mean()
+    scaled = scaler.scale(loss)
+    np.testing.assert_allclose(float(scaled.numpy()),
+                               float(loss.numpy()) * 128.0, rtol=1e-5)
+    scaled.backward()
+    w_before = lin.weight.numpy().copy()
+    scaler.step(o)
+    scaler.update()
+    assert not np.allclose(w_before, lin.weight.numpy())
+    # grads were unscaled before the step: equivalent to lr*true_grad
+    # inf grad skips the step and shrinks the scale
+    lin.clear_gradients()
+    loss2 = lin(x).mean()
+    scaler.scale(loss2).backward()
+    lin.weight.grad.set_value(np.full((4, 4), np.inf, dtype="float32"))
+    w_before = lin.weight.numpy().copy()
+    scaler.step(o)
+    scaler.update()
+    np.testing.assert_allclose(w_before, lin.weight.numpy())
+    assert scaler.get_init_loss_scaling() == 64.0
+
+
+def test_amp_decorate_o2():
+    import paddle_tpu.amp as amp
+    model = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    model, o = amp.decorate(model, o, level="O2", dtype="bfloat16")
+    assert model[0].weight.dtype == paddle.bfloat16
+    assert model[1].weight.dtype == paddle.float32  # norm kept fp32
+    assert o._multi_precision
+
+
+# ---------------------------------------------------------------------------
+# io
+# ---------------------------------------------------------------------------
+
+def test_dataset_dataloader_batching():
+    from paddle_tpu.io import Dataset, DataLoader
+
+    class Sq(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.float32(i), np.float32(i * i)
+
+    dl = DataLoader(Sq(), batch_size=4, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    np.testing.assert_allclose(x.numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+    assert batches[2][0].shape == [2]
+
+
+def test_dataloader_shuffle_and_workers():
+    from paddle_tpu.io import Dataset, DataLoader
+
+    class Rng(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    dl = DataLoader(Rng(), batch_size=8, shuffle=True, num_workers=2)
+    vals = np.concatenate([b.numpy() for b in dl])
+    assert sorted(vals.tolist()) == list(range(64))
+    assert not np.allclose(vals, np.arange(64))
+
+
+def test_tensor_dataset_and_random_split():
+    from paddle_tpu.io import TensorDataset, random_split
+    xs = paddle.to_tensor(np.arange(12, dtype="float32").reshape(12, 1))
+    ys = paddle.to_tensor(np.arange(12, dtype="float32"))
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 12
+    a, b = random_split(ds, [8, 4])
+    assert len(a) == 8 and len(b) == 4
+
+
+def test_distributed_batch_sampler_shards():
+    from paddle_tpu.io import Dataset, DistributedBatchSampler
+
+    class D(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return i
+
+    s0 = DistributedBatchSampler(D(), batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(D(), batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 8
+    assert set(i0) | set(i1) == set(range(16))
+    assert set(i0) & set(i1) == set()
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    o = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    model(x).sum().backward()
+    o.step()
+    p = str(tmp_path / "model.pdparams")
+    po = str(tmp_path / "model.pdopt")
+    paddle.save(model.state_dict(), p)
+    paddle.save(o.state_dict(), po)
+
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    model2.set_state_dict(paddle.load(p))
+    for (k1, v1), (k2, v2) in zip(sorted(model.state_dict().items()),
+                                  sorted(model2.state_dict().items())):
+        np.testing.assert_allclose(np.asarray(v1.numpy()),
+                                   np.asarray(v2.numpy()))
+    o2 = opt.Adam(learning_rate=1e-3, parameters=model2.parameters())
+    o2.set_state_dict(paddle.load(po))
+    assert o2._global_step == 1
