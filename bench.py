@@ -1,0 +1,114 @@
+"""Benchmark: GPT pretrain tokens/sec/chip (BASELINE.md north star).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The preset is chosen to fit the attached chip's HBM (the north-star 1.3B
+config needs >= ~32GB with AdamW; a v5e-16G chip runs 760M).  The baseline
+is the A100 planning estimate from BASELINE.md, FLOPs-scaled to the chosen
+model size: tokens/sec/chip ~= MFU * peak_flops / (6 * N_params) with the
+A100 row at 45% MFU of 312 bf16 TFLOPs (which reproduces the 15-20k
+tok/s/chip figure for 1.3B).  vs_baseline > 1.0 beats the reference chip-
+for-chip at the same model.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+A100_PEAK_BF16 = 312e12
+A100_MFU_EST = 0.45
+
+
+def _baseline_tokens_per_sec(n_params: float) -> float:
+    return A100_MFU_EST * A100_PEAK_BF16 / (6.0 * n_params)
+
+
+def _param_count(cfg) -> int:
+    H, L, V, S = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                  cfg.max_position_embeddings)
+    return V * H + S * H + L * (12 * H * H + 13 * H) + 2 * H
+
+
+def main():
+    import jax
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import amp
+    from paddle_tpu.jit import train_step
+    from paddle_tpu.models import GPTForPretraining, gpt_config
+
+    if on_tpu:
+        dev = jax.devices()[0]
+        try:
+            hbm = dev.memory_stats()["bytes_limit"]
+        except Exception:
+            hbm = 16e9
+        if os.environ.get("BENCH_PRESET"):
+            preset = os.environ["BENCH_PRESET"]
+        elif hbm >= 30e9:
+            preset = "gpt3-1.3B"
+        elif hbm >= 14e9:
+            preset = "gpt3-760M"
+        else:
+            preset = "gpt3-350M"
+        seq = int(os.environ.get("BENCH_SEQ", "2048"))
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        steps = int(os.environ.get("BENCH_STEPS", "5"))
+        warmup = 2
+    else:
+        preset, seq, batch, steps, warmup = "gpt3-125M", 256, 4, 3, 1
+
+    cfg = gpt_config(preset, max_position_embeddings=seq,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     use_recompute=on_tpu)
+    model = GPTForPretraining(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                          weight_decay=0.01, multi_precision=True)
+    if on_tpu:
+        # amp O2: bf16 params feeding the MXU, fp32 master weights
+        model, optimizer = amp.decorate(models=model, optimizers=optimizer,
+                                        level="O2", dtype="bfloat16")
+
+    step = train_step(model, None, optimizer,
+                      step_fn=lambda m, ids, labels:
+                      m.loss_fn(m(ids), labels))
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = rs.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+
+    for _ in range(warmup):
+        step(ids, labels).block_until_ready()
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = step(ids, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_chips = sum(1 for d in jax.devices() if d.platform == "tpu") or 1
+    value = tokens_per_sec / (n_chips if on_tpu else 1)
+    n_params = _param_count(cfg)
+    if on_tpu:
+        metric = f"{preset}_pretrain_tokens_per_sec_per_chip"
+        baseline = _baseline_tokens_per_sec(n_params)
+    else:
+        metric = f"{preset}_tokens_per_sec_cpu_smoke"
+        baseline = _baseline_tokens_per_sec(n_params)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(value / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -144,7 +144,15 @@ def decorate(models, optimizers=None, level: str = "O2",
 
 
 class GradScaler:
-    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py)."""
+    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py).
+
+    TPU-native detail: the scaler state (scale, growth counters, found_inf)
+    lives in 0-d jnp arrays and every control decision is data-flow
+    (``jnp.where`` select of old-vs-new parameter values), never python
+    ``if``-on-array — so the SAME code runs eagerly and inside the jitted
+    train step, where the engine threads the state arrays through the
+    compiled function (the reference's update_loss_scaling CUDA kernel,
+    expressed as XLA selects)."""
 
     def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
                  incr_ratio: float = 2.0, decr_ratio: float = 0.5,
@@ -152,15 +160,15 @@ class GradScaler:
                  decr_every_n_nan_or_inf: int = 1,
                  use_dynamic_loss_scaling: bool = True):
         self._enable = enable
-        self._scale = float(init_loss_scaling)
-        self._incr_ratio = incr_ratio
-        self._decr_ratio = decr_ratio
-        self._incr_every_n_steps = incr_every_n_steps
-        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._scale = jnp.asarray(float(init_loss_scaling), jnp.float32)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
         self._use_dynamic = use_dynamic_loss_scaling
-        self._incr_count = 0
-        self._decr_count = 0
-        self._found_inf = False
+        self._incr_count = jnp.asarray(0, jnp.int32)
+        self._decr_count = jnp.asarray(0, jnp.int32)
+        self._found_inf = jnp.asarray(False)
         self._unscaled = False
 
     def is_enable(self) -> bool:
@@ -169,24 +177,39 @@ class GradScaler:
     def is_use_dynamic_loss_scaling(self) -> bool:
         return self._use_dynamic
 
+    # -- engine state threading ----------------------------------------
+    def _get_state_arrays(self):
+        return {"scale": self._scale, "incr": self._incr_count,
+                "decr": self._decr_count}
+
+    def _set_state_arrays(self, st):
+        self._scale = st["scale"]
+        self._incr_count = st["incr"]
+        self._decr_count = st["decr"]
+
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
             return var
         from ..tensor import math as tmath
-        return tmath.multiply(var, Tensor(jnp.asarray(
-            self._scale, var._data.dtype)))
+        # float16 cannot represent the default 65536 scale (overflows to
+        # inf) — promote the loss to fp32 for scaling; the tape casts
+        # cotangents back per-node (see dispatch.run_backward)
+        if var._data.dtype == jnp.float16:
+            var = var.astype("float32")
+        return tmath.multiply(var, Tensor(
+            self._scale.astype(var._data.dtype)))
 
     def unscale_(self, optimizer):
         if not self._enable or self._unscaled:
             return
+        optimizer = getattr(optimizer, "_inner_opt", optimizer)
         inv = 1.0 / self._scale
-        found = False
+        found = jnp.asarray(False)
         for p in optimizer._parameter_list:
             if p._grad is None:
                 continue
             g = p._grad._data.astype(jnp.float32) * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
+            found = found | jnp.any(~jnp.isfinite(g))
             p._grad._data = g.astype(p._grad._data.dtype) \
                 if p._grad._data.dtype != jnp.float32 else g
         self._found_inf = found
@@ -197,43 +220,59 @@ class GradScaler:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
-        self._cache_founds = self._found_inf
+        opt = getattr(optimizer, "_inner_opt", optimizer)
+        found = self._found_inf
+        # snapshot, step unconditionally, then data-flow select — the only
+        # skip mechanism valid under jit tracing
+        old_params = [(p, p._data) for p in opt._parameter_list]
+        old_acc = {n: dict(s) for n, s in opt._accumulators.items()}
+        old_master = dict(opt._master_weights)
+        optimizer.step()
+        for p, old in old_params:
+            p._data = jnp.where(found, old, p._data)
+        for n, store in opt._accumulators.items():
+            for k, v in store.items():
+                o = old_acc.get(n, {}).get(k)
+                store[k] = v if o is None else jnp.where(found, o, v)
+        for k, v in opt._master_weights.items():
+            o = old_master.get(k)
+            opt._master_weights[k] = v if o is None else jnp.where(found, o, v)
 
     def update(self):
         if not self._enable or not self._use_dynamic:
             self._unscaled = False
             return
-        if self._found_inf:
-            self._decr_count += 1
-            self._incr_count = 0
-            if self._decr_count >= self._decr_every_n_nan_or_inf:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._decr_count = 0
-        else:
-            self._incr_count += 1
-            self._decr_count = 0
-            if self._incr_count >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
-                self._incr_count = 0
-        self._found_inf = False
+        found = self._found_inf
+        decr = jnp.where(found, self._decr_count + 1, 0).astype(jnp.int32)
+        incr = jnp.where(found, 0, self._incr_count + 1).astype(jnp.int32)
+        do_decr = decr >= self._decr_every_n_nan_or_inf
+        do_incr = incr >= self._incr_every_n_steps
+        scale = self._scale
+        scale = jnp.where(do_decr,
+                          jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        scale = jnp.where(~found & do_incr, scale * self._incr_ratio, scale)
+        self._scale = scale
+        self._decr_count = jnp.where(do_decr, 0, decr).astype(jnp.int32)
+        self._incr_count = jnp.where(do_incr, 0, incr).astype(jnp.int32)
+        self._found_inf = jnp.asarray(False)
         self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        # the reference contract: the caller has already run
+        # scaled_loss.backward(); minimize only unscales + steps + updates
         self.step(optimizer)
         self.update()
 
     def get_init_loss_scaling(self):
-        return self._scale
+        return float(self._scale)
 
     def set_init_loss_scaling(self, v):
-        self._scale = float(v)
+        self._scale = jnp.asarray(float(v), jnp.float32)
 
     def state_dict(self):
-        return {"scale": self._scale, "incr_count": self._incr_count,
-                "decr_count": self._decr_count,
+        return {"scale": float(self._scale),
+                "incr_count": int(self._incr_count),
+                "decr_count": int(self._decr_count),
                 "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every_n_steps,
@@ -241,9 +280,13 @@ class GradScaler:
                 "use_dynamic_loss_scaling": self._use_dynamic}
 
     def set_state_dict(self, state):
-        self._scale = float(state.get("scale", self._scale))
-        self._incr_count = int(state.get("incr_count", 0))
-        self._decr_count = int(state.get("decr_count", 0))
+        self._scale = jnp.asarray(float(state.get("scale",
+                                                  float(self._scale))),
+                                  jnp.float32)
+        self._incr_count = jnp.asarray(int(state.get("incr_count", 0)),
+                                       jnp.int32)
+        self._decr_count = jnp.asarray(int(state.get("decr_count", 0)),
+                                       jnp.int32)
 
 
 def is_float16_supported(device=None) -> bool:

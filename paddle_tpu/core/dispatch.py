@@ -151,6 +151,8 @@ def call_op_custom_vjp(fwd_fn: Callable, bwd_fn: Callable,
     arrays = [t._data for t in tensor_args]
     needs_grad = grad_enabled() and any(not t.stop_gradient for t in tensor_args)
     outs, residuals = fwd_fn(*arrays, **kwargs)
+    if multi_out is None:  # infer: a tuple of arrays means multiple outputs
+        multi_out = isinstance(outs, tuple)
     if not needs_grad:
         return _wrap_outputs(outs, multi_out, None, True)
 
@@ -229,7 +231,14 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph: bool = False,
         full = []
         for i, (shape, dt) in enumerate(n.out_avals):
             c = cots[i]
-            full.append(jnp.zeros(shape, dt) if c is None else c)
+            if c is None:
+                c = jnp.zeros(shape, dt)
+            elif c.dtype != dt and _is_float_dtype(dt):
+                # mixed-precision tape (amp auto_cast): cotangent follows
+                # the consumer's compute dtype; cast back to this node's
+                # output dtype for the vjp call
+                c = c.astype(dt)
+            full.append(c)
         if n.vjp_fn is None:
             raise RuntimeError(
                 "Trying to backward through the graph a second time "

@@ -34,7 +34,7 @@ class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
         "name", "persistable", "_retain_grads", "_hooks", "_is_param",
-        "_paddle_attrs", "__weakref__",
+        "_paddle_attrs", "_dist_attr", "__weakref__",
     )
 
     def __init__(self, data, dtype=None, place: Optional[Place] = None,
@@ -65,6 +65,9 @@ class Tensor:
         self._hooks: List[Callable] = []
         self._is_param = False
         self._paddle_attrs = None
+        # distributed attrs: {"spec": per-dim sharding tuple, ...} set by
+        # the parallel layers / auto_parallel API, read by the jit engine
+        self._dist_attr = None
 
     # ------------------------------------------------------------------
     # value plumbing

@@ -1,0 +1,423 @@
+"""Collective communication ops.
+
+TPU-native replacement for the reference's python collectives + C++
+ProcessGroup dispatch (ref: python/paddle/distributed/communication/
+{all_reduce,all_gather,broadcast,reduce,scatter,reduce_scatter,all_to_all,
+batch_isend_irecv,barrier}.py → paddle/fluid/distributed/collective/).
+
+Two modes per op (see group.py docstring): per-rank lax collectives inside
+shard_map (the compiled multi-chip path), and global-array semantics in
+eager single-controller mode.  All SPMD-mode ops route through the autograd
+tape (``call_op``) so collectives are differentiable exactly like the
+reference's c_* ops with grad kernels.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op, call_op_custom_vjp
+from ...core.tensor import Tensor
+from .group import Group, ReduceOp, _resolve_group
+
+
+def _as_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+class _Work:
+    """Async work handle (ref: ProcessGroup::Task).  XLA's async dispatch
+    makes every op a completed-on-use future, so wait() just syncs."""
+
+    def __init__(self, tensors=()):
+        self._tensors = tensors if isinstance(tensors, (list, tuple)) else (tensors,)
+
+    def wait(self):
+        for t in self._tensors:
+            if isinstance(t, Tensor):
+                t.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _reduce_fn(op, axis):
+    if op == ReduceOp.SUM:
+        return lambda x: jax.lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return lambda x: jax.lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return lambda x: jax.lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return lambda x: jax.lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        # gather-then-prod: sign/zero safe (log-sum-exp would NaN on
+        # negatives and zeros)
+        return lambda x: jnp.prod(
+            jax.lax.all_gather(x, axis, tiled=False), axis=0)
+    raise ValueError(f"unsupported ReduceOp {op}")
+
+
+# ---------------------------------------------------------------------------
+# all_reduce
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op: bool = True,
+               use_calc_stream: bool = False):
+    """In-place across-rank reduction (ref: distributed/communication/
+    all_reduce.py).  Eager single-controller: the array is already a global
+    value so the reduction is an identity."""
+    g = _resolve_group(group)
+    t = _as_tensor(tensor)
+    if g.in_spmd_scope():
+        # grad kernel matches the reference's c_allreduce_sum_grad:
+        # identity (per-rank loss calculus), NOT jax's psum-transpose
+        # (total-loss calculus) — keeps loss-parity with NCCL training.
+        rfn = _reduce_fn(op, g.axis_name)
+        out = call_op_custom_vjp(
+            lambda x: (rfn(x), None),
+            lambda res, cot: (cot,),
+            (t._snapshot(),), op_name="all_reduce")
+        t._inplace_assign(out)
+    return _Work(t)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op: bool = True, use_calc_stream: bool = False):
+    """ref: communication/reduce.py — result valid on dst (we give every
+    rank the reduced value, a legal strengthening of the contract)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+# ---------------------------------------------------------------------------
+# all_gather
+# ---------------------------------------------------------------------------
+
+def _all_gather_value(t: Tensor, g: Group) -> Tensor:
+    axis = g.axis_name
+
+    def fn(x):
+        return jax.lax.all_gather(x, axis, tiled=True)
+
+    return call_op(fn, (t,), op_name="all_gather")
+
+
+def all_gather(tensor_list: Optional[List], tensor=None, group=None,
+               sync_op: bool = True, use_calc_stream: bool = False):
+    """ref: communication/all_gather.py — fills ``tensor_list`` with every
+    rank's tensor.  Also usable functionally: ``all_gather(None, t)``
+    returns the dim-0 concatenation."""
+    if tensor is None and not isinstance(tensor_list, list):
+        tensor_list, tensor = None, tensor_list
+    g = _resolve_group(group)
+    t = _as_tensor(tensor)
+    if g.in_spmd_scope():
+        cat = _all_gather_value(t, g)
+    elif g.nranks == 1:
+        cat = t
+    else:
+        # eager: the global array already holds every rank's data
+        cat = Tensor(jnp.concatenate([t._data] * g.nranks, axis=0),
+                     stop_gradient=t.stop_gradient)
+    if tensor_list is None:
+        return cat
+    n = g.nranks
+    chunk = cat.shape[0] // n
+    del tensor_list[:]
+    for i in range(n):
+        sl = call_op(lambda x, i=i: jax.lax.dynamic_slice_in_dim(
+            x, i * chunk, chunk, axis=0), (cat,), op_name="slice")
+        tensor_list.append(sl)
+    return _Work(tuple(tensor_list))
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    g = _resolve_group(group)
+    del object_list[:]
+    object_list.extend([obj] * g.nranks)
+
+
+# ---------------------------------------------------------------------------
+# broadcast / scatter
+# ---------------------------------------------------------------------------
+
+def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
+              use_calc_stream: bool = False):
+    """ref: communication/broadcast.py.  SPMD: select src rank's value via
+    masked psum (lowered by XLA to a real broadcast on ICI)."""
+    g = _resolve_group(group)
+    t = _as_tensor(tensor)
+    if g.in_spmd_scope():
+        axis = g.axis_name
+        sg = g.get_group_rank(src) if src in g.ranks else src
+
+        def fn(x):
+            idx = jax.lax.axis_index(axis)
+            mask = (idx == sg).astype(x.dtype)
+            return jax.lax.psum(x * mask, axis)
+
+        t._inplace_assign(call_op(fn, (t._snapshot(),), op_name="broadcast"))
+    return _Work(t)
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None,
+            sync_op: bool = True, use_calc_stream: bool = False):
+    """ref: communication/scatter.py — src's tensor_list scattered one
+    chunk per rank."""
+    g = _resolve_group(group)
+    if g.in_spmd_scope():
+        axis = g.axis_name
+        if tensor_list is not None:
+            stacked = call_op(
+                lambda *xs: jnp.stack(xs, axis=0),
+                tuple(_as_tensor(x) for x in tensor_list), op_name="stack")
+        else:
+            stacked = _as_tensor(tensor)
+        sg = g.get_group_rank(src) if src in g.ranks else src
+
+        def fn(x):
+            idx = jax.lax.axis_index(axis)
+            mask = (idx == sg).astype(x.dtype)
+            full = jax.lax.psum(x * mask, axis)
+            return jax.lax.dynamic_index_in_dim(full, jax.lax.axis_index(axis),
+                                                axis=0, keepdims=False)
+
+        out = call_op(fn, (stacked,), op_name="scatter")
+        t = _as_tensor(tensor)
+        t._inplace_assign(out)
+        return _Work(t)
+    # eager: rank-0 view
+    t = _as_tensor(tensor)
+    if tensor_list is not None:
+        r = max(g.rank, 0)
+        t._inplace_assign(_as_tensor(tensor_list[r]))
+    return _Work(t)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    g = _resolve_group(group)
+    r = max(g.rank, 0)
+    del out_object_list[:]
+    if in_object_list is not None:
+        out_object_list.append(in_object_list[r])
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter
+# ---------------------------------------------------------------------------
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op: bool = True, use_calc_stream: bool = False):
+    """ref: communication/reduce_scatter.py."""
+    g = _resolve_group(group)
+    if g.in_spmd_scope():
+        axis = g.axis_name
+        if tensor_list is not None:
+            inp = call_op(lambda *xs: jnp.concatenate(xs, axis=0),
+                          tuple(_as_tensor(x) for x in tensor_list),
+                          op_name="concat")
+        else:
+            inp = _as_tensor(tensor) if not isinstance(tensor, Tensor) else tensor
+
+        if op == ReduceOp.AVG:
+            def fn(x):
+                return jax.lax.psum_scatter(x, axis, tiled=True) / g.nranks
+        elif op == ReduceOp.SUM:
+            def fn(x):
+                return jax.lax.psum_scatter(x, axis, tiled=True)
+        else:
+            rfn = _reduce_fn(op, axis)
+
+            def fn(x):
+                full = rfn(x)
+                n = full.shape[0] // jax.lax.axis_size(axis)
+                return jax.lax.dynamic_slice_in_dim(
+                    full, jax.lax.axis_index(axis) * n, n, axis=0)
+
+        out = call_op(fn, (inp,), op_name="reduce_scatter")
+        if tensor_list is not None and isinstance(tensor, Tensor):
+            tensor._inplace_assign(out)
+            return _Work(tensor)
+        return out  # functional form: reduce_scatter(input_tensor)
+    # eager: global value — scatter = this rank's chunk of the (identity) sum
+    t = _as_tensor(tensor)
+    if tensor_list is not None:
+        r = max(g.rank, 0)
+        t._inplace_assign(_as_tensor(tensor_list[r]))
+    return _Work(t)
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall(out_tensor_list, in_tensor_list=None, group=None,
+             sync_op: bool = True, use_calc_stream: bool = False):
+    """ref: communication/all_to_all.py."""
+    g = _resolve_group(group)
+    if in_tensor_list is None:
+        in_tensor_list, out_tensor_list = out_tensor_list, None
+    if g.in_spmd_scope():
+        stacked = call_op(lambda *xs: jnp.stack(xs, axis=0),
+                          tuple(_as_tensor(x) for x in in_tensor_list),
+                          op_name="stack")
+
+        def fn(x):
+            return jax.lax.all_to_all(x, g.axis_name, split_axis=0,
+                                      concat_axis=0, tiled=False)
+
+        out = call_op(fn, (stacked,), op_name="alltoall")
+        outs = [call_op(lambda x, i=i: x[i], (out,), op_name="index")
+                for i in range(g.nranks)]
+    else:
+        outs = [_as_tensor(x) for x in in_tensor_list]
+    if out_tensor_list is None:
+        return outs
+    del out_tensor_list[:]
+    out_tensor_list.extend(outs)
+    return _Work(tuple(outs))
+
+
+def alltoall_single(out_tensor, in_tensor=None,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op: bool = True,
+                    use_calc_stream: bool = False):
+    """ref: communication/all_to_all.py alltoall_single (equal splits;
+    ragged splits are the MoE layer's job)."""
+    g = _resolve_group(group)
+    if in_tensor is None:
+        in_tensor, out_tensor = out_tensor, None
+    t = _as_tensor(in_tensor)
+    if g.in_spmd_scope():
+        def fn(x):
+            n = jax.lax.axis_size(g.axis_name)
+            xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+            y = jax.lax.all_to_all(xs, g.axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+            return y.reshape(x.shape)
+
+        out = call_op(fn, (t,), op_name="alltoall_single")
+    else:
+        out = t
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._inplace_assign(out)
+        return _Work(out_tensor)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# p2p — usable only in SPMD scope (pipeline schedules use these)
+# ---------------------------------------------------------------------------
+
+def _shift(t: Tensor, g: Group, delta: int) -> Tensor:
+    """ppermute by +delta along the group axis (rank r → r+delta)."""
+    axis = g.axis_name
+    n = g.nranks
+    perm = [(i, (i + delta) % n) for i in range(n)]
+
+    def fn(x):
+        return jax.lax.ppermute(x, axis, perm)
+
+    return call_op(fn, (t,), op_name=f"ppermute{delta:+d}")
+
+
+def send(tensor, dst: int = 0, group=None, sync_op: bool = True,
+         use_calc_stream: bool = False):
+    """Point-to-point send.  In SPMD every rank runs the same program, so
+    send/recv pair into a ppermute; the python-level pairing is done by the
+    pipeline p2p helper (ref: pp_utils/p2p_communication.py).  Outside SPMD
+    scope this is a no-op record."""
+    g = _resolve_group(group)
+    if len(_p2p_pending) >= _P2P_PENDING_MAX:
+        # unmatched sends must not pin tensors forever
+        _p2p_pending.pop(0)
+    _p2p_pending.append(("send", _as_tensor(tensor), dst, g))
+    return _Work(tensor)
+
+
+def recv(tensor, src: int = 0, group=None, sync_op: bool = True,
+         use_calc_stream: bool = False):
+    g = _resolve_group(group)
+    t = _as_tensor(tensor)
+    for i, (kind, st, dst, sg) in enumerate(_p2p_pending):
+        if kind == "send" and sg is g:
+            _p2p_pending.pop(i)
+            if g.in_spmd_scope():
+                # uniform ring-shift interpretation (same rule as
+                # batch_isend_irecv): each rank sends +delta along the
+                # axis, where delta is the send's peer offset
+                delta = dst - src if dst != src else dst
+                t._inplace_assign(_shift(st, g, delta))
+            else:
+                t._inplace_assign(st)
+            return _Work(t)
+    return _Work(t)
+
+
+_p2p_pending: list = []
+_P2P_PENDING_MAX = 64
+
+
+class P2POp:
+    """ref: communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer: int, group=None):
+        self.op = op
+        self.tensor = _as_tensor(tensor)
+        self.peer = peer
+        self.group = _resolve_group(group)
+
+
+def batch_isend_irecv(p2p_op_list: Sequence[P2POp]):
+    """Pairs sends with recvs into ppermutes (SPMD scope)."""
+    sends = [p for p in p2p_op_list if p.op in (isend, send)]
+    recvs = [p for p in p2p_op_list if p.op in (irecv, recv)]
+    works = []
+    for s in sends:
+        match = next((r for r in recvs if r.group is s.group), None)
+        if match is not None and s.group.in_spmd_scope():
+            delta = s.peer - match.peer if s.peer != match.peer else 0
+            # each rank sends to rank+delta; the matching recv gets it
+            out = _shift(s.tensor, s.group, s.peer if delta == 0 else delta)
+            match.tensor._inplace_assign(out)
+            recvs.remove(match)
+        elif match is not None:
+            match.tensor._inplace_assign(s.tensor)
+            recvs.remove(match)
+        works.append(_Work(s.tensor))
+    return works
+
+
+def isend(tensor, dst: int = 0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src: int = 0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+# ---------------------------------------------------------------------------
+# barrier / sync
+# ---------------------------------------------------------------------------
+
+def barrier(group=None):
+    """ref: communication/barrier.py."""
+    g = _resolve_group(group)
+    if g.in_spmd_scope():
+        call_op(lambda x: jax.lax.psum(x, g.axis_name),
+                (Tensor(jnp.ones(())),), op_name="barrier")
+    else:
+        for d in jax.devices():
+            pass
+        jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    _as_tensor(tensor).block_until_ready()

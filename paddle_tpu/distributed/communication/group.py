@@ -1,0 +1,190 @@
+"""Communication groups over mesh axes.
+
+TPU-native replacement for the reference's ProcessGroup stack
+(ref: paddle/fluid/distributed/collective/process_group.h,
+process_group_nccl.cc; python: paddle/distributed/communication/group.py).
+
+A Group is a view of one or more named axes of the global mesh (fused axes
+behave like the reference's fused communicator checks), or an ad-hoc set of
+device ranks (``new_group``).  Collectives have two execution modes:
+
+- **per-rank SPMD** (inside ``shard_map`` where the axis is bound): lax
+  collectives — this is the true multi-chip path, compiled by XLA onto
+  ICI/DCN.  Matches the reference's per-process NCCL semantics.
+- **eager single-controller**: jax arrays are *global* values (every rank
+  "sees" the whole tensor), so reductions are identities and gather/
+  broadcast are reshardings.  This mirrors how XLA's sharded-array model
+  subsumes the reference's explicit stream collectives.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..mesh import axis_degree, ensure_mesh, get_mesh, in_axis_scope
+
+
+class ReduceOp:
+    """ref: paddle/distributed/communication/reduce.py ReduceOp."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication subgroup.
+
+    ``axis_name`` — mesh axis (or tuple of axes, fused) this group reduces
+    over when used inside shard_map.  ``ranks`` — flat device ranks.
+    """
+
+    def __init__(self, ranks: List[int], gid: int = 0,
+                 axis_name=None, mesh: Optional[Mesh] = None,
+                 name: str = ""):
+        self._ranks = list(ranks)
+        self._id = gid
+        self._axis_name = axis_name
+        self._mesh = mesh
+        self._name = name or f"group_{gid}"
+
+    # -- reference API surface ------------------------------------------
+    @property
+    def id(self) -> int:
+        return self._id
+
+    @property
+    def ranks(self) -> List[int]:
+        return self._ranks
+
+    @property
+    def nranks(self) -> int:
+        return len(self._ranks)
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        """This process's rank within the group (-1 if not a member)."""
+        from ..env import get_rank
+        r = get_rank()
+        return self._ranks.index(r) if r in self._ranks else -1
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return (self._ranks.index(global_rank)
+                if global_rank in self._ranks else -1)
+
+    def is_member(self) -> bool:
+        return self.rank >= 0
+
+    # -- mesh plumbing ---------------------------------------------------
+    @property
+    def axis_name(self):
+        return self._axis_name
+
+    def in_spmd_scope(self) -> bool:
+        return self._axis_name is not None and in_axis_scope(self._axis_name)
+
+    def __repr__(self):
+        return (f"Group(id={self._id}, nranks={self.nranks}, "
+                f"axis={self._axis_name}, name={self._name})")
+
+
+_groups: Dict[int, Group] = {}
+_next_gid = [1]
+_default_group: Optional[Group] = None
+
+
+def _world_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        n = len(jax.devices())
+        mesh = get_mesh()
+        axis = tuple(mesh.axis_names) if mesh is not None else None
+        _default_group = Group(list(range(n)), gid=0, axis_name=axis,
+                               mesh=mesh, name="default")
+        _groups[0] = _default_group
+    return _default_group
+
+
+def _reset_groups():
+    global _default_group
+    _groups.clear()
+    _default_group = None
+    _next_gid[0] = 1
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid == 0:
+        return _world_group()
+    return _groups.get(gid)
+
+
+def axis_group(axis_name, mesh: Optional[Mesh] = None,
+               name: str = "", ranks: Optional[Sequence[int]] = None) -> Group:
+    """Build the group for one (or a fused tuple of) mesh axis — used by
+    HybridCommunicateGroup for the dp/pp/sharding/sep/mp subgroups.
+
+    ``ranks`` — the global ranks of this process's subgroup along the axis
+    (from the topology grid); defaults to logical 0..deg-1 when the caller
+    has no rank grid (single-host tests)."""
+    mesh = mesh or ensure_mesh()
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    deg = axis_degree(mesh, names)
+    ranks = list(ranks) if ranks is not None else list(range(deg))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(ranks, gid=gid, axis_name=tuple(names) if len(names) > 1
+              else names[0], mesh=mesh, name=name or str(axis_name))
+    _groups[gid] = g
+    return g
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend: str = None,
+              timeout=None) -> Group:
+    """ref: paddle.distributed.new_group.  Creates an ad-hoc group over the
+    given device ranks (all devices when None)."""
+    n = len(jax.devices())
+    ranks = list(range(n)) if ranks is None else sorted(int(r) for r in ranks)
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    mesh = None
+    axis = None
+    if len(ranks) > 1:
+        devs = np.array(jax.devices())[ranks]
+        axis = f"_g{gid}"
+        mesh = Mesh(devs, (axis,))
+    g = Group(ranks, gid=gid, axis_name=axis, mesh=mesh)
+    _groups[gid] = g
+    return g
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return _world_group()
+    if isinstance(group, int):
+        g = get_group(group)
+        if g is None:
+            raise ValueError(f"no group with id {group}")
+        return g
+    return group
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _reset_groups()
+    else:
+        _groups.pop(_resolve_group(group).id, None)

@@ -1,0 +1,13 @@
+"""``paddle.distributed.stream`` — stream-controlled collectives
+(ref: python/paddle/distributed/communication/stream/).
+
+On TPU there are no user-visible streams: XLA schedules collectives on ICI
+with its own latency hiding, so ``use_calc_stream`` is accepted and
+ignored.  Same ops, same signatures.
+"""
+from .collective_ops import (all_reduce, all_gather, broadcast, reduce,
+                             scatter, reduce_scatter, alltoall,
+                             alltoall_single, send, recv)
+
+__all__ = ["all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+           "reduce_scatter", "alltoall", "alltoall_single", "send", "recv"]

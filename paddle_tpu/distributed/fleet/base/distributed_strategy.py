@@ -1,0 +1,122 @@
+"""DistributedStrategy (ref: python/paddle/distributed/fleet/base/
+distributed_strategy.py — ~100-knob protobuf-backed strategy object).
+
+TPU-native: a plain attribute object with the same field names; knobs that
+configure NCCL/stream behavior are accepted and ignored (XLA owns
+scheduling).  ``hybrid_configs`` carries the mesh degrees.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class _Bunch(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+_HYBRID_DEFAULTS = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "ep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+    "mp_configs": _Bunch(),
+    "pp_configs": _Bunch(),
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # execution/graph knobs (accepted for parity)
+        self.auto = False
+        self.a_sync = False
+        self.sync_nccl_allreduce = False
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.gradient_scale_configs = _Bunch(scale_strategy="avg")
+        self.without_graph_optimization = False
+
+        # amp
+        self.amp = False
+        self.amp_configs = _Bunch(
+            init_loss_scaling=32768.0, incr_every_n_steps=1000,
+            decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+            use_dynamic_loss_scaling=True, custom_white_list=[],
+            custom_black_list=[], use_pure_fp16=False, use_fp16_guard=False,
+            use_bf16=False)
+
+        # recompute
+        self.recompute = False
+        self.recompute_configs = _Bunch(checkpoints=[], enable_offload=False)
+
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = _Bunch(
+            micro_batch_size=1, accumulate_steps=1, schedule_mode="1F1B",
+            p2p_cache_shape=True)
+
+        # tensor parallel (static-graph style knobs)
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Bunch(tensor_parallel_degree=1)
+
+        # sharding
+        self.sharding = False
+        self.sharding_configs = _Bunch(
+            sharding_degree=1, stage=1, segment_broadcast_MB=32.0,
+            comm_overlap=False, split_param=False, offload=False)
+
+        # gradient merge
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Bunch(k_steps=1, avg=True)
+
+        # lamb / lars / dgc / localsgd — accepted for parity
+        self.lamb = False
+        self.lamb_configs = _Bunch(lamb_weight_decay=0.01,
+                                   exclude_from_weight_decay=[])
+        self.lars = False
+        self.lars_configs = _Bunch()
+        self.dgc = False
+        self.localsgd = False
+
+        # hybrid parallel degrees — the mesh definition
+        self.hybrid_configs = {k: (dict(v) if isinstance(v, dict) else
+                                   (list(v) if isinstance(v, list) else v))
+                               for k, v in _HYBRID_DEFAULTS.items()}
+
+        self.heter_ccl_mode = False
+        self.is_fl_ps_mode = False
+
+    @property
+    def hybrid_configs(self):
+        return self._hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, configs: Dict[str, Any]):
+        merged = dict(getattr(self, "_hybrid_configs", _HYBRID_DEFAULTS))
+        for k, v in configs.items():
+            if k in ("mp_configs", "pp_configs") and isinstance(v, dict):
+                b = _Bunch(merged.get(k, {}))
+                b.update(v)
+                v = b
+            merged[k] = v
+        self._hybrid_configs = _Bunch(merged)
+
+    def __repr__(self):
+        hc = self._hybrid_configs
+        return (f"DistributedStrategy(dp={hc['dp_degree']}, "
+                f"mp={hc['mp_degree']}, pp={hc['pp_degree']}, "
+                f"sharding={hc['sharding_degree']}, sep={hc['sep_degree']})")
+
+
+Strategy = DistributedStrategy

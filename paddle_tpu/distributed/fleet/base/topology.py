@@ -1,0 +1,273 @@
+"""Hybrid-parallel topology over the device mesh.
+
+TPU-native re-design of ref: python/paddle/distributed/fleet/base/
+topology.py (CommunicateTopology + HybridCommunicateGroup).  The reference
+builds a cartesian rank grid and one NCCL communicator per axis subgroup;
+here the grid IS a ``jax.sharding.Mesh`` with named axes — axis order
+[dp, pp, sharding, sep, mp] keeps mp innermost so its collectives ride
+neighbouring ICI links (the NVLink-innermost analogue).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ...env import get_rank
+from ...mesh import build_mesh, set_mesh
+from ...communication.group import Group, axis_group
+
+
+class CommunicateTopology:
+    """ref: topology.py CommunicateTopology — the cartesian rank grid."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe",
+                 "sharding", "sep", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims))
+        shape = tuple(self._dims)
+        self._grid = np.arange(self._world_size).reshape(shape)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **kwargs) -> int:
+        idx = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._grid[idx])
+
+    def get_coord(self, rank: int):
+        coords = np.argwhere(self._grid == rank)[0]
+        import collections
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*[int(c) for c in coords])
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(int(r) for r in self._grid[tuple(sl)].ravel())
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._grid, axis, -1).reshape(-1, self._dims[axis])
+        return [list(map(int, row)) for row in moved]
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = self.get_coord(global_rank)._asdict()
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+    def get_fused_ranks(self, axis_names: Sequence[str],
+                        global_rank: int) -> List[int]:
+        """Global ranks of the subgroup spanning ``axis_names`` that
+        contains ``global_rank`` (other axes held at its coordinate)."""
+        import itertools
+        coord = self.get_coord(global_rank)._asdict()
+        dims = [range(self.get_dim(a)) for a in axis_names]
+        out = []
+        for combo in itertools.product(*dims):
+            c = dict(coord)
+            for a, v in zip(axis_names, combo):
+                c[a] = v
+            out.append(self.get_rank(**c))
+        return sorted(out)
+
+
+# mesh axis name per reference parallel name
+_AXIS_OF = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+            "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """ref: topology.py HybridCommunicateGroup.
+
+    Builds the global mesh and per-axis Groups.  The reference's per-axis
+    NCCL communicators become mesh-axis views; fused "check" groups fuse
+    axes.
+    """
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = get_rank()
+
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in \
+            topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+
+        # build + install the global mesh over ALL devices (single- and
+        # multi-host alike — jax.devices() is the global set), keeping all
+        # five axes so sharding specs can always name them
+        order = topology.get_hybrid_group_names()
+        axes = {_AXIS_OF[n]: topology.get_dim(n) for n in order}
+        self._mesh = build_mesh(axes)
+        set_mesh(self._mesh)
+
+        coord = topology.get_coord(self.global_rank)
+        self._dp_rank = coord.data
+        self._pp_rank = coord.pipe
+        self._sharding_rank = coord.sharding
+        self._sep_rank = getattr(coord, "sep", 0)
+        self._mp_rank = coord.model
+
+        gr = self.global_rank if self.global_rank < self.nranks else 0
+
+        def _ranks(names):
+            return topology.get_fused_ranks(names, gr)
+
+        self._dp_group = axis_group("dp", self._mesh, name="dp",
+                                    ranks=_ranks(["data"]))
+        self._pp_group = axis_group("pp", self._mesh, name="pp",
+                                    ranks=_ranks(["pipe"]))
+        self._sharding_group = axis_group("sharding", self._mesh,
+                                          name="sharding",
+                                          ranks=_ranks(["sharding"]))
+        self._sep_group = axis_group("sep", self._mesh, name="sep",
+                                     ranks=_ranks(["sep"]))
+        self._mp_group = axis_group("mp", self._mesh, name="mp",
+                                    ranks=_ranks(["model"]))
+        # check group: fused dp+sharding+pp (ref: get_check_parallel_group)
+        self._check_group = axis_group(("dp", "pp", "sharding"), self._mesh,
+                                       name="check",
+                                       ranks=_ranks(["data", "pipe",
+                                                     "sharding"]))
+        self._dp_sharding_group = axis_group(("dp", "sharding"), self._mesh,
+                                             name="dp_sharding",
+                                             ranks=_ranks(["data",
+                                                           "sharding"]))
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self) -> str:
+        # ref returns enum ParallelMode; string keeps the same information
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1:
+            return "DATA_PARALLEL"
+        if self._sharding_degree > 1 and self._mp_degree == 1 and \
+                self._pp_degree == 1:
+            return "SHARDING_PARALLEL"
+        if self._pp_degree > 1:
+            return "PIPELINE_PARALLEL"
+        return "TENSOR_PARALLEL"
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    # --- data parallel -------------------------------------------------
+    def get_data_parallel_rank(self) -> int:
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._dp_group.ranks[0]
+
+    # --- model (tensor) parallel ---------------------------------------
+    def get_model_parallel_rank(self) -> int:
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._mp_group.ranks[0]
+
+    # --- pipeline parallel ---------------------------------------------
+    def get_stage_id(self) -> int:
+        return self._pp_rank
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._pp_group
+
+    def is_first_stage(self) -> bool:
+        return self._pp_rank == 0
+
+    def is_last_stage(self) -> bool:
+        return self._pp_rank == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None  # p2p rides ppermute on the pp axis
+
+    # --- sharding parallel ---------------------------------------------
+    def get_sharding_parallel_rank(self) -> int:
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self._sharding_group.ranks[0]
+
+    # --- sep (Ulysses sequence parallel) -------------------------------
+    def get_sep_parallel_rank(self) -> int:
+        return self._sep_rank
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._sep_group
+
+    # --- fused groups ---------------------------------------------------
+    def get_check_parallel_group(self, sharding: bool = False) -> Group:
+        return self._check_group
+
+    def get_dp_sharding_parallel_group(self) -> Group:
+        return self._dp_sharding_group
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id, **kwargs)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def _set_hcg(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def _clear_hcg():
+    global _hcg
+    _hcg = None

@@ -1,0 +1,125 @@
+"""The Fleet singleton (ref: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init builds the hybrid topology (and thus the global mesh);
+distributed_model / distributed_optimizer wrap per enabled axes — same
+entry points, mesh-backed internals.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ...nn.layer.layers import Layer
+from ..env import get_rank, get_world_size, _mark_initialized
+from ..parallel import DataParallel
+from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import PaddleCloudRoleMaker
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            _set_hcg, get_hybrid_communicate_group)
+from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+    HybridParallelOptimizer)
+from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+from .meta_parallel.pipeline_parallel import PipelineParallel
+from .meta_parallel.tensor_parallel import TensorParallel
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[PaddleCloudRoleMaker] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    # ------------------------------------------------------------------
+    def init(self, role_maker=None, is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        import jax
+        n_dev = len(jax.devices())
+        degrees = {"data": hc["dp_degree"], "pipe": hc["pp_degree"],
+                   "sharding": hc["sharding_degree"],
+                   "sep": hc["sep_degree"], "model": hc["mp_degree"]}
+        # -1 / auto dp degree absorbs the remainder of the device grid
+        known = 1
+        for k, v in degrees.items():
+            if k != "data" and v:
+                known *= v
+        if degrees["data"] in (-1, 0, None):
+            degrees["data"] = max(n_dev // known, 1)
+            hc["dp_degree"] = degrees["data"]
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"],
+            [degrees["data"], degrees["pipe"], degrees["sharding"],
+             degrees["sep"], degrees["model"]])
+        self._hcg = HybridCommunicateGroup(topo)
+        _set_hcg(self._hcg)
+        _mark_initialized()
+        self._is_initialized = True
+        return self
+
+    # ------------------------------------------------------------------
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return self._hcg
+
+    def is_first_worker(self) -> bool:
+        return self._role_maker._is_first_worker()
+
+    def worker_index(self) -> int:
+        return self._role_maker._worker_index()
+
+    def worker_num(self) -> int:
+        return self._role_maker._worker_num()
+
+    def is_worker(self) -> bool:
+        return True
+
+    def worker_endpoints(self, to_string: bool = False):
+        eps = self._role_maker._get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self) -> int:
+        return 0
+
+    def barrier_worker(self):
+        from ..communication import barrier
+        barrier()
+
+    @property
+    def distributed_strategy(self) -> DistributedStrategy:
+        return self._strategy
+
+    # ------------------------------------------------------------------
+    def distributed_model(self, model: Layer):
+        """ref: fleet.py distributed_model — wrap per enabled axes."""
+        hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            if isinstance(model, PipelineLayer):
+                return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1 or \
+                hcg.get_sep_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1 or \
+                hcg.get_sharding_parallel_world_size() > 1:
+            return DataParallel(model,
+                                find_unused_parameters=self._strategy
+                                .find_unused_parameters)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """ref: fleet.py distributed_optimizer."""
+        if strategy is not None:
+            self._strategy = strategy
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    # static-graph parity stubs (the jit engine subsumes program rewrite)
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        raise NotImplementedError(
+            "static-graph fleet.minimize: use paddle.jit/to_static + "
+            "fleet.distributed_optimizer in dygraph mode")
+
+
+fleet = Fleet()

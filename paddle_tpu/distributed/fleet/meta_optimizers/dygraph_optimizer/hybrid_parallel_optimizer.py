@@ -1,0 +1,93 @@
+"""HybridParallelOptimizer + DygraphShardingOptimizer.
+
+TPU-native re-design of ref: fleet/meta_optimizers/dygraph_optimizer/
+{hybrid_parallel_optimizer,dygraph_sharding_optimizer}.py.
+
+The reference's hardest job here — making ClipGradByGlobalNorm correct
+under tp/pp/sharding by all-reducing the squared-norm partials across
+groups — disappears in the single-controller model: grads are *global*
+arrays, so the norm computed by the stock clip is already the global norm.
+What remains is API parity and marking state for the engine (sharded
+optimizer states, master weights).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .....optimizer.optimizer import Optimizer
+from ...base.topology import get_hybrid_communicate_group
+
+
+class HybridParallelOptimizer:
+    """ref: hybrid_parallel_optimizer.py HybridParallelOptimizer."""
+
+    def __init__(self, optimizer: Optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        # sharding stage1 from strategy → shard optimizer state
+        if strategy is not None:
+            hc = strategy.hybrid_configs
+            if hc["sharding_degree"] > 1:
+                optimizer._shard_state_axis = "sharding"
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._learning_rate
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = True):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+
+class DygraphShardingOptimizer:
+    """ref: dygraph_sharding_optimizer.py — ZeRO stage-1: each sharding
+    rank owns 1/N of the optimizer state.  On TPU: mark the state for
+    sharded placement; the engine gives accumulators a sharded layout and
+    XLA reduce-scatters grads into them and all-gathers updated params —
+    the same comm volume as the reference's hand-built broadcast."""
+
+    def __init__(self, optimizer: Optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg or get_hybrid_communicate_group()
+        optimizer._shard_state_axis = "sharding"
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = True):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)

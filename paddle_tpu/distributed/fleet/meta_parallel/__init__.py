@@ -1,0 +1,9 @@
+from .parallel_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                              RowParallelLinear, ParallelCrossEntropy,
+                              LayerDesc, SharedLayerDesc, PipelineLayer,
+                              SegmentLayers, RNGStatesTracker,
+                              get_rng_state_tracker,
+                              model_parallel_random_seed)
+from .tensor_parallel import TensorParallel, SegmentParallel, MetaParallelBase
+from .pipeline_parallel import PipelineParallel
+from . import sharding

@@ -1,0 +1,156 @@
+"""Tensor-parallel layers.
+
+TPU-native re-design of ref: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py (VocabParallelEmbedding, ColumnParallelLinear,
+RowParallelLinear, ParallelCrossEntropy) and layers/mpu/mp_ops.py
+(_c_identity/_c_split/_mp_allreduce/_c_concat).
+
+Design: weights are *global* arrays annotated with a per-dim sharding spec
+on the 'mp' mesh axis; forward computes the plain math plus activation
+sharding constraints.  GSPMD then partitions the matmuls and inserts the
+identity/allreduce/allgather pairs that the reference's mp_ops implement as
+explicit autograd functions — same math, compiler-placed collectives
+(SURVEY.md §2.3 TP row).  Megatron semantics preserved:
+
+- Column: Y = X·[W1|W2] — W col-sharded; output mp-sharded unless
+  ``gather_output``.
+- Row: Y = [X1|X2]·[W1;W2] — W row-sharded, input mp-sharded when
+  ``input_is_parallel``; output needs the psum GSPMD inserts.
+- Vocab embedding: rows sharded; masked-lookup + psum is GSPMD's lowering
+  of gather on a row-sharded table.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..... import nn
+from .....core.tensor import Tensor
+from .....nn import functional as F
+from .....nn.initializer import Constant, XavierNormal
+from .....nn.layer.layers import Layer
+from ....shard_utils import annotate_param, sharding_constraint
+from ...base.topology import get_hybrid_communicate_group
+
+
+def _mp_degree(mp_group) -> int:
+    if mp_group is not None:
+        return mp_group.nranks
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg else 1
+
+
+class VocabParallelEmbedding(Layer):
+    """ref: mp_layers.py VocabParallelEmbedding — embedding table row-
+    (vocab-)sharded over mp."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.world_size = _mp_degree(mp_group)
+        if num_embeddings % max(self.world_size, 1):
+            raise ValueError(
+                f"num_embeddings ({num_embeddings}) must be divisible by "
+                f"mp degree ({self.world_size})")
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return sharding_constraint(out, *([None] * out.ndim))
+
+
+class ColumnParallelLinear(Layer):
+    """ref: mp_layers.py ColumnParallelLinear."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = _mp_degree(mp_group)
+        if out_features % max(self.world_size, 1):
+            raise ValueError(
+                f"out_features ({out_features}) must be divisible by "
+                f"mp degree ({self.world_size})")
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, (None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True,
+                default_initializer=Constant(0.0))
+            annotate_param(self.bias, ("mp",))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec = [None] * (y.ndim - 1) + [None if self.gather_output else "mp"]
+        return sharding_constraint(y, *spec)
+
+
+class RowParallelLinear(Layer):
+    """ref: mp_layers.py RowParallelLinear."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = _mp_degree(mp_group)
+        if in_features % max(self.world_size, 1):
+            raise ValueError(
+                f"in_features ({in_features}) must be divisible by "
+                f"mp degree ({self.world_size})")
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, ("mp", None))
+        if has_bias:
+            # bias is added AFTER the row-parallel reduction (replicated),
+            # matching the reference's is_bias handling
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True,
+                default_initializer=Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * (x.ndim - 1) + ["mp"]
+            x = sharding_constraint(x, *spec)
+        y = F.linear(x, self.weight, None)
+        y = sharding_constraint(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """ref: mp_layers.py ParallelCrossEntropy — softmax CE over vocab-
+    sharded logits.  The reference implements the two-pass distributed
+    softmax (c_softmax_with_cross_entropy); here the logits stay mp-sharded
+    and the logsumexp reduction is partitioned by XLA, which generates the
+    same psum-of-partials pattern."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        spec = [None] * (input.ndim - 1) + ["mp"]
+        input = sharding_constraint(input, *spec)
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index,
+                               soft_label=False)

@@ -1,0 +1,204 @@
+"""Pipeline layer description + segmentation.
+
+TPU-native re-design of ref: fleet/meta_parallel/parallel_layers/
+pp_layers.py (LayerDesc, SharedLayerDesc, PipelineLayer).
+
+The reference materialises only this stage's sublayers per process; the
+single-controller TPU build materialises ALL layers (the arrays live
+sharded on-device, not in host memory) and records the stage partition.
+The pipeline *schedule* (1F1B microbatch loop with ppermute boundaries over
+the pp mesh axis) lives in meta_parallel/pipeline_parallel.py; in GSPMD
+mode the stage assignment also lowers to per-stage sharding annotations.
+"""
+from __future__ import annotations
+
+import math
+import re
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+from .....nn.layer.layers import Layer
+from ...base.topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    """ref: pp_layers.py LayerDesc — deferred layer construction."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        is_layer_cls = isinstance(layer_func, type) and \
+            issubclass(layer_func, Layer)
+        if not is_layer_cls and not callable(layer_func):
+            raise TypeError("LayerDesc needs a Layer subclass or callable")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """ref: pp_layers.py SharedLayerDesc — one physical layer shared by
+    several stages (tied embeddings).  Single-controller: sharing is plain
+    python object identity, no broadcast group needed."""
+
+    def __init__(self, key: str, layer_func: Callable, forward_func=None,
+                 shared_weight_attr: str = "weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """ref: pp_layers.py SegmentLayers — split N layer descs into
+    pp_degree contiguous stages, uniformly or by named-layer boundaries."""
+
+    def __init__(self, layers_desc: Sequence, num_parts: int,
+                 method: str = "uniform", num_virtual_pipeline_stage=None):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            name = self.method.split(":", 1)[1]
+
+            def _matches(d):
+                fn = getattr(d, "layer_func", d)
+                label = getattr(fn, "__name__", type(fn).__name__)
+                return re.search(name, label) is not None
+
+            matched = [i for i, d in enumerate(self.layers_desc)
+                       if _matches(d)]
+            if not matched:
+                return self.uniform(n, self.num_parts)
+            # split the matched layers evenly; each stage starts at the
+            # first matched layer of its chunk (stage 0 always starts at 0)
+            chunk_bounds = self.uniform(len(matched), self.num_parts)
+            bounds = [0]
+            for k in range(1, self.num_parts):
+                bounds.append(matched[chunk_bounds[k]])
+            bounds.append(n)
+            return bounds
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items: int, num_parts: int) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """ref: pp_layers.py PipelineLayer.
+
+    Holds the full layer list plus the stage partition.  ``forward`` runs
+    the whole model (correct in single-controller GSPMD mode); the
+    PipelineParallel schedule driver uses ``stage_layers(i)`` to run one
+    stage at a time inside the shard_map microbatch loop.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, recompute_ctx=None,
+                 num_virtual_pipeline_stages: Optional[int] = None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+
+        hcg = get_hybrid_communicate_group()
+        if topology is not None:
+            self._topo = topology
+            self._num_stages = topology.get_dim("pipe")
+        elif hcg is not None:
+            self._topo = hcg.topology
+            self._num_stages = hcg.get_pipe_parallel_world_size()
+        else:
+            self._topo = None
+            self._num_stages = num_stages or 1
+        self._stage_id = hcg.get_stage_id() if hcg is not None else 0
+
+        self._layers_desc = list(layers)
+        self._shared_layers = {}
+        built: List[Layer] = []
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                built.append(_SharedCall(self._shared_layers[d.layer_name],
+                                         d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"cannot build pipeline item {d!r}")
+        self.run_function = built
+        for i, l in enumerate(built):
+            self.add_sublayer(str(i), l)
+
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+    # -- stage access (used by the schedule driver) ----------------------
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_bounds(self, stage_id: int):
+        return self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+
+    def stage_layers(self, stage_id: int) -> List[Layer]:
+        a, b = self.stage_bounds(stage_id)
+        return self.run_function[a:b]
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, input, chunk_id=None):
+        x = input
+        for i, fn in enumerate(self.run_function):
+            if self._recompute_interval > 0 and \
+                    i % self._recompute_interval == 0 and self.training:
+                from ...recompute import recompute
+                x = recompute(fn, x)
+            else:
+                x = fn(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+class _SharedCall(Layer):
+    def __init__(self, shared: Layer, forward_func=None):
+        super().__init__()
+        # registered as sublayer only at first use site via PipelineLayer
+        self._shared = shared
+        self._forward_func = forward_func
+
+    def forward(self, x):
+        if self._forward_func is not None:
+            return self._forward_func(self._shared, x)
+        return self._shared(x)

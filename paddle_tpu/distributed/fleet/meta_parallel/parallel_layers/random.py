@@ -1,0 +1,42 @@
+"""Tensor-parallel RNG streams (ref: fleet/meta_parallel/parallel_layers/
+random.py — RNGStatesTracker with MODEL_PARALLEL_RNG).
+
+The tracker itself lives in paddle_tpu.random_state (jax PRNG keys instead
+of curand states); this module provides the reference's entry points.
+"""
+from __future__ import annotations
+
+from .....random_state import RNGStatesTracker, _rng_tracker
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng_tracker
+
+
+def model_parallel_random_seed(seed: int = None):
+    """ref: model_parallel_random_seed — 'global_seed' identical across mp
+    ranks (weights/global dropout), 'local_seed' offset per mp rank
+    (mp-sharded activation dropout)."""
+    from ...base.topology import get_hybrid_communicate_group
+    import random as _py_random
+    hcg = get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed is None:
+        seed = _py_random.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    tracker = get_rng_state_tracker()
+    tracker._states.clear()
+    tracker.add("global_seed", global_seed)
+    tracker.add("local_seed", local_seed)
+    tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    return global_seed, local_seed
+
+
+def determinate_seed(name: str = "global_seed") -> int:
+    tracker = get_rng_state_tracker()
+    if name not in tracker._states:
+        tracker.add(name, hash(name) & 0x7FFFFFFF)
+    return tracker._states[name].initial_seed()

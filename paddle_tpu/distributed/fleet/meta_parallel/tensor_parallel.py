@@ -1,0 +1,46 @@
+"""TensorParallel model wrapper (ref: fleet/meta_parallel/
+tensor_parallel.py).  The reference broadcasts parameters within the mp
+group at wrap time; single-controller params are born global, so the wrap
+is a marker + API surface."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+from ..base.topology import get_hybrid_communicate_group
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+class TensorParallel(MetaParallelBase):
+    """ref: tensor_parallel.py TensorParallel."""
+    pass
+
+
+class SegmentParallel(MetaParallelBase):
+    """ref: segment_parallel.py — sep-axis wrapper; attention does the
+    head↔seq alltoall (see incubate ulysses utilities)."""
+    pass

@@ -1,0 +1,166 @@
+"""Activation recomputation (gradient checkpointing).
+
+TPU-native re-design of ref: python/paddle/distributed/fleet/recompute/
+recompute.py (PyLayer-based checkpointing with RNG state save/restore) —
+here a tape-level custom-VJP op: forward runs the function WITHOUT
+recording interior nodes; backward replays it with recording and chains
+the cotangents.  Under ``jax.jit`` the replay IS rematerialisation — the
+compiled graph contains the recompute exactly like ``jax.checkpoint``, but
+the implementation stays framework-level so hooks/PyLayers inside the
+block keep working.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core import dispatch
+from ....core.autograd_state import no_grad
+from ....core.tensor import Tensor
+from ....random_state import default_generator
+
+
+def _flatten(args, kwargs):
+    """Split (args, kwargs) into (tensor leaves, rebuild fn)."""
+    tensors: List[Tensor] = []
+    spec = []
+
+    def scan(obj):
+        if isinstance(obj, Tensor):
+            spec.append(("t", len(tensors)))
+            tensors.append(obj)
+        elif isinstance(obj, (list, tuple)):
+            spec.append(("l", len(obj), isinstance(obj, tuple)))
+            for o in obj:
+                scan(o)
+        elif isinstance(obj, dict):
+            keys = sorted(obj)
+            spec.append(("d", keys))
+            for k in keys:
+                scan(obj[k])
+        else:
+            spec.append(("c", obj))
+
+    scan((args, kwargs))
+
+    def rebuild(tensor_list):
+        it = iter(spec)
+
+        def build():
+            tag = next(it)
+            if tag[0] == "t":
+                return tensor_list[tag[1]]
+            if tag[0] == "l":
+                items = [build() for _ in range(tag[1])]
+                return tuple(items) if tag[2] else items
+            if tag[0] == "d":
+                return {k: build() for k in tag[1]}
+            return tag[1]
+
+        a, kw = build()
+        return a, kw
+
+    return tensors, rebuild
+
+
+def recompute(function, *args, **kwargs):
+    """ref: fleet/recompute/recompute.py recompute(function, *args,
+    preserve_rng_state=True, use_reentrant=True)."""
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    tensors, rebuild = _flatten(args, kwargs)
+    rng_key = default_generator.get_state() if preserve_rng_state else None
+
+    multi_box = {}
+
+    def fwd(*arrays, **_kw):
+        saved = default_generator.get_state()
+        if rng_key is not None:
+            default_generator.set_state(rng_key)
+        try:
+            with no_grad():
+                ts = [Tensor(a, stop_gradient=t.stop_gradient)
+                      for a, t in zip(arrays, tensors)]
+                a2, kw2 = rebuild(ts)
+                out = function(*a2, **kw2)
+        finally:
+            if rng_key is not None:
+                default_generator.set_state(saved)
+        if isinstance(out, (tuple, list)):
+            multi_box["multi"] = True
+            multi_box["type"] = type(out)
+            return tuple(o._data for o in out), arrays
+        multi_box["multi"] = False
+        return out._data, arrays
+
+    def bwd(residual_arrays, cots):
+        saved = default_generator.get_state()
+        if rng_key is not None:
+            default_generator.set_state(rng_key)
+        try:
+            ts = [Tensor(a, stop_gradient=t.stop_gradient)
+                  for a, t in zip(residual_arrays, tensors)]
+            a2, kw2 = rebuild(ts)
+            out = function(*a2, **kw2)
+        finally:
+            if rng_key is not None:
+                default_generator.set_state(saved)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        cots_list = list(cots) if isinstance(cots, (tuple, list)) else [cots]
+        # PyLayer-style replay backward: leaves INSIDE the function
+        # (parameters captured by closure) accumulate into their .grad as
+        # a side effect — exactly the reference's recompute semantics —
+        # while the explicit inputs' grads become this node's cotangents.
+        for o, c in zip(outs, cots_list):
+            if not o.stop_gradient:
+                dispatch.run_backward(o, Tensor(c), retain_graph=True)
+        return tuple(
+            (t._grad._data if t._grad is not None else None)
+            if not t.stop_gradient else None
+            for t in ts)
+
+    out = dispatch.call_op_custom_vjp(
+        fwd, bwd, tensors, multi_out=None, op_name="recompute")
+    return out
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """ref: recompute_sequential — split a Sequential into segments and
+    recompute each."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    preserve = ctx.get("preserve_rng_state", True) if isinstance(ctx, dict) \
+        else True
+    if hasattr(functions, "children"):
+        functions = list(functions.children())
+    functions = list(functions)
+    n = len(functions)
+    per = (n + segments - 1) // max(segments, 1)
+    x = args[0] if len(args) == 1 else args
+
+    def run_segment(fns):
+        def seg(*xs):
+            y = xs[0] if len(xs) == 1 else xs
+            for f in fns:
+                y = f(y) if not isinstance(y, tuple) else f(*y)
+            return y
+        return seg
+
+    for i in range(0, n, per):
+        seg = run_segment(functions[i:i + per])
+        if isinstance(x, tuple):
+            x = recompute(seg, *x, preserve_rng_state=preserve, **kwargs)
+        else:
+            x = recompute(seg, x, preserve_rng_state=preserve, **kwargs)
+    return x
+
+
+def recompute_hybrid(ctx: dict, function, *args, **kwargs):
+    """ref: recompute_hybrid — recompute with saved activations partitioned
+    over the mp group.  In GSPMD mode the remat tensors inherit their
+    sharding specs, so partitioning saved activations is automatic; the
+    offload knob maps to jax host-offload policies (future work)."""
+    kwargs.pop("offload_indices", None)
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,7 @@
+from . import sequence_parallel_utils, hybrid_parallel_util
+from .hybrid_parallel_util import fused_allreduce_gradients
+
+def recompute(function, *args, **kwargs):
+    """ref: fleet.utils.recompute re-export."""
+    from ..recompute import recompute as _rc
+    return _rc(function, *args, **kwargs)

@@ -1,0 +1,36 @@
+"""ref: fleet/utils/hybrid_parallel_util.py.
+
+``fused_allreduce_gradients`` is the reference's manual grad-sync for the
+dp axis.  Single-controller grads are global arrays (the dp reduction
+happens inside the jitted step via GSPMD), so this is an intentional no-op
+that keeps trainer loops written against the reference API working.
+"""
+from __future__ import annotations
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    return None
+
+
+def fused_allreduce_gradients_with_group(parameter_list, group, scale=None):
+    return None
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    return None
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    return None
+
+
+def broadcast_sep_parameters(model, hcg=None):
+    return None
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    return None

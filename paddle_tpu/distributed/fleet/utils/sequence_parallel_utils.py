@@ -1,0 +1,144 @@
+"""Megatron-style sequence parallelism utilities.
+
+TPU-native re-design of ref: fleet/utils/sequence_parallel_utils.py
+(ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp autograd functions,
+ColumnSequenceParallelLinear/RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter).
+
+Between transformer blocks the sequence dim is sharded over the mp axis;
+before qkv/fc1 an all-gather restores the full sequence, after proj/fc2 a
+reduce-scatter re-shards it.  Here those are sharding-spec transitions the
+GSPMD partitioner lowers to exactly that all_gather/reduce_scatter pair on
+ICI (SURVEY.md §2.3 SP row).  Convention: activations are [B, S, H] (or
+[S, B, H] — the seq axis is ``axis=1`` by default to match batch-major).
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.initializer import Constant, XavierNormal
+from ....nn.layer.layers import Layer
+from ...shard_utils import annotate_param, sharding_constraint
+
+_SEQ_AXIS = 1  # [B, S, H]
+
+
+def _spec(ndim, seq_axis, seq_sharded: bool, last=None):
+    spec = [None] * ndim
+    if seq_sharded:
+        spec[seq_axis] = "mp"
+    spec[-1] = last
+    return spec
+
+
+class ScatterOp:
+    """fwd: shard seq dim over mp; bwd: all-gather (GSPMD transposes the
+    constraint automatically)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = _SEQ_AXIS) -> Tensor:
+        return sharding_constraint(x, *_spec(x.ndim, axis, True))
+
+
+class GatherOp:
+    """fwd: all-gather seq dim; bwd: scatter."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = _SEQ_AXIS) -> Tensor:
+        return sharding_constraint(x, *_spec(x.ndim, axis, False))
+
+
+class AllGatherOp:
+    """fwd all-gather, bwd reduce-scatter (ref: AllGatherOp)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = _SEQ_AXIS) -> Tensor:
+        return sharding_constraint(x, *_spec(x.ndim, axis, False))
+
+
+class ReduceScatterOp:
+    """fwd reduce-scatter, bwd all-gather (ref: ReduceScatterOp)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = _SEQ_AXIS) -> Tensor:
+        return sharding_constraint(x, *_spec(x.ndim, axis, True))
+
+
+scatter = ScatterOp.apply
+all_gather = AllGatherOp.apply
+reduce_scatter = ReduceScatterOp.apply
+
+
+def mark_as_sequence_parallel_parameter(parameter: Tensor):
+    """ref: sequence-parallel params (layernorm) need their grads
+    all-reduced over mp; with replicated global params GSPMD emits that
+    reduction automatically — the mark is kept for parity + engine
+    introspection."""
+    da = parameter._dist_attr or {}
+    da["sequence_parallel"] = True
+    parameter._dist_attr = da
+
+
+def is_sequence_parallel_parameter(parameter: Tensor) -> bool:
+    return bool((parameter._dist_attr or {}).get("sequence_parallel"))
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op on TPU (grads of replicated params are reduced by GSPMD);
+    kept for API parity with the reference trainer loops."""
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """ref: ColumnSequenceParallelLinear — all-gather(seq) then column-
+    parallel matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, (None, "mp"))
+        self.bias = self.create_parameter(
+            shape=[out_features], is_bias=True,
+            default_initializer=Constant(0.0)) if has_bias else None
+        if self.bias is not None:
+            annotate_param(self.bias, ("mp",))
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        y = F.linear(x, self.weight, self.bias)
+        spec = [None] * (y.ndim - 1) + [None if self.gather_output else "mp"]
+        return sharding_constraint(y, *spec)
+
+
+class RowSequenceParallelLinear(Layer):
+    """ref: RowSequenceParallelLinear — row-parallel matmul then
+    reduce-scatter(seq)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, ("mp", None))
+        self.bias = self.create_parameter(
+            shape=[out_features], is_bias=True,
+            default_initializer=Constant(0.0)) if has_bias else None
+        if self.bias is not None:
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        spec = [None] * (x.ndim - 1) + ["mp"]
+        x = sharding_constraint(x, *spec)
+        y = F.linear(x, self.weight, None)
+        y = ReduceScatterOp.apply(y)
+        if self.bias is not None:
+            y = y + self.bias
+        return y

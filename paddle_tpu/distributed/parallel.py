@@ -1,0 +1,107 @@
+"""init_parallel_env + DataParallel.
+
+TPU-native re-design of ref: python/paddle/distributed/parallel.py.
+``init_parallel_env`` builds the global device mesh (instead of a NCCL
+communicator) — on a multi-host TPU pod it first calls
+``jax.distributed.initialize`` so every host sees the full device set.
+
+``DataParallel`` (ref: paddle.DataParallel + collective/reducer.cc
+EagerReducer): the reference buckets grads and overlaps allreduce on comm
+streams.  Under XLA the gradient psum is emitted inside the jitted step and
+overlapped by the compiler's latency-hiding scheduler, so the wrapper's job
+reduces to (a) marking the dp axis for the engine, (b) ``no_sync`` for
+gradient accumulation, (c) API parity (scale_loss, state_dict
+delegation).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+from ..nn.layer.layers import Layer
+from . import env as _env_mod
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import build_mesh, ensure_mesh, get_mesh, set_mesh
+
+
+def init_parallel_env():
+    """ref: paddle.distributed.init_parallel_env.
+
+    Multi-host: driven by env vars (PADDLE_TRAINER_ID → process id,
+    PADDLE_TRAINERS_NUM → process count, PADDLE_MASTER → coordinator),
+    mapping onto jax.distributed.initialize.  Single-host: builds the
+    default all-devices 'dp' mesh.
+    """
+    if _env_mod.is_initialized():
+        return ParallelEnv()
+    nproc = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    if nproc > 1 and os.getenv("PADDLE_MASTER"):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=os.environ["PADDLE_MASTER"],
+                num_processes=nproc,
+                process_id=int(os.getenv("PADDLE_TRAINER_ID", "0")))
+        except (RuntimeError, ValueError):
+            pass  # already initialized (e.g. by the launcher)
+    ensure_mesh()
+    _env_mod._mark_initialized()
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """ref: python/paddle/distributed/parallel.py DataParallel."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1,
+                 find_unused_parameters: bool = False, group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+        # buffer sizes kept for API parity; XLA fuses grad collectives
+        self.comm_buffer_size = comm_buffer_size
+        self.last_comm_buffer_size = last_comm_buffer_size
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Skip grad sync inside — for gradient accumulation
+        (ref: DataParallel.no_sync)."""
+        old = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = old
+
+    def scale_loss(self, loss):
+        # reference scales only when loss-scale-by-world-size is configured;
+        # psum-mean semantics are handled by the engine's pmean
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+def spawn(func, args=(), nprocs: int = -1, join: bool = True, daemon=False,
+          **options):
+    """ref: paddle.distributed.spawn.  Single-controller jax drives all
+    local devices from one process, so spawn degenerates to a direct call
+    (multi-host pods launch one process per host via the launch CLI)."""
+    func(*args)
+    return None

@@ -1,0 +1,79 @@
+"""Sharding annotation helpers — the GSPMD substrate of the parallel layers.
+
+Where the reference's mp/sharding layers call explicit c_* collectives
+(ref: fleet/layers/mpu/mp_ops.py), the TPU-native layers *annotate*:
+parameters carry a per-dim PartitionSpec (consumed by the jit engine as
+in_shardings) and activations get ``with_sharding_constraint`` — XLA/GSPMD
+then inserts the all-gather/psum/reduce-scatter on ICI, fused and
+overlapped, which is exactly the "completion" pass the reference implements
+by hand (SURVEY.md §3.5 TPU note).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from .mesh import get_mesh, in_axis_scope
+
+
+def annotate_param(p: Tensor, spec: Sequence) -> Tensor:
+    """Attach a per-dim sharding spec (axis name, tuple of names, or None
+    per dim) to a parameter."""
+    da = p._dist_attr or {}
+    da["spec"] = tuple(spec)
+    p._dist_attr = da
+    return p
+
+
+def param_spec(p: Tensor) -> Optional[Tuple]:
+    da = p._dist_attr
+    return None if da is None else da.get("spec")
+
+
+def param_partition_spec(p: Tensor) -> PartitionSpec:
+    s = param_spec(p)
+    return PartitionSpec(*s) if s else PartitionSpec()
+
+
+def _mesh_axes_active(mesh: Mesh, spec) -> bool:
+    for s in spec:
+        for a in (s if isinstance(s, (tuple, list)) else (s,)):
+            if a is not None and mesh.shape.get(a, 1) > 1:
+                return True
+    return False
+
+
+def largest_dim_spec(shape, axis: str, degree: int):
+    """Largest-divisible-dim sharding rule — the single source of truth
+    for ZeRO-style layouts (used by both stage-3 param sharding and the
+    engine's optimizer-state sharding, which must agree)."""
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % degree == 0 and shape[i] >= degree:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return tuple(spec)
+    return None
+
+
+def sharding_constraint(x: Tensor, *spec) -> Tensor:
+    """Constrain an activation's sharding (no-op when there is no mesh, the
+    named axes are trivial, or we're inside shard_map explicit SPMD)."""
+    mesh = get_mesh()
+    if mesh is None or not _mesh_axes_active(mesh, spec):
+        return x
+    names = [a for s in spec
+             for a in (s if isinstance(s, (tuple, list)) else (s,))
+             if a is not None]
+    if any(in_axis_scope(a) for a in names):
+        return x  # explicit-mode code owns its collectives
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
+
+    def fn(v):
+        return jax.lax.with_sharding_constraint(v, sh)
+
+    return call_op(fn, (x,), op_name="sharding_constraint")

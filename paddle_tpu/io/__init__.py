@@ -364,22 +364,41 @@ class DataLoader:
         q: _queue.Queue = _queue.Queue(
             maxsize=self.prefetch_factor * self.num_workers)
         _END = object()
+        _ERR = object()
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for item in self._gen():
-                    q.put(item)
-            finally:
-                q.put(_END)
+                    if not _put(item):
+                        return  # consumer abandoned the iterator
+                _put(_END)
+            except BaseException as e:  # propagate dataset errors
+                _put((_ERR, e))
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 and \
+                        item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            t.join()
 
 
 def get_worker_info():

@@ -1,0 +1,6 @@
+"""paddle.jit (ref: python/paddle/jit/) — to_static ≅ jax.jit.
+
+train_step.py is the SPMD engine; to_static/save/load land with the
+dy2static stage (SURVEY.md §7 stage 3).
+"""
+from .train_step import TrainStep, train_step

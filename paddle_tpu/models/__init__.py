@@ -1,0 +1,4 @@
+"""Model zoo (flagship training models; vision models live in
+paddle_tpu.vision.models)."""
+from .gpt import (GPTConfig, GPTModel, GPTForPretraining,
+                  GPTPretrainingCriterion, gpt_config, PRESETS)

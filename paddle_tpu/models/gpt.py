@@ -1,0 +1,236 @@
+"""GPT model family — the flagship pretrain model (BASELINE config 3:
+GPT-3 1.3B fleet dp+sharding; config 4 uses the same block structure).
+
+TPU-native design notes (vs the reference's PaddleNLP-style GPT built on
+fleet mp_layers + fused CUDA kernels):
+- built from the fleet tensor-parallel layers (ColumnParallelLinear /
+  RowParallelLinear / VocabParallelEmbedding) so tp comes from weight
+  sharding specs and GSPMD, not hand collectives;
+- attention math stays in plain jnp-backed ops so XLA fuses it; the
+  Pallas flash-attention kernel slots in via
+  paddle_tpu.nn.functional.flash_attention once seq length warrants it;
+- activations optionally carry Megatron-SP sequence sharding between
+  blocks (``sequence_parallel=True``);
+- everything is bf16-friendly: params fp32 (master-weight pattern via
+  amp O2), matmuls cast by amp auto_cast lists.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Normal, Constant
+from ..framework.param_attr import ParamAttr
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy)
+from ..distributed.fleet.utils.sequence_parallel_utils import (
+    AllGatherOp, ReduceScatterOp)
+from ..distributed.shard_utils import sharding_constraint
+from ..distributed.fleet.recompute import recompute
+import paddle_tpu as paddle
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 2048
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    sequence_parallel: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+PRESETS = {
+    # name: (layers, hidden, heads, seq)
+    "gpt3-125M": dict(num_layers=12, hidden_size=768, num_heads=12),
+    "gpt3-350M": dict(num_layers=24, hidden_size=1024, num_heads=16),
+    "gpt3-760M": dict(num_layers=24, hidden_size=1536, num_heads=16),
+    "gpt3-1.3B": dict(num_layers=24, hidden_size=2048, num_heads=16),
+    "gpt3-2.7B": dict(num_layers=32, hidden_size=2560, num_heads=32),
+    "gpt3-6.7B": dict(num_layers=32, hidden_size=4096, num_heads=32),
+    "gpt3-13B": dict(num_layers=40, hidden_size=5120, num_heads=40),
+    "tiny": dict(num_layers=2, hidden_size=64, num_heads=4, vocab_size=512,
+                 max_position_embeddings=128),
+}
+
+
+def gpt_config(name: str, **overrides) -> GPTConfig:
+    cfg = dict(PRESETS[name])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class MultiHeadAttention(nn.Layer):
+    """Causal self-attention with fused qkv column-parallel projection."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.hidden_size = c.hidden_size
+        self.attn_drop = c.attention_dropout_prob
+        self.seq_par = c.sequence_parallel
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, weight_attr=init,
+            has_bias=True, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, weight_attr=init, has_bias=True,
+            input_is_parallel=True)
+
+    def forward(self, x, training: bool = True):
+        B, S, H = x.shape
+        qkv = self.qkv_proj(x)                     # [B, S, 3H] (mp-sharded)
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        # heads are the mp-sharded dim: [B, nh, S, hd]
+        qkv = qkv.transpose([2, 0, 3, 1, 4])
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        q = sharding_constraint(q, None, "mp", None, None)
+        k = sharding_constraint(k, None, "mp", None, None)
+        v = sharding_constraint(v, None, "mp", None, None)
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.attn_drop if training else 0.0,
+            is_causal=True, training=training)     # [B, nh, S, hd]
+        out = out.transpose([0, 2, 1, 3]).reshape([B, S, H])
+        out = sharding_constraint(out, None, None, "mp")
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        proj_init = ParamAttr(initializer=Normal(
+            std=c.initializer_range / math.sqrt(2.0 * c.num_layers)))
+        self.fc1 = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                        weight_attr=init, has_bias=True,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(c.intermediate_size, c.hidden_size,
+                                     weight_attr=proj_init, has_bias=True,
+                                     input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.ln1 = nn.LayerNorm(c.hidden_size, epsilon=1e-5)
+        self.attn = MultiHeadAttention(c)
+        self.ln2 = nn.LayerNorm(c.hidden_size, epsilon=1e-5)
+        self.mlp = GPTMLP(c)
+        self.drop_p = c.hidden_dropout_prob
+
+    def forward(self, x):
+        h = self.attn(self.ln1(x), training=self.training)
+        h = F.dropout(h, self.drop_p, training=self.training)
+        x = x + h
+        h = self.mlp(self.ln2(x))
+        h = F.dropout(h, self.drop_p, training=self.training)
+        return x + h
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.word_embeddings = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size,
+            weight_attr=ParamAttr(initializer=Normal(std=c.initializer_range)))
+        self.position_embeddings = nn.Embedding(
+            c.max_position_embeddings, c.hidden_size,
+            weight_attr=ParamAttr(initializer=Normal(std=c.initializer_range)))
+        self.drop_p = c.hidden_dropout_prob
+
+    def forward(self, input_ids):
+        S = input_ids.shape[-1]
+        pos = paddle.arange(0, S, dtype="int64")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        return F.dropout(x, self.drop_p, training=self.training)
+
+
+class GPTModel(nn.Layer):
+    """Transformer decoder stack."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.final_ln = nn.LayerNorm(config.hidden_size, epsilon=1e-5)
+
+    def forward(self, input_ids):
+        c = self.config
+        x = self.embeddings(input_ids)
+        # dp over batch; SP shards the sequence dim over mp between blocks
+        if c.sequence_parallel:
+            x = sharding_constraint(x, ("dp", "sharding"), "mp", None)
+        else:
+            x = sharding_constraint(x, ("dp", "sharding"), None, None)
+        for block in self.layers:
+            if c.use_recompute and self.training:
+                x = recompute(block, x)
+            else:
+                x = block(x)
+        return self.final_ln(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head (tied to the word embedding) + loss."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head_weight = self.create_parameter(
+                shape=[config.vocab_size, config.hidden_size],
+                attr=ParamAttr(initializer=Normal(std=config.initializer_range)))
+        self.loss_fn = GPTPretrainingCriterion()
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)                       # [B, S, H]
+        w = (self.gpt.embeddings.word_embeddings.weight
+             if self.config.tie_word_embeddings else self.lm_head_weight)
+        logits = paddle.matmul(h, w, transpose_y=True)  # [B, S, V]
+        return sharding_constraint(logits, ("dp", "sharding"), None, "mp")
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Next-token cross entropy (vocab-parallel safe)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=-100)
+
+    def forward(self, logits, labels):
+        # logits [B, S, V]; labels [B, S].  Mean over VALID tokens only —
+        # ignore_index positions must not dilute the loss (reference's
+        # masked-sum / mask-count formulation).
+        B, S, V = logits.shape
+        flat = labels.reshape([B * S])
+        loss = self.ce(logits.reshape([B * S, V]), flat)
+        mask = (flat != self.ce.ignore_index).astype(loss.dtype)
+        return (loss * mask).sum() / mask.sum().clip(min=1.0)

@@ -58,6 +58,12 @@ class Optimizer:
             defaultdict(dict)
         self._master_weights: Dict[str, jnp.ndarray] = {}
         self._global_step = 0
+        # traced-lr override: the jit engine threads the scheduler's lr in
+        # as a scalar array so lr changes don't retrace the step
+        self._lr_override = None
+        # sharding hints set by fleet sharding wrappers, read by the engine
+        self._shard_state_axis: Optional[str] = None
+        self._shard_grads = False
 
     # ------------------------------------------------------------------
     def _add_param_group(self, group: dict):
@@ -75,6 +81,8 @@ class Optimizer:
     # lr plumbing
     # ------------------------------------------------------------------
     def get_lr(self) -> float:
+        if self._lr_override is not None:
+            return self._lr_override  # scalar array under trace
         if isinstance(self._learning_rate, LRScheduler):
             return float(self._learning_rate())
         return float(self._learning_rate)
