@@ -20,7 +20,8 @@ from .. import dtype as dtypes
 # ref: python/paddle/amp/auto_cast.py white/black lists
 WHITE_LIST = {
     "conv2d", "conv1d", "conv3d", "matmul", "mul", "linear", "einsum",
-    "attention", "scaled_dot_product_attention", "bmm", "mm",
+    "attention", "scaled_dot_product_attention", "flash_attention",
+    "bmm", "mm",
 }
 BLACK_LIST = {
     "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",

@@ -92,6 +92,10 @@ define_flag("use_stride_kernel", True, "accepted for API parity; XLA manages lay
 define_flag("eager_delete_tensor_gb", 0.0, "accepted for API parity; PJRT manages memory")
 define_flag("allocator_strategy", "auto_growth", "accepted for API parity")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "accepted for API parity")
+define_flag("use_pallas_attention", True,
+            "route attention through the Pallas flash kernel on TPU")
+define_flag("pallas_interpret", False,
+            "run Pallas kernels in interpreter mode (CPU tests)")
 define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")
 define_flag("embedding_deterministic", 0, "deterministic embedding lookup")
 define_flag("log_level", 0, "framework VLOG level")

@@ -99,17 +99,16 @@ class MultiHeadAttention(nn.Layer):
     def forward(self, x, training: bool = True):
         B, S, H = x.shape
         qkv = self.qkv_proj(x)                     # [B, S, 3H] (mp-sharded)
+        # flash layout [B, S, nh, hd]; heads are the mp-sharded dim
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
-        # heads are the mp-sharded dim: [B, nh, S, hd]
-        qkv = qkv.transpose([2, 0, 3, 1, 4])
-        q, k, v = qkv[0], qkv[1], qkv[2]
-        q = sharding_constraint(q, None, "mp", None, None)
-        k = sharding_constraint(k, None, "mp", None, None)
-        v = sharding_constraint(v, None, "mp", None, None)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = sharding_constraint(q, None, None, "mp", None)
+        k = sharding_constraint(k, None, None, "mp", None)
+        v = sharding_constraint(v, None, None, "mp", None)
         out = F.scaled_dot_product_attention(
             q, k, v, dropout_p=self.attn_drop if training else 0.0,
-            is_causal=True, training=training)     # [B, nh, S, hd]
-        out = out.transpose([0, 2, 1, 3]).reshape([B, S, H])
+            is_causal=True, training=training)     # [B, S, nh, hd]
+        out = out.reshape([B, S, H])
         out = sharding_constraint(out, None, None, "mp")
         return self.out_proj(out)
 

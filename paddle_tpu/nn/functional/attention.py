@@ -29,6 +29,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                          ensure_tensor(value))
     args = [query, key, value]
     has_mask = attn_mask is not None
+    # hot path: Pallas flash kernel (no mask, no dropout, aligned shapes)
+    if not has_mask and (dropout_p == 0.0 or not training):
+        from ...ops.pallas import flash_attention as _pfa
+        if _pfa.available() and _pfa.supports(
+                query.shape[1], key.shape[1], query.shape[-1], is_causal):
+            try:
+                return _pfa.pallas_flash_attention(query, key, value,
+                                                   causal=is_causal)
+            except Exception:
+                pass  # Mosaic lowering failure → XLA fallback below
     if has_mask:
         args.append(ensure_tensor(attn_mask))
     drop_key = next_key() if (dropout_p > 0.0 and training) else None
@@ -67,13 +77,7 @@ def flash_attention(query, key, value, dropout: float = 0.0,
     """ref: nn/functional/flash_attention.py flash_attention — returns
     (out, softmax_lse placeholder).  Uses the Pallas TPU kernel when
     enabled, else the XLA fused path."""
-    if get_flag("use_pallas_attention") and dropout == 0.0:
-        try:
-            from ...ops.pallas.flash_attention import pallas_flash_attention
-            out = pallas_flash_attention(query, key, value, causal=causal)
-            return (out, None) if return_softmax else (out, None)
-        except Exception:
-            pass
+    # routing (incl. the Pallas hot path) lives in sdpa — one gate
     out = scaled_dot_product_attention(query, key, value, None, dropout,
                                        causal, training)
     return (out, None)

@@ -1,0 +1,9 @@
+"""Hand-written TPU kernels (Pallas) — the native-kernel layer.
+
+This package is the TPU-native analogue of the reference's fused CUDA
+kernels (ref: paddle/phi/kernels/fusion/ + third_party flashattn): where
+the reference ships hand-scheduled CUDA, we ship Pallas kernels compiled
+by Mosaic onto the MXU/VPU (see /opt/skills/guides/pallas_guide.md).
+"""
+from . import flash_attention
+from . import ring_attention, ulysses

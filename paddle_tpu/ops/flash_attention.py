@@ -1,0 +1,287 @@
+"""Pallas flash attention (TPU).
+
+TPU-native replacement for the reference's flash-attn integration
+(ref: paddle/phi/kernels/fusion/ + third_party/flashattn +
+python/paddle/nn/functional/flash_attention.py).
+
+Blockwise online-softmax attention: never materialises the S x S score
+matrix.  Forward computes per-query-block running (max, sum, acc) over
+key blocks (skipping fully-masked blocks under causal); backward is the
+standard two-kernel flash recomputation (dq over key blocks, dk/dv over
+query blocks) using the saved logsumexp.
+
+Layout contract here is [B*H, S, D] (callers reshape); block sizes are
+MXU-aligned (128).  ``interpret=True`` runs the same kernels on CPU for
+tests (the fake-device strategy of SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves fully on TPU builds; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_q: int, block_k: int, seq_k: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    bq, d = q.shape
+
+    hi = (jnp.int32(seq_k) if not causal
+          else (qi + 1) * jnp.int32(block_q))
+    nblocks = pl.cdiv(hi, jnp.int32(block_k))
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_idx = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v_blk,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
+               block_k: int, interpret: bool):
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, pl.cdiv(sq, block_q))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale: float, causal: bool, block_q: int,
+                   block_k: int, seq_k: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    bq, d = q.shape
+
+    hi = (jnp.int32(seq_k) if not causal
+          else (qi + 1) * jnp.int32(block_q))
+    nblocks = pl.cdiv(hi, jnp.int32(block_k))
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_idx = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nblocks, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, block_k: int, seq_q: int):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+
+    lo = (jnp.int32(0) if not causal
+          else (ki * jnp.int32(block_k)) // jnp.int32(block_q))
+    nblocks = pl.cdiv(jnp.int32(seq_q), jnp.int32(block_q))
+
+    def body(i, carry):
+        dk, dv = carry
+
+        def compute(carry):
+            dk, dv = carry
+            q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+                jnp.float32) * scale
+            do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
+                jnp.float32)
+            lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+            delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+            s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
+            if causal:
+                q_idx = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 0)
+                k_idx = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, bk), 1)
+                s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+            p = jnp.exp(s - lse)                      # [BQ, BK]
+            dv_new = dv + jnp.dot(p.T, do_blk,
+                                  preferred_element_type=jnp.float32)
+            dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            dk_new = dk + jnp.dot(ds.T, q_blk,
+                                  preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        if causal:
+            return jax.lax.cond(i >= lo, compute, lambda c: c, carry)
+        return compute(carry)
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nblocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)   # note: q already carried `scale`
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool,
+               block_q: int, block_k: int, interpret: bool):
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)                           # [BH, SQ]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=(bh, pl.cdiv(sq, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq),
+        grid=(bh, pl.cdiv(sk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper (jnp level — the tape's jax.vjp picks this up)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_bhsd(q, k, v, scale: float, causal: bool,
+                         block_q: int = DEFAULT_BLOCK_Q,
+                         block_k: int = DEFAULT_BLOCK_K,
+                         interpret: bool = False):
+    """Flash attention over [B*H, S, D] tensors."""
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+flash_attention_bhsd.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (used by tests and as the non-TPU fallback path)
+# ---------------------------------------------------------------------------
+
+def reference_attention_bhsd(q, k, v, scale: float, causal: bool):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

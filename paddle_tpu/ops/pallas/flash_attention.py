@@ -1,0 +1,62 @@
+"""Tensor-level Pallas flash attention op.
+
+Bridges the raw kernels (paddle_tpu.ops.flash_attention) into the tape:
+the jnp-level function carries a jax.custom_vjp, so ``call_op``'s
+``jax.vjp`` automatically uses the hand-written flash backward.
+
+Layout: paddle flash layout [B, S, H, D] (ref: python/paddle/nn/
+functional/flash_attention.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+from ...flags import get_flag
+from ..flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+                               flash_attention_bhsd)
+
+
+def available() -> bool:
+    if not get_flag("use_pallas_attention"):
+        return False
+    if get_flag("pallas_interpret"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def supports(sq: int, sk: int, d: int, causal: bool) -> bool:
+    """Shape gate: the kernel's pl.ds loads clamp out-of-range blocks, so
+    non-multiple-of-block sequences would silently double-count keys; the
+    causal mask uses the top-left convention, valid only when sq == sk."""
+    bq = min(DEFAULT_BLOCK_Q, sq)
+    bk = min(DEFAULT_BLOCK_K, sk)
+    if sq % bq or sk % bk:
+        return False
+    if causal and sq != sk:
+        return False
+    return d % 8 == 0
+
+
+def pallas_flash_attention(query, key, value, causal: bool = False,
+                           scale=None):
+    """query/key/value: Tensors [B, S, H, D] → Tensor [B, S, H, D]."""
+    interpret = bool(get_flag("pallas_interpret"))
+
+    def f(q, k, v):
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+        sc = scale if scale is not None else 1.0 / math.sqrt(d)
+        qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+        kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+        vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+        # custom_vjp requires positional args (nondiff_argnums)
+        out = flash_attention_bhsd(qt, kt, vt, sc, causal,
+                                   DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                   interpret)
+        return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+    return call_op(f, (query, key, value), {}, op_name="flash_attention")

@@ -1,0 +1,157 @@
+"""Ring attention — context parallelism over a mesh axis.
+
+TPU-native implementation of the reference's ring/context-parallel flash
+attention (ref: RingFlashAttention paths in auto_parallel/incubate, see
+SURVEY.md §2.3 CP row; technique per the blockwise/ring attention papers
+in PAPERS.md).
+
+Per-rank SPMD (inside shard_map over ``axis_name``): the sequence is
+sharded; each rank keeps its Q block resident and rotates the K/V blocks
+around the ICI ring with ``lax.ppermute``, merging per-chunk flash
+results via logsumexp weights.  Backward runs a second ring pass: dq
+accumulates locally against each visiting K/V chunk, while dk/dv ride the
+ring with their chunk and arrive home after n steps — both computed with
+the SAME Pallas flash backward kernels, fed the GLOBAL lse (which turns
+per-chunk exp(s - lse) into true global softmax probabilities).
+
+Causal convention: rank r owns global positions [r*S_local, (r+1)*S_local).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, NEG_INF,
+                              _flash_bwd, _flash_fwd)
+
+
+def _chunk_fwd(q, k, v, scale, q_off, kv_off, causal, interpret):
+    """Attention of local q against one visiting kv chunk.
+    Returns (out, lse) with lse=-inf where the chunk is fully masked."""
+    if not causal:
+        return _flash_fwd(q, k, v, scale, False, DEFAULT_BLOCK_Q,
+                          DEFAULT_BLOCK_K, interpret)
+
+    def diagonal(_):
+        return _flash_fwd(q, k, v, scale, True, DEFAULT_BLOCK_Q,
+                          DEFAULT_BLOCK_K, interpret)
+
+    def full(_):
+        return _flash_fwd(q, k, v, scale, False, DEFAULT_BLOCK_Q,
+                          DEFAULT_BLOCK_K, interpret)
+
+    def masked(_):
+        bh, sq, d = q.shape
+        return (jnp.zeros((bh, sq, d), q.dtype),
+                jnp.full((bh, sq), NEG_INF, jnp.float32))
+
+    # kv_off > q_off → fully masked; == → diagonal causal; < → full
+    branch = jnp.where(kv_off > q_off, 0, jnp.where(kv_off == q_off, 1, 2))
+    return jax.lax.switch(branch, [masked, diagonal, full], None)
+
+
+def ring_attention_fwd(q, k, v, axis_name: str, scale: float,
+                       causal: bool = True, interpret: bool = False):
+    """q, k, v: per-rank [B*H, S_local, D].  Returns (out, lse_global)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        out, lse, kc, vc = carry
+        kv_rank = (idx - i) % n
+        o_i, lse_i = _chunk_fwd(q, kc, vc, scale, idx * s_local,
+                                kv_rank * s_local, causal, interpret)
+        # merge (out, lse) with (o_i, lse_i)
+        m = jnp.maximum(lse, lse_i)
+        # guard -inf - -inf
+        w0 = jnp.exp(jnp.where(lse == NEG_INF, NEG_INF, lse - m))
+        w1 = jnp.exp(jnp.where(lse_i == NEG_INF, NEG_INF, lse_i - m))
+        denom = jnp.maximum(w0 + w1, 1e-30)
+        out = (out * (w0 / denom)[..., None].astype(out.dtype)
+               + o_i * (w1 / denom)[..., None].astype(out.dtype))
+        lse = m + jnp.log(denom)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return out, lse, kc, vc
+
+    bh, sq, d = q.shape
+    out0 = jnp.zeros((bh, sq, d), q.dtype)
+    lse0 = jnp.full((bh, sq), NEG_INF, jnp.float32)
+    out, lse, _, _ = jax.lax.fori_loop(0, n, body, (out0, lse0, k, v))
+    return out, lse
+
+
+def _chunk_bwd(q, k, v, out, lse, do, scale, q_off, kv_off, causal,
+               interpret):
+    """(dq, dk, dv) for one q-block/kv-chunk pair under the global lse."""
+    def masked(_):
+        return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
+
+    def diagonal(_):
+        return _flash_bwd(q, k, v, out, lse, do, scale, True,
+                          DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+
+    def full(_):
+        return _flash_bwd(q, k, v, out, lse, do, scale, False,
+                          DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+
+    if not causal:
+        return full(None)
+    branch = jnp.where(kv_off > q_off, 0, jnp.where(kv_off == q_off, 1, 2))
+    return jax.lax.switch(branch, [masked, diagonal, full], None)
+
+
+def ring_attention_bwd(q, k, v, out, lse, do, axis_name: str, scale: float,
+                       causal: bool = True, interpret: bool = False):
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        dq, dk, dv, kc, vc = carry
+        kv_rank = (idx - i) % n
+        dq_i, dk_i, dv_i = _chunk_bwd(q, kc, vc, out, lse, do, scale,
+                                      idx * s_local, kv_rank * s_local,
+                                      causal, interpret)
+        dq = dq + dq_i
+        # dk/dv ride the ring WITH their kv chunk so they stay aligned
+        dk = dk + dk_i
+        dv = dv + dv_i
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return dq, dk, dv, kc, vc
+
+    dq0 = jnp.zeros_like(q)
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    dq, dk, dv, _, _ = jax.lax.fori_loop(0, n, body, (dq0, dk0, dv0, k, v))
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_bhsd(q, k, v, axis_name: str, scale: float,
+                        causal: bool = True, interpret: bool = False):
+    out, _ = ring_attention_fwd(q, k, v, axis_name, scale, causal, interpret)
+    return out
+
+
+def _ra_fwd(q, k, v, axis_name, scale, causal, interpret):
+    out, lse = ring_attention_fwd(q, k, v, axis_name, scale, causal,
+                                  interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ra_bwd(axis_name, scale, causal, interpret, res, do):
+    q, k, v, out, lse = res
+    return ring_attention_bwd(q, k, v, out, lse, do, axis_name, scale,
+                              causal, interpret)
+
+
+ring_attention_bhsd.defvjp(_ra_fwd, _ra_bwd)

@@ -1,0 +1,45 @@
+"""DeepSpeed-Ulysses style sequence parallelism (sep axis).
+
+TPU-native implementation of the reference's SEP segment-parallel
+attention (ref: fleet/meta_parallel/segment_parallel.py + sep axis in
+topology.py): inside shard_map over the sep axis, an all-to-all trades the
+sharded sequence dim for a sharded heads dim, runs full-sequence attention
+on the local heads, and an inverse all-to-all restores sequence sharding.
+On TPU the all-to-alls ride the ICI all-to-all primitive.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import flash_attention_bhsd, DEFAULT_BLOCK_Q, \
+    DEFAULT_BLOCK_K
+
+
+def _seq_to_heads(x, axis_name):
+    """[B, S/n, H, D] → [B, S, H/n, D] via all-to-all."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _heads_to_seq(x, axis_name):
+    """[B, S, H/n, D] → [B, S/n, H, D]."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str, scale: float,
+                      causal: bool = True, interpret: bool = False):
+    """Per-rank q/k/v: [B, S_local, H, D] (sequence sharded over sep).
+    Heads must be divisible by the sep degree."""
+    qg = _seq_to_heads(q, axis_name)
+    kg = _seq_to_heads(k, axis_name)
+    vg = _seq_to_heads(v, axis_name)
+    b, s, h, d = qg.shape
+    qt = jnp.swapaxes(qg, 1, 2).reshape(b * h, s, d)
+    kt = jnp.swapaxes(kg, 1, 2).reshape(b * h, s, d)
+    vt = jnp.swapaxes(vg, 1, 2).reshape(b * h, s, d)
+    out = flash_attention_bhsd(qt, kt, vt, scale, causal,
+                               DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+    out = jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    return _heads_to_seq(out, axis_name)
