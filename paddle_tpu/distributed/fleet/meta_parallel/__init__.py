@@ -7,3 +7,4 @@ from .parallel_layers import (VocabParallelEmbedding, ColumnParallelLinear,
 from .tensor_parallel import TensorParallel, SegmentParallel, MetaParallelBase
 from .pipeline_parallel import PipelineParallel
 from . import sharding
+from .pp_spmd import PipelineSpmdStep, gpt_pipeline_step, stack_params

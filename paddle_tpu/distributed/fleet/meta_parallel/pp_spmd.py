@@ -1,0 +1,365 @@
+"""SPMD pipeline parallelism — the compiled schedule driver.
+
+TPU-native re-design of ref: fleet/meta_parallel/pipeline_parallel.py +
+pp_utils/p2p_communication.py (NCCL 1F1B) and the PIR
+pipeline_scheduler_pass schedules.
+
+Design (the shard_map pipelining pattern, cf. the scaling-book recipe):
+the L homogeneous transformer blocks are grouped into P stages; their
+parameters are STACKED on a leading axis sharded over the ``pp`` mesh
+axis, so each rank holds its stage's blocks.  The microbatch loop runs
+M + P - 1 ticks; each tick every rank runs its stage on its in-flight
+microbatch, then the activations ``ppermute`` one hop along the ring.
+Stage 0 injects fresh microbatches (pre_fn: embedding), the last stage
+drains them (post_fn: head + loss) — both behind per-rank ``lax.cond``
+so inner stages skip that work at runtime.  The whole loop is
+DIFFERENTIABLE — ``jax.grad`` through shard_map transposes the
+ppermutes, so the backward pass is automatically the reversed pipeline
+(the 1F1B interleave falls out of XLA's latency-hiding scheduler rather
+than a hand-written schedule), with ``jax.checkpoint`` on the stage body
+bounding activation memory.
+
+Replicated parameters (embeddings/head/final-ln — incl. weights TIED
+across the first and last stage, which the reference handles with a
+shared-embedding broadcast group) are passed to both pre_fn and post_fn;
+their gradients arrive summed over all uses automatically.
+
+Requirements (as in the reference's practical use): homogeneous blocks,
+L % P == 0, M >= P microbatches.
+"""
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+
+
+def stack_params(param_lists: Sequence[Sequence[jnp.ndarray]]):
+    """[[block0 params], [block1 params], ...] → list of stacked [L, ...]
+    arrays, one per param position."""
+    n = len(param_lists[0])
+    for pl_ in param_lists:
+        if len(pl_) != n:
+            raise ValueError("pipeline blocks are not homogeneous")
+    return [jnp.stack([pl_[i] for pl_ in param_lists], axis=0)
+            for i in range(n)]
+
+
+def pipeline_spmd_forward(pre_fn: Callable, block_fn: Callable,
+                          post_fn: Callable,
+                          rep_params, stacked_block_params,
+                          micro_inputs, micro_labels,
+                          axis_name: str = "pp",
+                          remat_blocks: bool = True):
+    """Pipelined forward INSIDE shard_map scope → mean loss on every rank.
+
+    - pre_fn(rep_params, x) -> activation          (stage 0)
+    - block_fn(block_params, h) -> h               (one homogeneous block)
+    - post_fn(rep_params, h, labels) -> scalar loss (last stage)
+    - stacked_block_params: leaves [L_local, ...]
+    - micro_inputs/labels: [M, mb, ...]
+    """
+    n_stage = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = micro_inputs.shape[0]
+    ticks = m + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    bfn = jax.checkpoint(block_fn) if remat_blocks else block_fn
+
+    def stage_body(h):
+        def scan_fn(carry, params_i):
+            return bfn(params_i, carry), None
+        out, _ = jax.lax.scan(scan_fn, h, stacked_block_params)
+        return out
+
+    h0 = pre_fn(rep_params, micro_inputs[0])
+    act_shape, act_dtype = h0.shape, h0.dtype
+
+    def tick(t, carry):
+        recv, loss_sum, nloss = carry
+        inj_idx = jnp.clip(t, 0, m - 1)
+
+        def inject(_):
+            return pre_fn(rep_params, jax.lax.dynamic_index_in_dim(
+                micro_inputs, inj_idx, axis=0, keepdims=False)
+            ).astype(act_dtype)
+
+        h_in = jax.lax.cond(idx == 0, inject, lambda _: recv, None)
+        h_out = stage_body(h_in)
+
+        out_idx = jnp.clip(t - (n_stage - 1), 0, m - 1)
+        valid = jnp.logical_and(t >= n_stage - 1, idx == n_stage - 1)
+
+        def drain(_):
+            labels_t = jax.lax.dynamic_index_in_dim(
+                micro_labels, out_idx, axis=0, keepdims=False)
+            return post_fn(rep_params, h_out, labels_t).astype(jnp.float32)
+
+        mb_loss = jax.lax.cond(valid, drain, lambda _: jnp.zeros((), jnp.float32),
+                               None)
+        loss_sum = loss_sum + mb_loss
+        nloss = nloss + jnp.where(valid, 1.0, 0.0)
+        recv = jax.lax.ppermute(h_out, axis_name, perm)
+        return recv, loss_sum, nloss
+
+    recv0 = jnp.zeros(act_shape, act_dtype)
+    carry = (recv0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    recv, loss_sum, nloss = jax.lax.fori_loop(0, ticks, tick, carry)
+    total = jax.lax.psum(loss_sum, axis_name)
+    count = jax.lax.psum(nloss, axis_name)
+    return total / jnp.maximum(count, 1.0)
+
+
+class PipelineSpmdStep:
+    """Compiled pp(+dp) train step.
+
+    ``rep_params`` (Tensors) are replicated across stages; the stacked
+    block parameters (synthetic [L, ...] Tensors) are pp-sharded and
+    registered with the optimizer, so optimizer state is sharded along
+    the pp axis with them."""
+
+    def __init__(self, pre_fn, block_fn, post_fn, rep_params: List[Tensor],
+                 block_param_stacks: List[Tensor], optimizer, mesh: Mesh,
+                 n_micro: int, axis_name: str = "pp", dp_axes=("dp",),
+                 remat_blocks: bool = True, sync_fn: Optional[Callable] = None):
+        self.pre_fn, self.block_fn, self.post_fn = pre_fn, block_fn, post_fn
+        self.rep_params = rep_params
+        self.block_stacks = block_param_stacks
+        # writes trained stack values back into the source model's own
+        # block parameters (so state_dict/eval see the trained weights)
+        self.sync_fn = sync_fn
+        self.optimizer = getattr(optimizer, "_inner_opt", optimizer)
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.axis = axis_name
+        self.dp_axes = tuple(a for a in dp_axes if mesh.shape.get(a, 1) > 1)
+        self.remat = remat_blocks
+        self._jitted = None
+
+    def _loss_fn(self, rep_v, blk_v, x_micro, y_micro):
+        axis = self.axis
+        dp = self.dp_axes
+
+        def spmd(rep_v, blk_v, xm, ym):
+            loss = pipeline_spmd_forward(
+                self.pre_fn, self.block_fn, self.post_fn,
+                rep_v, blk_v, xm, ym, axis_name=axis,
+                remat_blocks=self.remat)
+            if dp:
+                loss = jax.lax.pmean(loss, dp)
+            return loss
+
+        rep = P()
+        blk_spec = jax.tree.map(lambda _: P(axis), blk_v)
+        rep_spec = jax.tree.map(lambda _: rep, rep_v)
+        data_spec = P(None, dp if dp else None)
+        f = jax.shard_map(
+            spmd, mesh=self.mesh,
+            in_specs=(rep_spec, blk_spec, data_spec, data_spec),
+            out_specs=rep, check_vma=False)
+        return f(rep_v, blk_v, x_micro, y_micro)
+
+    def _make_step(self):
+        opt = self.optimizer
+        all_params = self.rep_params + self.block_stacks
+        n_rep = len(self.rep_params)
+
+        def step(state, lr, x_micro, y_micro):
+            vals = state["p"]
+            rep_v = vals[:n_rep]
+            blk_v = vals[n_rep:]
+            loss, grads = jax.value_and_grad(
+                self._loss_fn, argnums=(0, 1))(rep_v, blk_v,
+                                               x_micro, y_micro)
+            flat_grads = list(grads[0]) + list(grads[1])
+            opt._accumulators = defaultdict(
+                dict, {n: dict(v) for n, v in state["o"]["acc"].items()})
+            opt._master_weights = dict(state["o"]["master"])
+            opt._lr_override = lr
+            try:
+                for p, v, g in zip(all_params, vals, flat_grads):
+                    p._data = v
+                    p._grad = Tensor(g)
+                    p._grad_node = None
+                opt.step()
+                new_vals = [p._data for p in all_params]
+                new_opt = {"acc": {n: dict(s) for n, s in
+                                   opt._accumulators.items()},
+                           "master": dict(opt._master_weights)}
+            finally:
+                opt._lr_override = None
+                for p in all_params:
+                    p._grad = None
+            return {"p": new_vals, "o": new_opt}, loss
+
+        return step
+
+    def _shardings(self, state):
+        rep = NamedSharding(self.mesh, P())
+        n_rep = len(self.rep_params)
+        pp = NamedSharding(self.mesh, P(self.axis))
+
+        def p_shard(i):
+            return pp if i >= n_rep else rep
+
+        p_sh = [p_shard(i) for i in range(len(state["p"]))]
+        all_params = self.rep_params + self.block_stacks
+        by_key = {}
+        for i, p in enumerate(all_params):
+            by_key[p.name if p.name else f"param_{i}"] = (p, p_shard(i))
+
+        def acc_sharding(k, arr):
+            ent = by_key.get(k)
+            # scalar accumulators (beta powers) and shape-mismatched
+            # states stay replicated
+            if ent is not None and hasattr(arr, "shape") and \
+                    tuple(arr.shape) == tuple(ent[0]._data.shape):
+                return ent[1]
+            return rep
+
+        o_sh = {"acc": {n: {k: acc_sharding(k, v) for k, v in s.items()}
+                        for n, s in state["o"]["acc"].items()},
+                "master": {k: acc_sharding(k, v)
+                           for k, v in state["o"]["master"].items()}}
+        return {"p": p_sh, "o": o_sh}
+
+    def __call__(self, inputs, labels):
+        x = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        y = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        m = self.n_micro
+        b = x.shape[0]
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by {m} microbatches")
+        x = x.reshape((m, b // m) + x.shape[1:])
+        y = y.reshape((m, b // m) + y.shape[1:])
+
+        all_params = self.rep_params + self.block_stacks
+        state = {"p": [p._data for p in all_params],
+                 "o": {"acc": {n: dict(s) for n, s in
+                               self.optimizer._accumulators.items()},
+                       "master": dict(self.optimizer._master_weights)}}
+        key = tuple(sorted(state["o"]["acc"]))
+        if self._jitted is None or self._jitted[0] != key:
+            step = self._make_step()
+            sh = self._shardings(state)
+            rep = NamedSharding(self.mesh, P())
+            kw = {"in_shardings": (sh, rep, rep, rep),
+                  "donate_argnums": (0,)}
+            if state["o"]["acc"]:
+                kw["out_shardings"] = (sh, rep)
+            self._jitted = (key, jax.jit(step, **kw))
+            # reshard committed arrays (born on another mesh) explicitly
+            state = jax.device_put(state, sh)
+        lr = jnp.asarray(self._lr(), jnp.float32)
+        new_state, loss = self._jitted[1](state, lr, x, y)
+        for p, v in zip(all_params, new_state["p"]):
+            p._data = v
+        self.optimizer._accumulators = defaultdict(
+            dict, {n: dict(v) for n, v in new_state["o"]["acc"].items()})
+        self.optimizer._master_weights = dict(new_state["o"]["master"])
+        if self.sync_fn is not None:
+            self.sync_fn()
+        return Tensor(loss)
+
+    def _lr(self) -> float:
+        from ....optimizer.lr import LRScheduler
+        lr = self.optimizer._learning_rate
+        return float(lr()) if isinstance(lr, LRScheduler) else float(lr)
+
+
+# ---------------------------------------------------------------------------
+# GPT adapter — pipeline step for the flagship model
+# ---------------------------------------------------------------------------
+
+def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
+                      axis_name: str = "pp", dp_axes=("dp", "sharding"),
+                      remat_blocks: bool = True) -> PipelineSpmdStep:
+    """Build a PipelineSpmdStep from a GPTForPretraining model.
+
+    Stage split: pre = embeddings (stage 0), blocks = the L GPTBlocks
+    (stacked over pp), post = final_ln + tied head + CE (last stage).
+    """
+    from ....core.autograd_state import no_grad
+    from ....models.gpt import GPTForPretraining
+
+    gpt = model.gpt
+    cfg = model.config
+    if cfg.hidden_dropout_prob or cfg.attention_dropout_prob:
+        # the pipeline step does not thread per-tick dropout RNG yet;
+        # refuse rather than silently train without dropout
+        raise ValueError(
+            "gpt_pipeline_step requires hidden_dropout_prob == "
+            "attention_dropout_prob == 0 (dropout RNG threading through "
+            "the pipeline ring is not implemented)")
+    blocks = list(gpt.layers)
+    template = blocks[0]
+    t_params = template.parameters()
+
+    stacks = stack_params([[p._data for p in blk.parameters()]
+                           for blk in blocks])
+    stack_tensors = []
+    for i, arr in enumerate(stacks):
+        t = Tensor(arr, stop_gradient=False)
+        t.name = f"pp_block_stack_{i}"
+        stack_tensors.append(t)
+
+    emb_w = gpt.embeddings.word_embeddings.weight
+    pos_w = gpt.embeddings.position_embeddings.weight
+    ln_w, ln_b = gpt.final_ln.parameters()
+    rep_tensors = [emb_w, pos_w, ln_w, ln_b]
+    for i, p in enumerate(rep_tensors):
+        if not p.name:
+            p.name = f"pp_rep_{i}"
+
+    def pre_fn(rep_v, ids):
+        emb, pos = rep_v[0], rep_v[1]
+        h = jnp.take(emb, ids, axis=0)
+        h = h + pos[:ids.shape[-1]][None, :, :]
+        return h
+
+    def block_fn(params_i, h):
+        # dropout is 0 by contract (checked above), so the training flag
+        # is irrelevant — don't flip it on the real model's layer 0
+        with no_grad():
+            for p, v in zip(t_params, params_i):
+                p._data = v
+            out = template(Tensor(h))
+        return out._data
+
+    def post_fn(rep_v, h, labels):
+        emb, _, lw, lb = rep_v
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        hn = (h - mu) * jax.lax.rsqrt(var + 1e-5) * lw + lb
+        logits = jnp.einsum("bsh,vh->bsv", hn, emb)
+        logits = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels != -100).astype(jnp.float32)
+        loss = (lse - ll) * mask
+        return loss.sum() / jnp.maximum(mask.sum(), 1.0)
+
+    opt = getattr(optimizer, "_inner_opt", optimizer)
+    opt._append_params(rep_tensors + stack_tensors)
+
+    def sync_to_model():
+        # unstack trained values back into the blocks' own Parameters so
+        # state_dict()/eval on the source model see the trained weights
+        for j, blk in enumerate(blocks):
+            for p, st in zip(blk.parameters(), stack_tensors):
+                p._data = st._data[j]
+
+    return PipelineSpmdStep(pre_fn, block_fn, post_fn, rep_tensors,
+                            stack_tensors, opt, mesh, n_micro,
+                            axis_name=axis_name, dp_axes=dp_axes,
+                            remat_blocks=remat_blocks,
+                            sync_fn=sync_to_model)

@@ -250,6 +250,10 @@ class TrainStep:
                 # let GSPMD infer; steady state pins the layouts
                 if state["o"]["acc"] or self.optimizer is None:
                     kw["out_shardings"] = (st_sh, self._named_sharding(()))
+                # jit refuses committed args with mismatched shardings
+                # (e.g. state arrays born on a previous mesh) — place them
+                # explicitly on the first call with this structure
+                state = jax.device_put(state, st_sh)
             if self._donate:
                 kw["donate_argnums"] = (0,)
             fn = jax.jit(step, **kw)
@@ -264,7 +268,11 @@ class TrainStep:
         self._install_opt_state(new_state["o"])
         if self.scaler is not None:
             self.scaler._set_state_arrays(new_state["s"])
-        default_generator.set_state(new_state["rng"])
+        # decommit the key from this step's mesh — otherwise every later
+        # random init (jax.random.split chains shardings) is pinned to it.
+        # device_put avoids the host round-trip sync np.asarray would force.
+        default_generator.set_state(
+            jax.device_put(new_state["rng"], jax.devices()[0]))
         return Tensor(loss)
 
 

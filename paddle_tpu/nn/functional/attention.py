@@ -37,8 +37,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             try:
                 return _pfa.pallas_flash_attention(query, key, value,
                                                    causal=is_causal)
-            except Exception:
-                pass  # Mosaic lowering failure → XLA fallback below
+            except Exception as e:
+                # eager-mode Mosaic failures fall back to XLA — loudly,
+                # so real wrapper bugs aren't silently masked.  (Under an
+                # enclosing jit, lowering errors surface at compile time
+                # and propagate regardless.)
+                import warnings
+                warnings.warn(
+                    f"pallas flash attention failed ({type(e).__name__}: "
+                    f"{e}); falling back to the XLA path", RuntimeWarning)
     if has_mask:
         args.append(ensure_tensor(attn_mask))
     drop_key = next_key() if (dropout_p > 0.0 and training) else None

@@ -135,14 +135,29 @@ def ring_attention_bwd(q, k, v, out, lse, do, axis_name: str, scale: float,
     return dq, dk, dv
 
 
+def _check_ring_shapes(q, k):
+    """The flash kernels clamp out-of-range pl.ds loads, so misaligned
+    shapes would silently double-count keys — reject them loudly."""
+    s_local = q.shape[1]
+    bq = min(DEFAULT_BLOCK_Q, s_local)
+    bk = min(DEFAULT_BLOCK_K, k.shape[1])
+    if s_local % bq or k.shape[1] % bk:
+        raise ValueError(
+            f"ring attention needs per-rank sequence lengths aligned to "
+            f"the flash block size ({DEFAULT_BLOCK_Q}); got q={s_local}, "
+            f"k={k.shape[1]}")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_attention_bhsd(q, k, v, axis_name: str, scale: float,
                         causal: bool = True, interpret: bool = False):
+    _check_ring_shapes(q, k)
     out, _ = ring_attention_fwd(q, k, v, axis_name, scale, causal, interpret)
     return out
 
 
 def _ra_fwd(q, k, v, axis_name, scale, causal, interpret):
+    _check_ring_shapes(q, k)
     out, lse = ring_attention_fwd(q, k, v, axis_name, scale, causal,
                                   interpret)
     return out, (q, k, v, out, lse)

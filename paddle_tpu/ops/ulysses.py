@@ -32,6 +32,17 @@ def ulysses_attention(q, k, v, axis_name: str, scale: float,
                       causal: bool = True, interpret: bool = False):
     """Per-rank q/k/v: [B, S_local, H, D] (sequence sharded over sep).
     Heads must be divisible by the sep degree."""
+    n = jax.lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the sep "
+            f"degree ({n})")
+    s_global = q.shape[1] * n
+    bq = min(DEFAULT_BLOCK_Q, s_global)
+    if s_global % bq:
+        raise ValueError(
+            f"ulysses needs the global sequence ({s_global}) aligned to "
+            f"the flash block size ({DEFAULT_BLOCK_Q})")
     qg = _seq_to_heads(q, axis_name)
     kg = _seq_to_heads(k, axis_name)
     vg = _seq_to_heads(v, axis_name)
