@@ -51,9 +51,21 @@ from .tensor import einsum
 from .tensor.creation import create_parameter
 from .tensor.search import topk, where, nonzero, argmax, argmin, argsort, sort
 
+# static mode toggles (ref: paddle.enable_static/disable_static)
+def enable_static():
+    from . import static as _static
+    _static.enable_static()
+
+
+def disable_static():
+    from . import static as _static
+    _static.disable_static()
+
+
 # static check helpers
 def in_dynamic_mode() -> bool:
-    return True
+    from .static import in_static_mode as _ism
+    return not _ism()
 
 
 def in_static_mode() -> bool:

@@ -36,6 +36,10 @@ def _is_float_dtype(d) -> bool:
 # installed by paddle_tpu.amp: (op_name, arrays) -> arrays with AMP casts
 _amp_hook = None
 
+# installed by paddle_tpu.static: records every executed op into the
+# program being captured (fn, kwargs, in_tensors, out_tensors, multi, name)
+_op_observer = None
+
 
 class GradNode:
     """One recorded op on the tape."""
@@ -111,7 +115,12 @@ def call_op(fn: Callable, tensor_args: Sequence[Tensor],
             _check_numerics(op_name or getattr(fn, "__name__", "op"), outs)
         if get_flag("benchmark"):
             _sync(outs)
-        return _wrap_outputs(outs, multi_out, None, True)
+        wrapped = _wrap_outputs(outs, multi_out, None, True)
+        if _op_observer is not None:
+            _op_observer(fn, kwargs, tensor_args,
+                         list(wrapped) if multi_out else [wrapped],
+                         multi_out, op_name)
+        return wrapped
 
     f = lambda *xs: fn(*xs, **kwargs)
     outs, vjp_fn = jax.vjp(f, *arrays)
@@ -123,7 +132,12 @@ def call_op(fn: Callable, tensor_args: Sequence[Tensor],
         _check_numerics(node.op_name, outs)
     if get_flag("benchmark"):
         _sync(outs)
-    return _wrap_outputs(outs, multi_out, node, False)
+    wrapped = _wrap_outputs(outs, multi_out, node, False)
+    if _op_observer is not None:
+        _op_observer(fn, kwargs, tensor_args,
+                     list(wrapped) if multi_out else [wrapped],
+                     multi_out, op_name)
+    return wrapped
 
 
 def _sync(outs):

@@ -1,6 +1,12 @@
-"""paddle.jit (ref: python/paddle/jit/) — to_static ≅ jax.jit.
-
-train_step.py is the SPMD engine; to_static/save/load land with the
-dy2static stage (SURVEY.md §7 stage 3).
-"""
+"""paddle.jit (ref: python/paddle/jit/) — to_static ≅ jax.jit; the saved
+artifact is serialized StableHLO (jax.export)."""
+from .to_static import (to_static, not_to_static, ignore_module,
+                        enable_to_static, StaticFunction, InputSpec)
+from .save_load import save, load, TranslatedLayer
 from .train_step import TrainStep, train_step
+
+
+class api:  # ref module path paddle.jit.api
+    to_static = to_static
+    save = save
+    load = load

@@ -1,0 +1,140 @@
+"""paddle.jit.save / load — deployment artifacts.
+
+TPU-native re-design of ref: python/paddle/jit/api.py save/load +
+static/io.py.  The saved artifact is a serialized StableHLO export
+(jax.export) — the PIR ``__model__`` equivalent, runnable by any PJRT
+runtime — plus the pickled state_dict (``.pdiparams``).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .to_static import InputSpec, StaticFunction
+
+
+def _example_arrays(input_spec):
+    """Build jax.export example args; None/-1 dims become SYMBOLIC
+    dimensions (shared scope), preserving the dynamic-batch contract of
+    InputSpec([None, 8])."""
+    from jax import export as jexport
+    from .. import dtype as dtypes
+    arrays = []
+    scope = None
+    sym_count = [0]
+
+    def dim_str(s):
+        if s is None or int(s) < 0:
+            sym_count[0] += 1
+            return f"_d{sym_count[0]}"
+        return str(int(s))
+
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = spec.shape or [1]
+            if any(s is None or int(s) < 0 for s in shape):
+                expr = ",".join(dim_str(s) for s in shape)
+                if scope is None:
+                    sym = jexport.symbolic_shape(expr)
+                    scope = sym[0].scope if hasattr(sym[0], "scope") else None
+                else:
+                    sym = jexport.symbolic_shape(expr, scope=scope)
+                arrays.append(jax.ShapeDtypeStruct(
+                    tuple(sym), dtypes.to_jax(spec.dtype)))
+            else:
+                arrays.append(jnp.zeros([int(s) for s in shape],
+                                        dtypes.to_jax(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            arrays.append(spec._data)
+        else:
+            arrays.append(jnp.asarray(np.asarray(spec)))
+    return arrays
+
+
+def save(layer, path: str, input_spec=None, **configs):
+    """ref: paddle.jit.save."""
+    from jax import export as jexport
+    if not isinstance(layer, Layer):
+        raise TypeError("paddle.jit.save expects a Layer")
+    fwd = layer.forward
+    fn = fwd._function if isinstance(fwd, StaticFunction) else fwd
+    params = []
+    seen = set()
+    for p in list(layer.parameters()) + list(layer.buffers()):
+        if id(p) not in seen:
+            seen.add(id(p))
+            params.append(p)
+    if input_spec is None:
+        raise ValueError("paddle.jit.save needs input_spec on this build")
+    example = _example_arrays(input_spec)
+
+    was_training = layer.training
+    layer.eval()
+    out_tree = {}
+
+    def pure(param_arrays, *input_arrays):
+        saved = [p._data for p in params]
+        for p, v in zip(params, param_arrays):
+            p._data = v
+        try:
+            out = fn(*[Tensor(a) for a in input_arrays])
+        finally:
+            for p, v in zip(params, saved):
+                p._data = v
+        if isinstance(out, (list, tuple)):
+            out_tree["multi"] = True
+            return tuple(o._data for o in out)
+        out_tree["multi"] = False
+        return (out._data,)
+
+    exported = jexport.export(jax.jit(pure))(
+        tuple(p._data for p in params), *example)
+    if was_training:
+        layer.train()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    from ..framework.io import save as psave
+    psave({"params": [np.asarray(p._data) for p in params],
+           "multi": out_tree.get("multi", False)},
+          path + ".pdiparams")
+
+
+class TranslatedLayer(Layer):
+    """ref: jit/translated_layer.py — a loaded deployment artifact."""
+
+    def __init__(self, exported, params: List[jnp.ndarray], multi: bool):
+        super().__init__()
+        self._exported = exported
+        self._param_arrays = tuple(params)
+        self._multi = multi
+
+    def forward(self, *inputs):
+        arrays = tuple(i._data if isinstance(i, Tensor)
+                       else jnp.asarray(np.asarray(i)) for i in inputs)
+        outs = self._exported.call(self._param_arrays, *arrays)
+        tensors = tuple(Tensor(o) for o in outs)
+        if self._multi:
+            return tensors
+        return tensors[0]
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    """ref: paddle.jit.load."""
+    from jax import export as jexport
+    from ..framework.io import load as pload
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    meta = pload(path + ".pdiparams")
+    params = [jnp.asarray(a) for a in meta["params"]]
+    return TranslatedLayer(exported, params, bool(meta.get("multi")))

@@ -1,0 +1,307 @@
+"""@paddle.jit.to_static — dy2static over jax.jit.
+
+TPU-native re-design of ref: python/paddle/jit/api.py +
+jit/dy2static/program_translator.py + jit/sot/ (~80k LoC).  The reference
+needs AST rewriting / bytecode capture because its graph IR cannot run
+python; here the eager machinery itself runs under jax tracing, so
+"to static" is: trace once per (shapes, dtypes, tree-structure) guard
+into a compiled XLA executable — the SOT design's guard/fallback
+semantics with the tracer doing the capture.
+
+Training works through the tape: the compiled forward is recorded as ONE
+tape op whose VJP is jax's (compiled) VJP of the traced function.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..random_state import default_generator
+
+
+class InputSpec:
+    """ref: paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient: bool = True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _leaf_sig(x):
+    if isinstance(x, Tensor):
+        return ("T", tuple(x.shape), str(x.dtype))
+    if isinstance(x, (np.ndarray, jnp.ndarray, jax.Array)):
+        return ("A", tuple(x.shape), str(x.dtype))
+    return ("C", repr(x))
+
+
+def _signature(args, kwargs, training: bool):
+    def walk(o):
+        if isinstance(o, (list, tuple)):
+            return tuple(walk(i) for i in o)
+        if isinstance(o, dict):
+            return tuple((k, walk(o[k])) for k in sorted(o))
+        return _leaf_sig(o)
+    return (walk(args), walk(kwargs), training)
+
+
+class StaticFunction:
+    """The compiled-callable wrapper (ref: program_translator.py
+    StaticFunction).  Guards on input shapes/dtypes/structure; falls back
+    to eager (graph break) when tracing fails."""
+
+    def __init__(self, function: Callable, input_spec=None,
+                 build_strategy=None, layer: Optional[Layer] = None,
+                 full_graph: bool = True):
+        functools.update_wrapper(self, function)
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache = {}
+        self._broken = False
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    # -- bound-method protocol (to_static on Layer.forward) -------------
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        # one bound wrapper (and thus one compile cache) per instance
+        cache = getattr(instance, "__dict__", None)
+        if cache is not None:
+            key = f"__static_fn_{self.__name__}"
+            bound = cache.get(key)
+            if bound is not None:
+                return bound
+        bound = StaticFunction(self._function.__get__(instance, owner),
+                               self._input_spec, layer=instance)
+        if cache is not None:
+            cache[key] = bound
+        return bound
+
+    @property
+    def _params(self) -> List[Tensor]:
+        layer = self._layer
+        if layer is None:
+            fn = self._function
+            layer = getattr(fn, "__self__", None)
+        if isinstance(layer, Layer):
+            seen, out = set(), []
+            for p in list(layer.parameters()) + list(layer.buffers()):
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    out.append(p)
+            return out
+        return []
+
+    def _build(self, args, kwargs, params, training):
+        """Trace the eager function into a pure jax fn of
+        (param_arrays, rng_key, *input_arrays)."""
+        tensor_slots: List[Tuple[str, Any]] = []
+
+        def strip(o):
+            if isinstance(o, Tensor):
+                tensor_slots.append(o)
+                return ("__slot__", len(tensor_slots) - 1)
+            if isinstance(o, (np.ndarray, jnp.ndarray, jax.Array)):
+                tensor_slots.append(Tensor(o))
+                return ("__slot__", len(tensor_slots) - 1)
+            if isinstance(o, (list, tuple)):
+                t = [strip(i) for i in o]
+                return tuple(t) if isinstance(o, tuple) else t
+            if isinstance(o, dict):
+                return {k: strip(v) for k, v in o.items()}
+            return o
+
+        s_args = strip(list(args))
+        s_kwargs = strip(dict(kwargs))
+        out_box = {}
+
+        def pure(param_arrays, key, *input_arrays):
+            saved = [p._data for p in params]
+            saved_key = default_generator.get_state()
+            default_generator.set_state(key)
+            for p, v in zip(params, param_arrays):
+                p._data = v
+
+            def rebuild(o):
+                if isinstance(o, tuple) and len(o) == 2 and \
+                        o[0] == "__slot__":
+                    src = tensor_slots[o[1]]
+                    t = Tensor(input_arrays[o[1]])
+                    t.stop_gradient = src.stop_gradient
+                    return t
+                if isinstance(o, list):
+                    return [rebuild(i) for i in o]
+                if isinstance(o, tuple):
+                    return tuple(rebuild(i) for i in o)
+                if isinstance(o, dict):
+                    return {k: rebuild(v) for k, v in o.items()}
+                return o
+
+            try:
+                out = self._function(*rebuild(s_args), **rebuild(s_kwargs))
+            finally:
+                for p, v in zip(params, saved):
+                    p._data = v
+                default_generator.set_state(saved_key)
+            leaves = []
+
+            def collect(o):
+                if isinstance(o, Tensor):
+                    leaves.append(o._data)
+                    return ("__out__", len(leaves) - 1)
+                if isinstance(o, (list, tuple)):
+                    t = [collect(i) for i in o]
+                    return tuple(t) if isinstance(o, tuple) else t
+                if isinstance(o, dict):
+                    return {k: collect(v) for k, v in o.items()}
+                return o
+
+            out_box["tree"] = collect(out)
+            return tuple(leaves)
+
+        # THE compile step: the traced python runs once per guard; later
+        # calls hit the XLA executable cache (ref: _ExecutorCache)
+        return jax.jit(pure), tensor_slots, out_box
+
+    def __call__(self, *args, **kwargs):
+        if self._broken or not _to_static_enabled:
+            return self._function(*args, **kwargs)
+        # canonical kwargs order: slot capture and the guard signature
+        # must agree, or same-shape calls with reordered kwargs would hit
+        # one cache entry with arrays bound to the wrong slots
+        kwargs = {k: kwargs[k] for k in sorted(kwargs)}
+        params = self._params
+        training = all(not isinstance(l, Layer) or l.training
+                       for l in [self._layer] if l is not None)
+        sig = _signature(args, kwargs, training)
+        entry = self._cache.get(sig)
+        if entry is None:
+            try:
+                pure, slots, out_box = self._build(args, kwargs, params,
+                                                   training)
+            except Exception as e:  # graph break → eager fallback
+                warnings.warn(
+                    f"to_static fallback to eager (graph break): {e}",
+                    RuntimeWarning)
+                self._broken = True
+                return self._function(*args, **kwargs)
+            entry = (pure, out_box)
+            self._cache[sig] = entry
+        pure, out_box = entry
+
+        # collect current input arrays in slot order
+        arrays = []
+
+        def collect_in(o):
+            if isinstance(o, Tensor):
+                arrays.append(o)
+            elif isinstance(o, (np.ndarray, jnp.ndarray, jax.Array)):
+                arrays.append(Tensor(o))
+            elif isinstance(o, (list, tuple)):
+                for i in o:
+                    collect_in(i)
+            elif isinstance(o, dict):
+                for k in o:
+                    collect_in(o[k])
+
+        collect_in(list(args))
+        collect_in(dict(kwargs))
+
+        key = default_generator.next_key()
+
+        def f(*xs):
+            n = len(params)
+            return pure(xs[:n], xs[n], *xs[n + 1:])
+
+        try:
+            outs = call_op(f, tuple(params) + (Tensor(key),) + tuple(arrays),
+                           {}, multi_out=True, op_name="to_static")
+        except Exception as e:
+            self._cache.pop(sig, None)
+            # distinguish a genuine graph break (.numpy() on a tracer,
+            # data-dependent control flow) from a plain user error: if the
+            # function ALSO fails eagerly, it's the user's bug — re-raise
+            # and do NOT disable compilation
+            result = self._function(*args, **kwargs)  # may (rightly) raise
+            warnings.warn(
+                f"to_static fallback to eager (graph break): {e}",
+                RuntimeWarning)
+            self._broken = True
+            return result
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+
+        def rebuild_out(o):
+            if isinstance(o, tuple) and len(o) == 2 and o[0] == "__out__":
+                return outs[o[1]]
+            if isinstance(o, list):
+                return [rebuild_out(i) for i in o]
+            if isinstance(o, tuple):
+                return tuple(rebuild_out(i) for i in o)
+            if isinstance(o, dict):
+                return {k: rebuild_out(v) for k, v in o.items()}
+            return o
+
+        return rebuild_out(out_box["tree"])
+
+    # -- reference API ----------------------------------------------------
+    def concrete_program_specify_input_spec(self, *a, **kw):
+        return None
+
+    @property
+    def code(self) -> str:
+        import inspect
+        try:
+            return inspect.getsource(self._function)
+        except OSError:
+            return "<source unavailable>"
+
+    def rollback(self):
+        return self._function
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph: bool = True, **kwargs):
+    """ref: paddle.jit.to_static."""
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn)
+            return fn
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(function):
+    """ref: paddle.jit.not_to_static — marker for functions the tracer
+    should leave eager (here: a no-op passthrough)."""
+    function._not_to_static = True
+    return function
+
+
+def ignore_module(modules):
+    return None
+
+
+def enable_to_static(flag: bool = True):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+_to_static_enabled = True

@@ -1,0 +1,379 @@
+"""paddle.static (ref: python/paddle/static/).
+
+Static graph = op-capture Program (capture.py) + jit-compiled replay
+Executor.  The reference's ~200k-LoC ProgramDesc/StandaloneExecutor stack
+collapses to this because XLA owns scheduling/memory/GC (SURVEY.md §2.1
+StandaloneExecutor row).  Static-graph TRAINING (append_backward +
+optimizer ops in the program) is intentionally routed to the dygraph +
+``paddle.jit.to_static`` path — the reference itself is migrating that
+way in the PIR era.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtype as dtypes
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+from ..jit.to_static import InputSpec
+from .capture import (Program, current_program, in_static_capture,
+                      pop_program, push_program, record_op)
+
+__all__ = [
+    "Program", "CompiledProgram", "Executor", "program_guard",
+    "default_main_program", "default_startup_program", "data", "InputSpec",
+    "global_scope", "scope_guard", "name_scope", "py_func",
+    "save_inference_model", "load_inference_model", "normalize_program",
+    "save", "load", "set_program_state", "cpu_places", "cuda_places",
+    "xpu_places", "device_guard", "BuildStrategy", "ExecutionStrategy",
+    "CompiledProgram", "gradients", "append_backward", "nn",
+]
+
+_default_main: Program = Program()
+_default_startup: Program = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """ref: static.program_guard — capture ops into the given program."""
+
+    def __init__(self, main_program: Program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _default_main
+        self._saved = _default_main
+        _default_main = self.main
+        push_program(self.main)
+        _install_observer()
+        return self.main
+
+    def __exit__(self, *exc):
+        global _default_main
+        pop_program()
+        _default_main = self._saved
+        if not in_static_capture() and not _static_mode[0]:
+            _dispatch._op_observer = None
+        return False
+
+
+_static_mode = [False]
+
+
+def _install_observer():
+    _dispatch._op_observer = record_op
+
+
+def enable_static():
+    """paddle.enable_static — ops build the default main program."""
+    if not _static_mode[0]:
+        _static_mode[0] = True
+        push_program(_default_main)
+        _install_observer()
+
+
+def disable_static():
+    if _static_mode[0]:
+        _static_mode[0] = False
+        if in_static_capture():
+            pop_program()
+        if not in_static_capture():
+            _dispatch._op_observer = None
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0]
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> Tensor:
+    """ref: static.data — a feed placeholder.  Holds zeros of the given
+    shape (None/-1 dims become 1) so construction-time shape inference is
+    real computation on real arrays."""
+    shp = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
+    jdt = dtypes.to_jax(dtype)
+    t = Tensor(jnp.zeros(shp, jdt), stop_gradient=True, name=name)
+    prog = current_program() or _default_main
+    prog.add_placeholder(name, t)
+    return t
+
+
+class Scope:
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program if isinstance(program, Program) else program
+        self.build_strategy = build_strategy
+
+
+class Executor:
+    """ref: base/executor.py Executor — with the _ExecutorCache folded
+    into jax.jit (keyed on program identity + feed shapes)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def run(self, program=None, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None, scope=None,
+            return_numpy: bool = True, use_program_cache: bool = True):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        program = program or _default_main
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_tensors = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                fetch_tensors.append(f)
+            elif isinstance(f, str):
+                t = program.find_var_by_name(f)
+                if t is None:
+                    raise KeyError(
+                        f"fetch variable {f!r} not found in the program")
+                fetch_tensors.append(t)
+            else:
+                raise TypeError(
+                    f"fetch_list entries must be Tensors or names, got "
+                    f"{type(f).__name__}")
+
+        feed_names = sorted(feed)
+        feed_arrays = []
+        for n in feed_names:
+            v = feed[n]
+            feed_arrays.append(v._data if isinstance(v, Tensor)
+                               else jnp.asarray(np.asarray(v)))
+        key = (id(program), len(program.ops), tuple(feed_names),
+               tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
+               tuple(id(t) for t in fetch_tensors))
+        entry = self._cache.get(key)
+        if entry is None:
+            pure, externals = program.build_replay(feed_names,
+                                                   fetch_tensors)
+            fn = jax.jit(lambda f, e: pure(f, e))
+            entry = (fn, externals)
+            self._cache[key] = entry
+        fn, externals = entry
+        ext_arrays = [t._data for t in externals]
+        outs = fn(tuple(feed_arrays), tuple(ext_arrays))
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        self._cache.clear()
+
+
+# -- inference model save/load ---------------------------------------------
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def serialize_program(program, feed_vars, fetch_vars):
+    return pickle.dumps({"n_ops": len(program.ops)})
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """ref: static/io.py save_inference_model — saves a compiled StableHLO
+    artifact + parameters (the __model__ + params files)."""
+    from jax import export as jexport
+    program = program or _default_main
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_names = [t.name for t in feed_vars]
+    pure, externals = program.build_replay(feed_names, list(fetch_vars))
+
+    def fn(feed_arrays, ext_arrays):
+        return pure(feed_arrays, ext_arrays)
+
+    args = (tuple(t._data for t in feed_vars),
+            tuple(t._data for t in externals))
+    exported = jexport.export(jax.jit(fn))(*args)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    from ..framework.io import save as psave
+    psave({"externals": [np.asarray(t._data) for t in externals],
+           "feed_names": feed_names,
+           "fetch_count": len(fetch_vars)}, path_prefix + ".pdiparams")
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns (program-like callable, feed_names, fetch_placeholder)."""
+    from jax import export as jexport
+    from ..framework.io import load as pload
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    meta = pload(path_prefix + ".pdiparams")
+    externals = tuple(jnp.asarray(e) for e in meta["externals"])
+
+    class _LoadedProgram:
+        def __init__(self):
+            self.feed_names = meta["feed_names"]
+
+        def run(self, feed_arrays):
+            return exported.call(tuple(jnp.asarray(a) for a in feed_arrays),
+                                 externals)
+
+    return _LoadedProgram(), meta["feed_names"], meta["fetch_count"]
+
+
+def save(program, model_path: str, protocol: int = 4):
+    from ..framework.io import save as psave
+    psave({"params": {p.name or str(i): np.asarray(p._data)
+                      for i, p in enumerate(program.all_parameters())}},
+          model_path + ".pdparams")
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    from ..framework.io import load as pload
+    state = pload(model_path + ".pdparams")
+    params = {p.name or str(i): p
+              for i, p in enumerate(program.all_parameters())}
+    for k, v in state.get("params", {}).items():
+        if k in params:
+            params[k]._data = jnp.asarray(v)
+
+
+def set_program_state(program, state):
+    """ref: static/io.py set_program_state — state is a dict of arrays."""
+    if isinstance(state, str):
+        return load(program, state)
+    params = {p.name or str(i): p
+              for i, p in enumerate(program.all_parameters())}
+    for k, v in (state or {}).items():
+        if k in params:
+            params[k]._data = jnp.asarray(np.asarray(v))
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, **kw):
+    raise NotImplementedError(
+        "static-graph append_backward: use dygraph training with "
+        "@paddle.jit.to_static (the PIR-era recommended path); the "
+        "Executor serves inference programs")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "static.gradients: use paddle.grad in dygraph mode")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("py_func is not supported on the TPU build")
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..device import TPUPlace
+    return [TPUPlace(0)]
+
+
+def xpu_places(device_ids=None):
+    from ..device import TPUPlace
+    return [TPUPlace(0)]
+
+
+class device_guard:
+    def __init__(self, device=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class nn:
+    """Minimal paddle.static.nn — maps onto the dygraph functional ops."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        from .. import nn as dynn
+        from ..nn import functional as F
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = dynn.Linear(in_features, size, weight_attr=weight_attr,
+                            bias_attr=bias_attr)
+        flat = x.reshape(list(x.shape[:num_flatten_dims]) + [in_features])
+        out = layer(flat)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, **kwargs):
+        from .. import nn as dynn
+        bn = dynn.BatchNorm1D(input.shape[1]) if input.ndim == 2 else \
+            dynn.BatchNorm2D(input.shape[1])
+        return bn(input)

@@ -1,0 +1,168 @@
+"""Static-graph capture: the Program IR.
+
+TPU-native re-design of ref: paddle/fluid/framework/ ProgramDesc +
+python/paddle/base/framework.py Program/Block.  The reference builds a
+protobuf op graph that a C++ interpreter schedules; here the "program" is
+an op-trace recorded at construction time (every op already flows through
+core.dispatch.call_op — the single chokepoint) and replayed as a pure
+function that the Executor jit-compiles per feed-shape (the
+StandaloneExecutor + _ExecutorCache collapsed into jax.jit, SURVEY.md
+§3.2 TPU note).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class _OpRecord:
+    __slots__ = ("fn", "kwargs", "inputs", "outputs", "multi_out", "name")
+
+    def __init__(self, fn, kwargs, inputs, outputs, multi_out, name):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.inputs = inputs      # list[Tensor] (strong refs — the
+        self.outputs = outputs    # Program owns its graph tensors)
+        self.multi_out = multi_out
+        self.name = name
+
+
+class Program:
+    """ref: base/framework.py Program."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self._id = Program._counter
+        self.ops: List[_OpRecord] = []
+        self.placeholders: Dict[str, Tensor] = {}
+        self.random_seed = 0
+
+    # -- capture ---------------------------------------------------------
+    def _record(self, fn, kwargs, in_tensors, out_tensors, multi_out, name):
+        self.ops.append(_OpRecord(fn, dict(kwargs), list(in_tensors),
+                                  list(out_tensors), multi_out, name))
+
+    def add_placeholder(self, name: str, t: Tensor):
+        self.placeholders[name] = t
+
+    # -- introspection (reference API) -----------------------------------
+    def global_block(self):
+        return self
+
+    @property
+    def blocks(self):
+        return [self]
+
+    def all_parameters(self):
+        seen, out = set(), []
+        for op in self.ops:
+            for t in op.inputs:
+                if t._is_param and id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+    def find_var_by_name(self, name: str):
+        if name in self.placeholders:
+            return self.placeholders[name]
+        for op in self.ops:
+            for t in op.outputs:
+                if t.name == name:
+                    return t
+        return None
+
+    def list_vars(self):
+        return list(self.placeholders.values())
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.ops = list(self.ops)
+        p.placeholders = dict(self.placeholders)
+        return p
+
+    def __repr__(self):
+        return (f"Program(id={self._id}, ops={len(self.ops)}, "
+                f"feeds={list(self.placeholders)})")
+
+    # -- replay ----------------------------------------------------------
+    def build_replay(self, feed_names: Sequence[str],
+                     fetch_tensors: Sequence[Tensor]):
+        """Return (pure_fn, external_tensors): pure_fn(feed_arrays,
+        external_arrays) -> fetch arrays.  External tensors are inputs
+        produced outside the program (parameters, constants) — passed at
+        run time so parameter updates are visible without retracing."""
+        produced = set()
+        feed_ids = {id(self.placeholders[n]) for n in feed_names
+                    if n in self.placeholders}
+        externals: List[Tensor] = []
+        ext_ids = {}
+        for op in self.ops:
+            for t in op.inputs:
+                if id(t) not in produced and id(t) not in feed_ids and \
+                        id(t) not in ext_ids:
+                    ext_ids[id(t)] = len(externals)
+                    externals.append(t)
+            for t in op.outputs:
+                produced.add(id(t))
+
+        feed_pos = {id(self.placeholders[n]): i
+                    for i, n in enumerate(feed_names)
+                    if n in self.placeholders}
+
+        def pure(feed_arrays, ext_arrays):
+            env: Dict[int, Any] = {}
+            for tid, i in feed_pos.items():
+                env[tid] = feed_arrays[i]
+            for tid, i in ext_ids.items():
+                env[tid] = ext_arrays[i]
+
+            for op in self.ops:
+                ins = [env.get(id(t), t._data) for t in op.inputs]
+                outs = op.fn(*ins, **op.kwargs)
+                if op.multi_out:
+                    for t, o in zip(op.outputs, outs):
+                        env[id(t)] = o
+                else:
+                    env[id(op.outputs[0])] = outs
+            result = []
+            for ft in fetch_tensors:
+                if id(ft) in env:
+                    result.append(env[id(ft)])
+                else:
+                    result.append(ft._data)
+            return tuple(result)
+
+        return pure, externals
+
+
+_capture_stack: List[Program] = []
+_static_mode = False
+
+
+def in_static_capture() -> bool:
+    return bool(_capture_stack)
+
+
+def current_program() -> Optional[Program]:
+    return _capture_stack[-1] if _capture_stack else None
+
+
+def push_program(p: Program):
+    _capture_stack.append(p)
+
+
+def pop_program() -> Program:
+    return _capture_stack.pop()
+
+
+def record_op(fn, kwargs, in_tensors, out_tensors, multi_out, name):
+    p = current_program()
+    if p is not None:
+        p._record(fn, kwargs, in_tensors, out_tensors, multi_out, name)

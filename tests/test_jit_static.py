@@ -1,0 +1,133 @@
+"""to_static / jit.save/load / paddle.static tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import to_static, InputSpec
+
+
+def test_to_static_function():
+    calls = []
+
+    @to_static
+    def f(x, y):
+        calls.append(1)
+        return paddle.matmul(x, y) + 1.0
+
+    a = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(8, 2).astype("float32"))
+    out1 = f(a, b)
+    np.testing.assert_allclose(out1.numpy(),
+                               a.numpy() @ b.numpy() + 1.0, rtol=1e-5)
+    n_trace = len(calls)
+    f(a, b)
+    f(a, b)
+    assert len(calls) == n_trace, "same shapes must not retrace"
+    c = paddle.to_tensor(np.random.randn(6, 8).astype("float32"))
+    f(c, b)
+    assert len(calls) > n_trace, "new shapes retrace (guard miss)"
+
+
+def test_to_static_training_parity():
+    paddle.seed(5)
+    model1 = nn.Linear(8, 4)
+    paddle.seed(5)
+    model2 = nn.Linear(8, 4)
+    model2.forward = to_static(model2.forward)
+    o1 = opt.SGD(learning_rate=0.1, parameters=model1.parameters())
+    o2 = opt.SGD(learning_rate=0.1, parameters=model2.parameters())
+    x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.randn(16, 4).astype("float32"))
+    for _ in range(3):
+        l1 = ((model1(x) - y) ** 2).mean()
+        l1.backward(); o1.step(); o1.clear_grad()
+        l2 = ((model2(x) - y) ** 2).mean()
+        l2.backward(); o2.step(); o2.clear_grad()
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(model1.weight.numpy(), model2.weight.numpy(),
+                               rtol=1e-5)
+
+
+def test_to_static_graph_break_fallback():
+    @to_static
+    def f(x):
+        # .numpy() is a graph-break point under tracing
+        v = float(x.sum().numpy())
+        return x * v
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    with pytest.warns(RuntimeWarning):
+        out = f(x)
+    np.testing.assert_allclose(out.numpy(), np.ones(3) * 3.0)
+
+
+def test_jit_save_load(tmp_path):
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path / "model")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([None, 8], "float32", "x")])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.random.randn(1, 8).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                               rtol=1e-5)
+
+
+def test_static_program_capture_and_executor():
+    import paddle_tpu.static as static
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        lin = nn.Linear(8, 2)
+        y = lin(x)
+        z = (y * 2.0).sum()
+    exe = static.Executor()
+    feed_x = np.random.randn(4, 8).astype("float32")
+    out, = exe.run(main, feed={"x": feed_x}, fetch_list=[z])
+    expect = (feed_x @ lin.weight.numpy() + lin.bias.numpy()).sum() * 2.0
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    # parameter updates are visible without rebuilding the program
+    lin.weight.set_value(lin.weight.numpy() * 0.0)
+    out2, = exe.run(main, feed={"x": feed_x}, fetch_list=[z])
+    np.testing.assert_allclose(out2, (feed_x * 0 @ np.zeros((8, 2))
+                                      + lin.bias.numpy()).sum() * 2.0,
+                               rtol=1e-5)
+
+
+def test_enable_static_mode_roundtrip():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        assert not paddle.in_dynamic_mode()
+        x = static.data("inp", [2, 4], "float32")
+        y = x + 1.0
+        exe = static.Executor()
+        out, = exe.run(static.default_main_program(),
+                       feed={"inp": np.zeros((2, 4), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, np.ones((2, 4)))
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_save_load_inference_model(tmp_path):
+    import paddle_tpu.static as static
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 8], "float32")
+        lin = nn.Linear(8, 3)
+        y = lin(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "inf" / "model")
+    static.save_inference_model(prefix, [x], [y], exe, program=main)
+    prog, feed_names, n_fetch = static.load_inference_model(prefix, exe)
+    feed = np.random.randn(2, 8).astype("float32")
+    outs = prog.run([feed])
+    expect = feed @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(np.asarray(outs[0]), expect, rtol=1e-5)
