@@ -1,0 +1,16 @@
+"""paddle.distributed.auto_parallel — semi-automatic parallelism.
+
+TPU-native re-design of ref: python/paddle/distributed/auto_parallel/
+(~100k LoC: completion/partitioner/reshard planner).  This is where the
+TPU stack wins structurally (SURVEY.md §3.5 note): ProcessMesh ≅
+jax.sharding.Mesh, Placement ≅ PartitionSpec entries, and the whole
+completion→partition→reshard pipeline IS GSPMD inside XLA — the API layer
+annotates, the compiler propagates.
+"""
+from .api import (ProcessMesh, Placement, Shard, Replicate, Partial,
+                  DistAttr, shard_tensor, dtensor_from_fn, reshard,
+                  shard_layer, shard_optimizer, unshard_dtensor,
+                  get_mesh, set_mesh, shard_dataloader, to_static,
+                  DistModel)
+from .strategy import Strategy
+from .engine import Engine

@@ -1,0 +1,336 @@
+"""Semi-auto API (ref: python/paddle/distributed/auto_parallel/api.py +
+process_mesh.py + placement_type.py).
+
+ProcessMesh/Placement describe WHERE tensors live; ``shard_tensor``
+places the array (jax.device_put with a NamedSharding) and annotates the
+Tensor so the jit engine pins the layout; GSPMD performs the reference's
+completion (SPMD-rule propagation), partitioner (per-rank program) and
+reshard planning inside XLA.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..shard_utils import annotate_param
+
+
+# ---------------------------------------------------------------------------
+# placements
+# ---------------------------------------------------------------------------
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicate(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim ``dim`` is sharded over this mesh dimension."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Partial(Placement):
+    """Pending-reduction state (ref: Partial placement).  A jax array is
+    never observably partial outside a collective region, so this marks
+    intent; materialisation reduces immediately."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+# ---------------------------------------------------------------------------
+# ProcessMesh
+# ---------------------------------------------------------------------------
+
+class ProcessMesh:
+    """ref: process_mesh.py ProcessMesh — an N-d grid of ranks with named
+    dims, backed by a jax Mesh over the corresponding devices."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._ranks = arr
+        self._dim_names = list(dim_names)
+        devices = np.asarray(jax.devices(), dtype=object)
+        if arr.max() >= len(devices):
+            raise ValueError(
+                f"ProcessMesh names rank {int(arr.max())} but only "
+                f"{len(devices)} devices exist")
+        self._jax_mesh = Mesh(devices[arr], tuple(self._dim_names))
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ranks.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ranks.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._ranks
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(r) for r in self._ranks.ravel()]
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._ranks.shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, name: str):
+        axis = self._dim_names.index(name)
+        return ProcessMesh(np.moveaxis(self._ranks, axis, 0),
+                           [name] + [n for n in self._dim_names
+                                     if n != name])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ranks, other._ranks)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+_auto_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _auto_mesh
+    _auto_mesh = mesh
+    from ..mesh import set_mesh as _set_jax_mesh
+    _set_jax_mesh(mesh.jax_mesh)
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _auto_mesh
+
+
+class DistAttr:
+    """ref: DistAttr — (mesh, placements) pair."""
+
+    def __init__(self, mesh: ProcessMesh, placements: Sequence[Placement]):
+        self.process_mesh = mesh
+        self.placements = list(placements)
+
+
+# ---------------------------------------------------------------------------
+# placement → PartitionSpec
+# ---------------------------------------------------------------------------
+
+def _placements_to_spec(mesh: ProcessMesh,
+                        placements: Sequence[Placement], ndim: int):
+    spec: List[Any] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            axis = mesh.dim_names[mesh_dim]
+            if spec[d] is None:
+                spec[d] = axis
+            elif isinstance(spec[d], tuple):
+                spec[d] = spec[d] + (axis,)
+            else:
+                spec[d] = (spec[d], axis)
+    return tuple(spec)
+
+
+def _sharding_for(mesh: ProcessMesh, placements, ndim: int):
+    return NamedSharding(mesh.jax_mesh,
+                         PartitionSpec(*_placements_to_spec(mesh, placements,
+                                                            ndim)))
+
+
+# ---------------------------------------------------------------------------
+# API
+# ---------------------------------------------------------------------------
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """ref: api.py shard_tensor — place + annotate."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    placements = list(placements)
+    # Partial materialises as the reduced value (jax arrays are global)
+    spec = _placements_to_spec(mesh, placements, t.ndim)
+    sharded = jax.device_put(t._data, NamedSharding(mesh.jax_mesh,
+                                                    PartitionSpec(*spec)))
+    t._data = sharded
+    annotate_param(t, spec)
+    da = t._dist_attr or {}
+    da["mesh"] = mesh
+    da["placements"] = placements
+    t._dist_attr = da
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs):
+    """ref: dtensor_from_fn — build then shard."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(tensor: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """ref: reshard — cross-mesh/cross-layout move ≅ device_put (XLA plans
+    the collective)."""
+    return shard_tensor(tensor, mesh, placements)
+
+
+def unshard_dtensor(tensor: Tensor) -> Tensor:
+    """ref: unshard_dtensor — gather to replicated."""
+    mesh = (tensor._dist_attr or {}).get("mesh")
+    if mesh is None:
+        return tensor
+    full = jax.device_put(tensor._data,
+                          NamedSharding(mesh.jax_mesh, PartitionSpec()))
+    out = Tensor(full, stop_gradient=tensor.stop_gradient)
+    return out
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None) -> Layer:
+    """ref: api.py shard_layer."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer.named_parameters(include_sublayers=False):
+                shard_tensor(p, mesh, [Replicate()
+                                       for _ in range(mesh.ndim)])
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """ref: api.py shard_optimizer — optimizer state follows parameter
+    layouts (the engine's default); with a shard_fn the states get custom
+    placements."""
+    opt = getattr(optimizer, "_inner_opt", optimizer)
+    opt._auto_parallel_sharded = True
+    if shard_fn is not None:
+        opt._auto_parallel_shard_fn = shard_fn
+    return optimizer
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """ref: api.py shard_dataloader — batches get placed on the mesh; in
+    single-controller jax the engine shards the batch arrays directly, so
+    the loader passes through annotated."""
+    dataloader._auto_parallel_meshes = meshes
+    return dataloader
+
+
+class DistModel:
+    """ref: api.py DistModel (result of dist.to_static): a compiled
+    distributed train/eval step around the model."""
+
+    def __init__(self, layer: Layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        from ...jit.train_step import TrainStep
+        self._layer = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._mode = "train"
+        mesh = _auto_mesh.jax_mesh if _auto_mesh is not None else None
+        self._step = TrainStep(layer, loss, optimizer, mesh=mesh)
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def __call__(self, *batch):
+        if self._mode == "train":
+            return self._step(*batch)
+        inputs = batch[0]
+        out = self._layer(inputs if isinstance(inputs, Tensor)
+                          else Tensor(inputs))
+        if self._loss is not None and len(batch) > 1:
+            lbl = batch[1]
+            return self._loss(out, lbl if isinstance(lbl, Tensor)
+                              else Tensor(lbl))
+        return out
+
+    def state_dict(self, *a, **kw):
+        return self._layer.state_dict(*a, **kw)
+
+    def dist_main_program(self, mode=None):
+        return None
+
+
+def to_static(layer: Layer, loader=None, loss=None, optimizer=None,
+              strategy=None) -> DistModel:
+    """ref: api.py to_static — build the distributed static model."""
+    return DistModel(layer, loader, loss, optimizer, strategy)

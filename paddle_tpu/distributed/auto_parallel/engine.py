@@ -1,0 +1,125 @@
+"""auto_parallel static Engine (ref: python/paddle/distributed/
+auto_parallel/static/engine.py — Engine.fit/evaluate/predict/prepare).
+
+The reference traces a serial program, completes dist attrs, partitions
+per rank and inserts reshards; here the Engine wraps the jit TrainStep:
+parameter placements come from shard_tensor annotations, batch sharding
+from the mesh's data dims, and GSPMD does completion/partition/reshard.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .api import ProcessMesh, get_mesh
+from .strategy import Strategy
+
+
+class Engine:
+    def __init__(self, model: Layer, loss=None, optimizer=None,
+                 metrics=None, strategy: Optional[Strategy] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = getattr(optimizer, "_inner_opt", optimizer)
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+        self.history = None
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            from ...jit.train_step import TrainStep
+            pm = get_mesh()
+            mesh = pm.jax_mesh if pm is not None else None
+
+            def step_fn(model, *batch):
+                inputs, labels = batch[0], batch[1:]
+                out = model(inputs)
+                if callable(self._loss):
+                    return self._loss(out, *labels)
+                raise ValueError("Engine needs a callable loss")
+
+            self._train_step = TrainStep(self._model, None, self._optimizer,
+                                         mesh=mesh, step_fn=step_fn)
+        return self._train_step
+
+    # -- reference API ----------------------------------------------------
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        self._ensure_step()
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
+            **kwargs):
+        from ...io import DataLoader
+        step = self._ensure_step()
+        loader = train_data if hasattr(train_data, "__iter__") and \
+            not hasattr(train_data, "__getitem__") else DataLoader(
+                train_data, batch_size=batch_size, shuffle=False)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = step(*batch)
+                history["loss"].append(float(loss))
+            if self._optimizer is not None and hasattr(
+                    self._optimizer, "_learning_rate") and hasattr(
+                    self._optimizer._learning_rate, "step"):
+                self._optimizer._learning_rate.step()
+        self.history = history
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, **kwargs):
+        from ...io import DataLoader
+        self._model.eval()
+        loader = valid_data if hasattr(valid_data, "__iter__") and \
+            not hasattr(valid_data, "__getitem__") else DataLoader(
+                valid_data, batch_size=batch_size)
+        losses = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            out = self._model(batch[0] if isinstance(batch[0], Tensor)
+                              else Tensor(np.asarray(batch[0])))
+            if self._loss is not None and len(batch) > 1:
+                losses.append(float(self._loss(out, batch[1])))
+        self._model.train()
+        return {"eval_loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size=1, steps=None, **kwargs):
+        from ...io import DataLoader
+        self._model.eval()
+        loader = test_data if hasattr(test_data, "__iter__") and \
+            not hasattr(test_data, "__getitem__") else DataLoader(
+                test_data, batch_size=batch_size)
+        outs = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs.append(self._model(batch[0]))
+        self._model.train()
+        return outs
+
+    def save(self, path: str, training: bool = True):
+        from ... import save as psave
+        psave(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, strict: bool = True, load_optimizer: bool = True):
+        from ... import load as pload
+        self._model.set_state_dict(pload(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None:
+            import os
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def cost(self, mode="train"):
+        return None

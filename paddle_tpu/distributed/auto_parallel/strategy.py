@@ -1,0 +1,61 @@
+"""auto_parallel Strategy (ref: python/paddle/distributed/auto_parallel/
+strategy.py) — knob object with the reference's field names."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class ShardingConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, stage=1, degree=-1,
+                         enable_overlap=False)
+
+
+class AMPConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, dtype="float16", level="O1",
+                         init_loss_scaling=32768.0, custom_white_list=[],
+                         custom_black_list=[], use_master_grad=False)
+
+
+class RecomputeConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, sr=0, refined_ops_patterns=[])
+
+
+class PipelineConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, schedule_mode="1F1B",
+                         micro_batch_size=1, accumulate_steps=1)
+
+
+class GradientMergeConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, k_steps=1, avg=True)
+
+
+class MPOptimizationConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, replace_with_parallel_cross_entropy=False)
+
+
+class Strategy(_Config):
+    def __init__(self, config=None):
+        super().__init__()
+        self.sharding = ShardingConfig()
+        self.amp = AMPConfig()
+        self.recompute = RecomputeConfig()
+        self.pipeline = PipelineConfig()
+        self.gradient_merge = GradientMergeConfig()
+        self.mp_optimization = MPOptimizationConfig()
+        self.split_data = True
+        self.seed = None
+        if config:
+            for k, v in dict(config).items():
+                setattr(self, k, v)

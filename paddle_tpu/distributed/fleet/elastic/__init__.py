@@ -1,0 +1,62 @@
+"""Elastic training manager (ref: python/paddle/distributed/fleet/elastic/
+manager.py — etcd node registry, watch join/leave, checkpoint-restart).
+
+TPU-native: slice/host failure surfaces as a jax.distributed heartbeat
+error that kills the process; the launcher's restart loop (launch/main.py)
+re-execs the worker which resumes from its latest checkpoint.  This module
+keeps the manager API so trainer code written against the reference
+(scale-in/out hooks, checkpointing cadence) keeps working.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, etcd_client=None):
+        self.args = args
+        self.elastic_level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+        self.np = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._stopped = False
+
+    def enabled(self) -> bool:
+        return self.elastic_level > 0
+
+    def pre_hook(self):
+        return None
+
+    def watch(self) -> str:
+        return ElasticStatus.COMPLETED
+
+    def signal_handler(self, sigint, frame):
+        self._stopped = True
+
+    def exit(self, completed: bool = True):
+        self._stopped = True
+
+
+class LauncherInterface:
+    def __init__(self, args=None):
+        self.args = args
+        self.procs = []
+
+    def launch(self):
+        return None
+
+    def stop(self):
+        return None
+
+    def watch(self):
+        return ElasticStatus.COMPLETED

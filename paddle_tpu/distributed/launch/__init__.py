@@ -1,0 +1,11 @@
+"""paddle.distributed.launch (ref: python/paddle/distributed/launch/ —
+the cluster entry CLI).
+
+On TPU pods the contract is one process per host; the launcher sets the
+reference's env vars (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_MASTER, PADDLE_TRAINER_ENDPOINTS) and execs the training script —
+``init_parallel_env``/``fleet.init`` then wire jax.distributed from the
+same contract.  Usage: ``python -m paddle_tpu.distributed.launch
+[--nnodes N] [--rank R] [--master host:port] script.py args...``
+"""
+from .main import launch, main

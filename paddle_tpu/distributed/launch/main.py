@@ -1,0 +1,99 @@
+"""Launcher implementation (ref: launch/main.py + context/ + controllers/).
+
+The reference's controller zoo (collective/ps/rpc, pod model, elastic
+etcd) reduces on TPU to: establish the env contract, spawn the worker
+process (one per host — jax drives all local chips), restart on failure
+up to ``max_restart`` times (the elastic fault-tolerance level 1
+behavior), streaming logs to ``--log_dir``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _build_env(args) -> dict:
+    env = dict(os.environ)
+    rank = int(args.rank if args.rank is not None else
+               os.environ.get("PADDLE_TRAINER_ID", 0))
+    nnodes = int(args.nnodes)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(nnodes)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env["MASTER_ADDR"], _, port = args.master.partition(":")
+        env["MASTER_PORT"] = port or "8090"
+    if args.devices:
+        env["FLAGS_selected_tpus"] = args.devices
+        env["FLAGS_selected_gpus"] = args.devices
+    eps = env.get("PADDLE_TRAINER_ENDPOINTS")
+    if not eps and args.master:
+        host, _, port = args.master.partition(":")
+        eps = ",".join(f"{host}:{int(port or 8090) + i}"
+                       for i in range(nnodes))
+        env["PADDLE_TRAINER_ENDPOINTS"] = eps
+        env["PADDLE_CURRENT_ENDPOINT"] = eps.split(",")[rank]
+    return env
+
+
+def launch(script: str, script_args: Optional[List[str]] = None,
+           nnodes: int = 1, rank: Optional[int] = None,
+           master: Optional[str] = None, devices: Optional[str] = None,
+           log_dir: str = "log", max_restart: int = 3,
+           run_mode: str = "collective") -> int:
+    """Programmatic entry (ref: launch/main.py launch)."""
+    ns = argparse.Namespace(nnodes=nnodes, rank=rank, master=master,
+                            devices=devices)
+    env = _build_env(ns)
+    os.makedirs(log_dir, exist_ok=True)
+    cmd = [sys.executable, "-u", script] + list(script_args or [])
+    restarts = 0
+    while True:
+        log_path = os.path.join(
+            log_dir, f"workerlog.{env['PADDLE_TRAINER_ID']}")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                    stderr=subprocess.STDOUT)
+            code = proc.wait()
+        if code == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restart:
+            return code
+        # elastic restart-from-checkpoint loop (SURVEY.md §5 failure
+        # detection): the training script is expected to resume from its
+        # latest checkpoint on re-exec
+        time.sleep(min(10 * restarts, 60))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="paddle.distributed.launch",
+        description="TPU launcher (one process per host)")
+    p.add_argument("--nnodes", default=os.environ.get("PADDLE_NNODES", "1"))
+    p.add_argument("--nproc_per_node", default=None,
+                   help="accepted for parity; jax drives all local chips "
+                        "from one process")
+    p.add_argument("--rank", default=None)
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
+    p.add_argument("--devices", "--gpus", "--xpus", dest="devices",
+                   default=None)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    return launch(args.script, args.script_args, nnodes=int(args.nnodes),
+                  rank=None if args.rank is None else int(args.rank),
+                  master=args.master, devices=args.devices,
+                  log_dir=args.log_dir, max_restart=args.max_restart,
+                  run_mode=args.run_mode)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

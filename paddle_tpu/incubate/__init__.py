@@ -1,0 +1,14 @@
+"""paddle.incubate (ref: python/paddle/incubate/)."""
+from . import distributed, nn
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    import jax.numpy as jnp
+    from ..core.dispatch import call_op
+
+    def f(v):
+        s = v.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        import jax
+        return jax.nn.softmax(jnp.where(mask, v, -1e30), axis=-1)
+    return call_op(f, (x,), {}, op_name="softmax_mask_fuse_upper_triangle")

@@ -1,0 +1,2 @@
+from .moe_layer import (MoELayer, NaiveGate, GShardGate, SwitchGate,
+                        BaseGate, ClipGradForMOEByGlobalNorm)

@@ -1,0 +1,84 @@
+"""paddle.incubate.nn.functional — fused ops (ref: python/paddle/incubate/
+nn/functional/).  On TPU "fused" means: expressed so XLA/Pallas emits one
+kernel; these wrappers exist for API parity with the reference's
+hand-fused CUDA ops."""
+from ....nn import functional as _F
+from ....core.dispatch import call_op
+from ....core.tensor import Tensor
+import jax
+import jax.numpy as jnp
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    """ref: incubate fused_rms_norm."""
+    args = [x if isinstance(x, Tensor) else Tensor(x),
+            norm_weight if isinstance(norm_weight, Tensor) else Tensor(norm_weight)]
+    has_bias = norm_bias is not None
+    if has_bias:
+        args.append(norm_bias if isinstance(norm_bias, Tensor) else Tensor(norm_bias))
+
+    def f(v, w, *rest):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = v * jax.lax.rsqrt(var + epsilon).astype(v.dtype) * w
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return call_op(f, tuple(args), {}, op_name="rms_norm"), None
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+    return _F.layer_norm(x, x.shape[-1:], weight=norm_weight,
+                         bias=norm_bias, epsilon=epsilon), None
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """ref: fused_rope — rotate q/k by (sin, cos)."""
+    def rope(t, sin_a, cos_a):
+        def f(x, s, c):
+            # x: [B, S, H, D]
+            if use_neox_rotary_style:
+                x1, x2 = jnp.split(x, 2, axis=-1)
+                rot = jnp.concatenate([-x2, x1], axis=-1)
+            else:
+                x1 = x[..., 0::2]
+                x2 = x[..., 1::2]
+                rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+            # broadcast sin/cos to [B, S, 1, D]
+            if s.ndim == 2:            # [S, D]
+                s, c = s[None], c[None]
+            if s.ndim == 3:            # [B, S, D] → insert head axis
+                s, c = s[:, :, None, :], c[:, :, None, :]
+            return x * c + rot * s
+        return call_op(f, (t, sin_a, cos_a), {}, op_name="fused_rope")
+    sin_t = sin if isinstance(sin, Tensor) else Tensor(sin)
+    cos_t = cos if isinstance(cos, Tensor) else Tensor(cos)
+    outs = []
+    for t in (q, k, v):
+        outs.append(None if t is None else rope(t, sin_t, cos_t))
+    return tuple(outs)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return _F.linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    y = x + bias if bias is not None else x
+    return getattr(_F, act_method)(y)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      name=None):
+    return _F.dropout(x, p, training=training, mode=mode) + y
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        a, b = x.chunk(2, axis=-1)
+    else:
+        a, b = x, y
+    return _F.silu(a) * b
