@@ -1,6 +1,20 @@
 """Benchmark: GPT pretrain tokens/sec/chip (BASELINE.md north star).
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
+Extra fields: "platform" (tpu/cpu/none), "mfu" (model-FLOPs utilisation of
+the attached chip, 6*N*T FLOPs model), "preset".
+
+Crash-safety contract (VERDICT r1 weakness 1): backend init failures must
+never lose the round's perf data.  A parent process runs each stage as a
+child with a hard timeout (a hung TPU tunnel blocks inside a C call, so
+in-process watchdogs never fire):
+  1. default backend (TPU when attached);
+  2. one retry on the same platform (transient TPU-tunnel errors);
+  3. BENCH_FORCE_CPU=1 child that switches to the virtual CPU backend via
+     jax.config.update('jax_platforms', 'cpu') — the env var alone is too
+     late because sitecustomize imports jax at interpreter startup;
+  4. if even that dies, print a JSON line with value 0 and the error tail.
+The driver only keeps what bench prints, so every path emits the line.
 
 The preset is chosen to fit the attached chip's HBM (the north-star 1.3B
 config needs >= ~32GB with AdamW; a v5e-16G chip runs 760M).  The baseline
@@ -22,6 +36,21 @@ import numpy as np
 A100_PEAK_BF16 = 312e12
 A100_MFU_EST = 0.45
 
+# bf16 peak FLOPs per chip by TPU generation (public spec sheets); used
+# only for the extra "mfu" diagnostic, never for vs_baseline.
+TPU_PEAK_BF16 = {
+    "v2": 46e12, "v3": 123e12, "v4": 275e12,
+    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+}
+
+
+def _chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, peak in sorted(TPU_PEAK_BF16.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return peak
+    return 197e12  # unknown TPU: assume v5e-class
+
 
 def _baseline_tokens_per_sec(n_params: float) -> float:
     return A100_MFU_EST * A100_PEAK_BF16 / (6.0 * n_params)
@@ -33,9 +62,16 @@ def _param_count(cfg) -> int:
     return V * H + S * H + L * (12 * H * H + 13 * H) + 2 * H
 
 
-def main():
+def run_bench():
     import jax
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # env vars are too late here: the session's sitecustomize imports
+        # jax at interpreter startup with the TPU platform pinned, so the
+        # only reliable override is the config API (see tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()  # may raise on backend-init failure
+    on_tpu = any(d.platform == "tpu" for d in devices)
+    platform = devices[0].platform
 
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
@@ -44,7 +80,7 @@ def main():
     from paddle_tpu.models import GPTForPretraining, gpt_config
 
     if on_tpu:
-        dev = jax.devices()[0]
+        dev = devices[0]
         try:
             hbm = dev.memory_stats()["bytes_limit"]
         except Exception:
@@ -62,7 +98,9 @@ def main():
         steps = int(os.environ.get("BENCH_STEPS", "5"))
         warmup = 2
     else:
-        preset, seq, batch, steps, warmup = "gpt3-125M", 256, 4, 3, 1
+        # CPU smoke: must finish in seconds — it exists only so the driver
+        # always records a parsable line even when the TPU tunnel is down
+        preset, seq, batch, steps, warmup = "tiny", 128, 4, 3, 1
 
     cfg = gpt_config(preset, max_position_embeddings=seq,
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
@@ -93,20 +131,73 @@ def main():
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt
-    n_chips = sum(1 for d in jax.devices() if d.platform == "tpu") or 1
+    n_chips = sum(1 for d in devices if d.platform == "tpu") or 1
     value = tokens_per_sec / (n_chips if on_tpu else 1)
     n_params = _param_count(cfg)
+    baseline = _baseline_tokens_per_sec(n_params)
     if on_tpu:
         metric = f"{preset}_pretrain_tokens_per_sec_per_chip"
-        baseline = _baseline_tokens_per_sec(n_params)
+        mfu = value * 6.0 * n_params / _chip_peak_flops(devices[0])
     else:
         metric = f"{preset}_tokens_per_sec_cpu_smoke"
-        baseline = _baseline_tokens_per_sec(n_params)
-    print(json.dumps({
+        mfu = None
+    out = {
         "metric": metric,
         "value": round(value, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(value / baseline, 4),
+        "platform": platform,
+        "preset": preset,
+        "n_params": n_params,
+    }
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+    print(json.dumps(out))
+
+
+def main():
+    """Orchestrate the bench in child processes with hard timeouts.
+
+    A hung TPU tunnel blocks inside a C call, so in-process watchdogs
+    (SIGALRM) never fire — the only robust guard is a parent that can
+    SIGKILL the child.  Stages: (1) default backend (TPU when attached),
+    (2) one retry for transient tunnel errors, (3) BENCH_FORCE_CPU=1
+    virtual-CPU fallback (config-API platform switch, see run_bench).
+    Whatever happens, exactly one JSON line is printed.
+    """
+    import subprocess
+    if os.environ.get("BENCH_CHILD") == "1":
+        run_bench()
+        return
+    t_tpu = int(os.environ.get("BENCH_STAGE_TIMEOUT", "420"))
+    # retry + CPU stages get tighter budgets: worst case stays ~14 min
+    stages = [({}, t_tpu), ({}, min(t_tpu, 180)),
+              ({"BENCH_FORCE_CPU": "1"}, min(t_tpu, 240))]
+    last_err = "no stage ran"
+    for i, (extra, budget) in enumerate(stages):
+        env = dict(os.environ, BENCH_CHILD="1", **extra)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                timeout=budget, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"stage {i} exceeded {budget}s"
+            sys.stderr.write(last_err + "\n")
+            continue
+        line = next((ln for ln in reversed(proc.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+        last_err = (proc.stderr.strip().splitlines() or ["?"])[-1]
+        sys.stderr.write(f"stage {i} rc={proc.returncode}: {last_err}\n")
+    print(json.dumps({
+        "metric": "bench_failed",
+        "value": 0.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "error": last_err[-300:],
     }))
 
 

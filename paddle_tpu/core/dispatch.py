@@ -40,6 +40,9 @@ _amp_hook = None
 # program being captured (fn, kwargs, in_tensors, out_tensors, multi, name)
 _op_observer = None
 
+# installed by paddle_tpu.profiler while recording: (op_name, t0, t1)
+_prof_op_hook = None
+
 
 class GradNode:
     """One recorded op on the tape."""
@@ -109,6 +112,21 @@ def call_op(fn: Callable, tensor_args: Sequence[Tensor],
                   and any(not t.stop_gradient for t in tensor_args)
                   and any(_is_float_dtype(a.dtype) for a in arrays))
 
+    if _prof_op_hook is not None:
+        import time as _time
+        _t0 = _time.perf_counter()
+        try:
+            return _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out,
+                                  op_name, needs_grad)
+        finally:
+            _prof_op_hook(op_name or getattr(fn, "__name__", "op"), _t0,
+                          _time.perf_counter())
+    return _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out,
+                          op_name, needs_grad)
+
+
+def _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out, op_name,
+                   needs_grad):
     if not needs_grad:
         outs = fn(*arrays, **kwargs)
         if get_flag("check_nan_inf"):
@@ -164,7 +182,14 @@ def call_op_custom_vjp(fwd_fn: Callable, bwd_fn: Callable,
     kwargs = kwargs or {}
     arrays = [t._data for t in tensor_args]
     needs_grad = grad_enabled() and any(not t.stop_gradient for t in tensor_args)
-    outs, residuals = fwd_fn(*arrays, **kwargs)
+    if _prof_op_hook is not None:
+        import time as _time
+        _t0 = _time.perf_counter()
+        outs, residuals = fwd_fn(*arrays, **kwargs)
+        _prof_op_hook(op_name or getattr(fwd_fn, "__name__", "op"), _t0,
+                      _time.perf_counter())
+    else:
+        outs, residuals = fwd_fn(*arrays, **kwargs)
     if multi_out is None:  # infer: a tuple of arrays means multiple outputs
         multi_out = isinstance(outs, tuple)
     if not needs_grad:

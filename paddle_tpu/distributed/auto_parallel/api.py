@@ -191,6 +191,10 @@ def _placements_to_spec(mesh: ProcessMesh,
                 spec[d] = spec[d] + (axis,)
             else:
                 spec[d] = (spec[d], axis)
+    # normalize: PartitionSpec treats trailing Nones as absent; strip them
+    # so spec comparisons (and checkpoint round-trips) are canonical
+    while spec and spec[-1] is None:
+        spec.pop()
     return tuple(spec)
 
 

@@ -1,0 +1,17 @@
+"""paddle.profiler — TPU-native profiling (ref: python/paddle/profiler/).
+
+Host spans + op dispatch events recorded in-process; device timeline via
+jax.profiler XPlane traces (TensorBoard).  See profiler.py for design.
+"""
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+                       TracerEventType, export_chrome_tracing,
+                       export_protobuf, load_profiler_result, make_scheduler)
+from .profiler_statistic import SortedKeys
+from .timer import Benchmark, benchmark
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "TracerEventType", "export_chrome_tracing", "export_protobuf",
+    "load_profiler_result", "make_scheduler", "SortedKeys", "Benchmark",
+    "benchmark",
+]

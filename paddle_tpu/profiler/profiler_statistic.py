@@ -1,0 +1,129 @@
+"""Summary tables over recorded host events — counterpart of the
+reference's ``python/paddle/profiler/profiler_statistic.py`` (overview +
+operator summary tables, SortedKeys).
+
+Device-side time lives in the XPlane trace (TensorBoard); these tables
+aggregate the host dispatch/user spans, which on a single-controller JAX
+runtime is the host-overhead picture the reference's "CPU" columns give.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict, List, Optional
+
+from .profiler import HostEvent, TracerEventType
+
+
+class SortedKeys(Enum):
+    """ref: profiler_statistic.SortedKeys (CPU* subset — no separate GPU
+    stream clock on this runtime)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+
+
+class _Item:
+    __slots__ = ("name", "call", "total", "max", "min")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.call = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, dur: float):
+        self.call += 1
+        self.total += dur
+        self.max = max(self.max, dur)
+        self.min = min(self.min, dur)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.call if self.call else 0.0
+
+
+def _aggregate(events: List[HostEvent],
+               etype: Optional[TracerEventType] = None) -> Dict[str, _Item]:
+    table: Dict[str, _Item] = {}
+    for e in events:
+        if etype is not None and e.type != etype:
+            continue
+        item = table.get(e.name)
+        if item is None:
+            item = table[e.name] = _Item(e.name)
+        item.add(e.duration)
+    return table
+
+
+_SORT_KEY = {
+    SortedKeys.CPUTotal: lambda it: -it.total,
+    SortedKeys.CPUAvg: lambda it: -it.avg,
+    SortedKeys.CPUMax: lambda it: -it.max,
+    SortedKeys.CPUMin: lambda it: it.min,
+}
+
+_UNIT = {"s": 1.0, "ms": 1e3, "us": 1e6}
+
+
+def _fmt_table(title: str, rows: List[List[str]], headers: List[str]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    sep = "-" * (sum(widths) + 3 * len(widths) + 1)
+    out = [sep, title.center(len(sep)), sep,
+           " | ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    out.append(sep)
+    for r in rows:
+        out.append(" | ".join(c.ljust(w) for c, w in zip(r, widths)))
+    out.append(sep)
+    return "\n".join(out)
+
+
+def gen_summary(events: List[HostEvent], sorted_by: Optional[SortedKeys] = None,
+                time_unit: str = "ms") -> str:
+    """Build the overview + operator summary string."""
+    sorted_by = sorted_by or SortedKeys.CPUTotal
+    scale = _UNIT.get(time_unit, 1e3)
+    parts = []
+
+    # overview: total time per event type
+    by_type: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for e in events:
+        by_type[e.type.name] = by_type.get(e.type.name, 0.0) + e.duration
+        counts[e.type.name] = counts.get(e.type.name, 0) + 1
+    rows = [[k, str(counts[k]), f"{v * scale:.3f}"]
+            for k, v in sorted(by_type.items(), key=lambda kv: -kv[1])]
+    parts.append(_fmt_table("Overview Summary",
+                            rows, ["Event Type", "Calls",
+                                   f"Total ({time_unit})"]))
+
+    # operator summary
+    ops = _aggregate(events, TracerEventType.Operator)
+    total_op = sum(it.total for it in ops.values()) or 1.0
+    rows = []
+    for it in sorted(ops.values(), key=_SORT_KEY[sorted_by]):
+        rows.append([
+            it.name, str(it.call), f"{it.total * scale:.3f}",
+            f"{it.avg * scale:.3f}", f"{it.max * scale:.3f}",
+            f"{(0.0 if it.min == float('inf') else it.min) * scale:.3f}",
+            f"{100.0 * it.total / total_op:.2f}%",
+        ])
+    if rows:
+        parts.append(_fmt_table(
+            "Operator Summary", rows,
+            ["Name", "Calls", f"Total ({time_unit})", f"Avg ({time_unit})",
+             f"Max ({time_unit})", f"Min ({time_unit})", "Ratio"]))
+
+    # user-defined spans
+    user = _aggregate(events, TracerEventType.UserDefined)
+    rows = [[it.name, str(it.call), f"{it.total * scale:.3f}",
+             f"{it.avg * scale:.3f}"]
+            for it in sorted(user.values(), key=_SORT_KEY[sorted_by])]
+    if rows:
+        parts.append(_fmt_table(
+            "UserDefined Summary", rows,
+            ["Name", "Calls", f"Total ({time_unit})", f"Avg ({time_unit})"]))
+
+    return "\n\n".join(parts) if parts else "(no events recorded)"

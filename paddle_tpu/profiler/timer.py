@@ -1,0 +1,135 @@
+"""Throughput timer (ips) — TPU-native counterpart of the reference's
+``python/paddle/profiler/timer.py`` (Benchmark/TimerHook used by hapi and
+the launch utils to print reader_cost / batch_cost / ips).
+
+Pure host-side wall-clock accounting; no device sync is forced — callers
+that want exact per-step numbers should run with
+``paddle.set_flags({'FLAGS_benchmark': True})`` (sync mode) or time whole
+windows (the default here), which is the honest way to measure async
+dispatch on TPU.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class _Stat:
+    """Streaming mean over a window plus a global total."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.window_total = 0.0
+        self.window_count = 0
+        self.last = 0.0
+
+    def update(self, value: float):
+        self.last = value
+        self.total += value
+        self.count += 1
+        self.window_total += value
+        self.window_count += 1
+
+    def roll_window(self):
+        self.window_total = 0.0
+        self.window_count = 0
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    @property
+    def window_avg(self) -> float:
+        if not self.window_count:
+            return 0.0
+        return self.window_total / self.window_count
+
+
+class Benchmark:
+    """Step timer: reader cost, batch cost, and ips.
+
+    Usage (mirrors the reference's hapi integration):
+        bm = benchmark()
+        bm.begin()
+        for batch in loader:
+            bm.before_reader(); batch = next(...); bm.after_reader()
+            ... train ...
+            bm.step(num_samples=batch_size)
+        bm.end()
+    """
+
+    def __init__(self):
+        self.reader_cost = _Stat()
+        self.batch_cost = _Stat()
+        self.ips = _Stat()
+        self._t_begin: Optional[float] = None
+        self._t_reader: Optional[float] = None
+        self._t_step: Optional[float] = None
+        self.num_samples: Optional[float] = None
+        self.steps = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def begin(self):
+        now = time.perf_counter()
+        self._t_begin = now
+        self._t_step = now
+
+    def before_reader(self):
+        self._t_reader = time.perf_counter()
+
+    def after_reader(self):
+        if self._t_reader is not None:
+            self.reader_cost.update(time.perf_counter() - self._t_reader)
+            self._t_reader = None
+
+    def step(self, num_samples: Optional[float] = None):
+        now = time.perf_counter()
+        if self._t_step is not None:
+            dt = now - self._t_step
+            self.batch_cost.update(dt)
+            if num_samples and dt > 0:
+                self.ips.update(num_samples / dt)
+        self._t_step = now
+        self.steps += 1
+
+    def end(self):
+        self._t_begin = None
+
+    def reset(self):
+        self.reader_cost.reset()
+        self.batch_cost.reset()
+        self.ips.reset()
+        self.steps = 0
+
+    # -- reporting ---------------------------------------------------------
+    def step_info(self, unit: str = "samples") -> str:
+        msg = (f"reader_cost: {self.reader_cost.window_avg:.5f} s, "
+               f"batch_cost: {self.batch_cost.window_avg:.5f} s, "
+               f"ips: {self.ips.window_avg:.2f} {unit}/s")
+        self.reader_cost.roll_window()
+        self.batch_cost.roll_window()
+        self.ips.roll_window()
+        return msg
+
+    def report(self) -> Dict[str, float]:
+        return {
+            "reader_cost_avg": self.reader_cost.avg,
+            "batch_cost_avg": self.batch_cost.avg,
+            "ips_avg": self.ips.avg,
+            "steps": self.steps,
+        }
+
+
+_benchmark: Optional[Benchmark] = None
+
+
+def benchmark() -> Benchmark:
+    """Global Benchmark singleton (ref: paddle.profiler.timer.benchmark)."""
+    global _benchmark
+    if _benchmark is None:
+        _benchmark = Benchmark()
+    return _benchmark
