@@ -89,6 +89,11 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
 
 
 def __getattr__(name):
+    # paddle.Model / paddle.summary live in hapi (ref: paddle/__init__.py)
+    if name in ("Model", "summary"):
+        from .hapi import Model, summary
+        globals().update(Model=Model, summary=summary)
+        return globals()[name]
     # lazy subpackage import keeps partially-built stages from breaking the core
     if name in _SUBPACKAGES:
         import importlib

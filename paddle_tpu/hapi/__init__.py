@@ -1,0 +1,9 @@
+"""paddle.hapi (ref: python/paddle/hapi/)."""
+from . import callbacks
+from .callbacks import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
+                        ProgBarLogger)
+from .model import Model, summary
+from .progressbar import ProgressBar
+
+__all__ = ["Model", "summary", "callbacks", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "EarlyStopping", "LRScheduler", "ProgressBar"]

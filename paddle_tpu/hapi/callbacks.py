@@ -1,0 +1,254 @@
+"""hapi callbacks (ref: python/paddle/hapi/callbacks.py — Callback,
+CallbackList, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler).
+"""
+from __future__ import annotations
+
+import numbers
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .progressbar import ProgressBar
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler"]
+
+
+class Callback:
+    """ref: callbacks.Callback."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    # train
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    # eval
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    # predict
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def _call(self, name, *args):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None,
+                     epochs=None, steps=None, log_freq=2, verbose=2,
+                     save_freq=1, save_dir=None, metrics=None,
+                     mode="train"):
+    """ref: callbacks.config_callbacks — default ProgBar + ModelCheckpoint."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks):
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    metrics = metrics or []
+    params = {
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "verbose": verbose, "metrics": metrics,
+    }
+    cbk_list.set_params(params)
+    return cbk_list
+
+
+class ProgBarLogger(Callback):
+    """ref: callbacks.ProgBarLogger."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.train_metrics = self.params.get("metrics", [])
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.steps = self.params.get("steps")
+        self.epoch = epoch
+        self.train_step = 0
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+        self.train_progbar = ProgressBar(num=self.steps,
+                                         verbose=self.verbose)
+
+    def _updates(self, logs, progbar, step):
+        values = {k: v for k, v in (logs or {}).items()
+                  if isinstance(v, (numbers.Number, list, tuple))}
+        progbar.update(step, values)
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step += 1
+        if self.verbose and self.train_step % self.log_freq == 0:
+            self._updates(logs, self.train_progbar, self.train_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self._updates(logs, self.train_progbar, self.train_step)
+
+    def on_eval_begin(self, logs=None):
+        self.eval_steps = (logs or {}).get("steps")
+        self.eval_progbar = ProgressBar(num=self.eval_steps,
+                                        verbose=self.verbose)
+        self.eval_step = 0
+        if self.verbose:
+            print("Eval begin...")
+
+    def on_eval_batch_end(self, step, logs=None):
+        self.eval_step += 1
+        if self.verbose and self.eval_step % self.log_freq == 0:
+            self._updates(logs, self.eval_progbar, self.eval_step)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            self._updates(logs, self.eval_progbar, self.eval_step)
+            print("Eval samples: ", (logs or {}).get("samples", ""))
+
+
+class ModelCheckpoint(Callback):
+    """ref: callbacks.ModelCheckpoint — epoch snapshots via model.save."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and (
+                epoch % self.save_freq == 0):
+            path = os.path.join(self.save_dir, str(epoch))
+            print(f"save checkpoint at {os.path.abspath(path)}")
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            path = os.path.join(self.save_dir, "final")
+            print(f"save checkpoint at {os.path.abspath(path)}")
+            self.model.save(path)
+
+
+class LRScheduler(Callback):
+    """ref: callbacks.LRScheduler — steps the lr scheduler."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """ref: callbacks.EarlyStopping — stop when the monitored metric stops
+    improving."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        self.save_dir = None
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and ("acc" not in monitor
+                                                 and "auc" not in monitor)):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less \
+                else -np.inf
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.save_dir and self.model:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose > 0:
+                print(f"Epoch early stopped (patience {self.patience}); "
+                      f"best {self.monitor}: {self.best_value}")

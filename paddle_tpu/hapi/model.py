@@ -1,0 +1,333 @@
+"""paddle.Model — high-level train/eval/predict loops (ref:
+python/paddle/hapi/model.py).
+
+The reference carries two adapters (dygraph + static graph); on this
+runtime the eager tape IS jit-compatible, so one adapter serves both —
+`Model` runs eager loops, and `save(training=False)` exports the
+inference artifact through paddle.jit (StableHLO path).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary"]
+
+
+class InputSpec:
+    """Re-export convenience (ref: paddle.static.InputSpec used in hapi)."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..static import InputSpec as _IS
+        return _IS(*args, **kwargs)
+
+
+def _to_tensor_batch(data):
+    if isinstance(data, (list, tuple)):
+        return [d if isinstance(d, Tensor) else Tensor(np.asarray(d))
+                for d in data]
+    return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+
+
+class Model:
+    """ref: hapi/model.py Model — network wrapper with fit/evaluate/
+    predict/save/load."""
+
+    def __init__(self, network: nn.Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._optimizer = None
+        self.stop_training = False
+
+    # -- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """ref: Model.prepare."""
+        self._optimizer = optimizer
+        if loss is not None and not isinstance(loss, nn.Layer) \
+                and not callable(loss):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        metrics = metrics or []
+        metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+        self._metrics = list(metrics)
+        self._amp_configs = amp_configs
+
+    # -- single-batch ops --------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if self._loss is None:
+            raise RuntimeError("loss is not set; call prepare(loss=...)")
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        return self._loss(*(list(outs) + list(labels)))
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """ref: Model.train_batch — one optimizer step."""
+        self.network.train()
+        inputs = _to_tensor_batch(inputs)
+        labels = _to_tensor_batch(labels) if labels is not None else []
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for metric in self._metrics:
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            m = metric.update(*[
+                v for v in [metric.compute(outs[0], *labels)]
+                for v in (v if isinstance(v, tuple) else (v,))])
+            metrics.append(m)
+        vals = [float(loss)]
+        return (vals, metrics) if metrics else vals
+
+    def eval_batch(self, inputs, labels=None):
+        """ref: Model.eval_batch."""
+        self.network.eval()
+        from ..core.autograd_state import no_grad
+        with no_grad():
+            inputs = _to_tensor_batch(inputs)
+            labels = _to_tensor_batch(labels) if labels is not None else []
+            outputs = self.network(*inputs)
+            vals = []
+            if self._loss is not None and labels:
+                vals = [float(self._compute_loss(outputs, labels))]
+            metrics = []
+            for metric in self._metrics:
+                outs = (outputs if isinstance(outputs, (list, tuple))
+                        else [outputs])
+                m = metric.update(*[
+                    v for v in [metric.compute(outs[0], *labels)]
+                    for v in (v if isinstance(v, tuple) else (v,))])
+                metrics.append(m)
+        return (vals, metrics) if metrics else vals
+
+    def predict_batch(self, inputs):
+        """ref: Model.predict_batch."""
+        self.network.eval()
+        from ..core.autograd_state import no_grad
+        with no_grad():
+            inputs = _to_tensor_batch(inputs)
+            outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=False)
+        return data  # assume iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        return batch, None
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        """ref: Model.fit."""
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self,
+                                batch_size=batch_size, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_train_begin({})
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch, {})
+            for metric in self._metrics:
+                metric.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step, {})
+                x, y = self._split_batch(batch)
+                res = self.train_batch(x, y)
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+            for metric in self._metrics:
+                names = metric.name()
+                names = names if isinstance(names, list) else [names]
+                vals = metric.accumulate()
+                vals = vals if isinstance(vals, list) else [vals]
+                for n, v in zip(names, vals):
+                    logs[n] = v
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+        cbks.on_train_end({})
+
+    def _run_eval(self, loader, cbks):
+        for metric in self._metrics:
+            metric.reset()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.on_eval_begin({"steps": steps})
+        logs = {}
+        samples = 0
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step, {})
+            x, y = self._split_batch(batch)
+            res = self.eval_batch(x, y)
+            logs = self._pack_logs(res, prefix="eval_")
+            first = x[0] if isinstance(x, (list, tuple)) else x
+            samples += int(first.shape[0]) if hasattr(first, "shape") else 1
+            cbks.on_eval_batch_end(step, logs)
+        for metric in self._metrics:
+            names = metric.name()
+            names = names if isinstance(names, list) else [names]
+            vals = metric.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        logs["samples"] = samples
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        """ref: Model.evaluate."""
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        logs = self._run_eval(loader, cbks)
+        logs.pop("samples", None)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """ref: Model.predict."""
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[])
+        cbks.on_predict_begin({})
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step, {})
+            x, _ = self._split_batch(batch)
+            outs = self.predict_batch(x)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end({})
+        # transpose: list over batches → list over outputs
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    def _pack_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            vals, metrics = res
+        else:
+            vals, metrics = res, []
+        if vals:
+            logs[prefix + "loss"] = vals[0] if len(vals) == 1 else vals
+        for metric, m in zip(self._metrics, metrics):
+            name = metric.name()
+            name = name[0] if isinstance(name, list) else name
+            logs[prefix + name] = m
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        """ref: Model.save — training=True saves .pdparams/.pdopt,
+        training=False exports the inference artifact via paddle.jit."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        if training:
+            from ..framework.io import save as psave
+            psave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                psave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit
+            if not self._inputs:
+                raise RuntimeError(
+                    "save(training=False) needs Model(inputs=[InputSpec...])")
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """ref: Model.load."""
+        from ..framework.io import load as pload
+        state = pload(path + ".pdparams" if not path.endswith(".pdparams")
+                      else path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(pload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """ref: Model.summary."""
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net: nn.Layer, input_size=None, dtypes=None, input=None):
+    """ref: hapi/model_summary.py summary — layer table + param counts."""
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers():
+        n_params = 0
+        for p in layer.parameters(include_sublayers=False):
+            n_params += int(np.prod(p.shape))
+            if not p.stop_gradient:
+                trainable_params += int(np.prod(p.shape))
+        total_params += n_params
+        rows.append((name or type(layer).__name__,
+                     type(layer).__name__, n_params))
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    lines = ["-" * (width + 40),
+             f"{'Layer (type)':<{width}}{'Type':<20}{'Param #':>12}",
+             "=" * (width + 40)]
+    for name, t, n in rows:
+        lines.append(f"{name:<{width}}{t:<20}{n:>12,}")
+    lines.append("=" * (width + 40))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    lines.append(
+        f"Non-trainable params: {total_params - trainable_params:,}")
+    lines.append("-" * (width + 40))
+    print("\n".join(lines))
+    return {"total_params": total_params,
+            "trainable_params": trainable_params}

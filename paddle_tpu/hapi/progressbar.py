@@ -1,0 +1,47 @@
+"""Console progress bar (ref: python/paddle/hapi/progressbar.py)."""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressBar:
+    """Keras-style progress line used by ProgBarLogger."""
+
+    def __init__(self, num=None, width=30, verbose=1, file=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self.file = file
+        self._values = {}
+        self._start = time.time()
+        self._last_update = 0
+
+    def update(self, current_num, values=None):
+        now = time.time()
+        values = values or {}
+        msg = f"step {current_num}"
+        if self._num is not None:
+            msg += f"/{self._num}"
+        for k, v in values.items():
+            if isinstance(v, (float, int)):
+                msg += f" - {k}: {v:.4f}"
+            elif isinstance(v, (list, tuple)):
+                msg += f" - {k}: " + " ".join(
+                    f"{x:.4f}" if isinstance(x, float) else str(x) for x in v)
+            else:
+                msg += f" - {k}: {v}"
+        elapsed = now - self._start
+        if current_num:
+            msg += f" - {elapsed / max(current_num, 1):.0e}s/step"
+        if self._verbose == 1:
+            self.file.write("\r" + msg)
+            if self._num is not None and current_num >= self._num:
+                self.file.write("\n")
+        else:
+            self.file.write(msg + "\n")
+        self.file.flush()
+        self._last_update = now
+
+    def start(self):
+        self._start = time.time()

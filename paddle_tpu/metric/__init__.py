@@ -1,0 +1,218 @@
+"""paddle.metric — streaming metrics (ref: python/paddle/metric/metrics.py:
+Metric base, Accuracy, Precision, Recall, Auc, functional accuracy).
+
+Metrics accumulate on host (numpy): they sit at the step boundary where
+values have already left the jit region, so device-side accumulation
+would only add transfers.
+"""
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _to_numpy(x):
+    if isinstance(x, Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Metric(metaclass=abc.ABCMeta):
+    """ref: metrics.Metric — reset/update/accumulate/name (+compute)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Optional pre-processing on device tensors; default passthrough
+        of (pred, label)."""
+        return args
+
+
+class Accuracy(Metric):
+    """ref: metrics.Accuracy — top-k accuracy over a stream."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._init_name(name)
+        self.reset()
+
+    def _init_name(self, name):
+        name = name or "acc"
+        if self.maxk != 1:
+            self._name = [f"{name}_top{k}" for k in self.topk]
+        else:
+            self._name = [name]
+
+    def compute(self, pred, label, *args):
+        pred_np = _to_numpy(pred)
+        label_np = _to_numpy(label)
+        idx = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1) if label_np.shape[-1] == 1 \
+                else np.argmax(label_np, axis=-1)
+        correct = (idx == label_np[..., None]).astype("float32")
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_numpy(correct)
+        num_samples = int(np.prod(correct.shape[:-1]))
+        accs = []
+        for i, k in enumerate(self.topk):
+            num_corrects = correct[..., :k].sum()
+            accs.append(float(num_corrects) / max(num_samples, 1))
+            self.total[i] += num_corrects
+            self.count[i] += num_samples
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c > 0 else 0.0
+               for t, c in zip(self.total, self.count)]
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    """ref: metrics.Precision — binary precision over a stream."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds).round().astype("int32").ravel()
+        labels = _to_numpy(labels).astype("int32").ravel()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """ref: metrics.Recall — binary recall over a stream."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds).round().astype("int32").ravel()
+        labels = _to_numpy(labels).astype("int32").ravel()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        ar = self.tp + self.fn
+        return float(self.tp) / ar if ar else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ref: metrics.Auc — ROC AUC via thresholded confusion histogram."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds)
+        labels = _to_numpy(labels).ravel()
+        if preds.ndim == 2:
+            pos_prob = preds[:, 1]
+        else:
+            pos_prob = preds.ravel()
+        bins = np.clip((pos_prob * self._num_thresholds).astype("int64"), 0,
+                       self._num_thresholds)
+        for b, l in zip(bins, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, dtype="int64")
+        self._stat_neg = np.zeros(self._num_thresholds + 1, dtype="int64")
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return auc / tot_pos / tot_neg
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """ref: metrics.accuracy functional — top-k accuracy of a batch."""
+    import jax.numpy as jnp
+    from ..core.dispatch import call_op
+    inp = input if isinstance(input, Tensor) else Tensor(input)
+    lbl = label if isinstance(label, Tensor) else Tensor(label)
+
+    def impl(pred, lab):
+        topk_idx = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = lab if lab.ndim == pred.ndim else lab[..., None]
+        if lab2.shape[-1] != 1:
+            lab2 = jnp.argmax(lab2, axis=-1, keepdims=True)
+        hit = (topk_idx == lab2).any(axis=-1)
+        return hit.mean(dtype=jnp.float32)
+
+    return call_op(impl, [inp, lbl], op_name="accuracy")

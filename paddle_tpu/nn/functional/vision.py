@@ -1,0 +1,123 @@
+"""Spatial sampling functionals (ref: python/paddle/nn/functional/vision.py
+— grid_sample, affine_grid, pixel_shuffle live here upstream; backed by
+phi CUDA kernels there, pure jnp gathers here so XLA fuses them).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+from ...core.tensor import Tensor
+from ...tensor._helpers import ensure_tensor
+
+__all__ = ["grid_sample", "affine_grid", "pairwise_distance"]
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """ref: functional.grid_sample — NCHW input, (N, Hg, Wg, 2) grid of
+    xy coords in [-1, 1]."""
+    x, grid = ensure_tensor(x), ensure_tensor(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode}")
+
+    def impl(xa, ga):
+        N, C, H, W = xa.shape
+        gx = _unnormalize(ga[..., 0], W, align_corners)  # (N,Hg,Wg)
+        gy = _unnormalize(ga[..., 1], H, align_corners)
+
+        def reflect(c, size):
+            if align_corners:
+                span = size - 1
+                c = jnp.abs(c)
+                c = span - jnp.abs(c % (2 * span) - span) if span > 0 else c * 0
+            else:
+                span = size
+                c = (c + 0.5) % (2 * span)
+                c = jnp.abs(c - span) - 0.5
+                c = span - 1 - jnp.abs(span - 1 - jnp.clip(c, 0, size - 1))
+            return c
+
+        if padding_mode == "reflection":
+            gx = reflect(gx, W)
+            gy = reflect(gy, H)
+
+        def sample(ix, iy):
+            """Gather x at integer coords with zero/border handling."""
+            inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+            ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            # vals: (N, C, Hg, Wg)
+            vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(xa, iyc, ixc)
+            if padding_mode == "zeros":
+                vals = vals * inb[:, None, :, :]
+            return vals
+
+        if mode == "nearest":
+            return sample(jnp.round(gx), jnp.round(gy)).astype(xa.dtype)
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[:, None, :, :]
+        wy = (gy - y0)[:, None, :, :]
+        v00 = sample(x0, y0)
+        v01 = sample(x0 + 1, y0)
+        v10 = sample(x0, y0 + 1)
+        v11 = sample(x0 + 1, y0 + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return (top * (1 - wy) + bot * wy).astype(xa.dtype)
+
+    return call_op(impl, [x, grid], op_name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """ref: functional.affine_grid — (N, 2, 3) affine matrices → sampling
+    grid (N, H, W, 2) for grid_sample."""
+    theta = ensure_tensor(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def impl(th):
+        def linspace(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+        ys = linspace(H)
+        xs = linspace(W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H,W,3)
+        out = jnp.einsum("hwk,nik->nhwi", base, th)  # (N,H,W,2)
+        return out.astype(th.dtype)
+
+    return call_op(impl, [theta], op_name="affine_grid")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref: functional.distance.pairwise_distance."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.abs(d).max(axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = (d != 0).sum(axis=-1, keepdims=keepdim).astype(a.dtype)
+        else:
+            out = (jnp.abs(d) ** p).sum(
+                axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out
+
+    return call_op(impl, [x, y], op_name="pairwise_distance")

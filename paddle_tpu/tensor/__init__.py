@@ -10,11 +10,13 @@ import numpy as np
 from ..core.tensor import Tensor, Parameter, to_tensor, is_tensor
 from ..core.dispatch import call_op
 from .. import dtype as dtypes
-from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, op_registry, random, search, stat
 from ._helpers import ensure_tensor
 
-# re-export everything public from the op modules
-_MODULES = [creation, math, manipulation, logic, linalg, search, stat, random]
+# re-export everything public from the op modules (op_registry first so
+# hand-written modules win on name clashes)
+_MODULES = [creation, math, manipulation, logic, linalg, search, stat,
+            random, op_registry]
 for _m in _MODULES:
     for _k in dir(_m):
         if not _k.startswith("_") and callable(getattr(_m, _k)):

@@ -1,0 +1,476 @@
+"""Declarative op registry — the TPU-native analogue of the reference's
+yaml op table (ref: paddle/phi/api/yaml/ops.yaml + generator scripts,
+SURVEY.md: "the op surface is data, not code").
+
+Each ``OpDef`` row declares name → jnp impl → arity/aliases → numpy
+reference + case generator.  From this one table we generate:
+  * the module-level functions (picked up by ``paddle_tpu.tensor`` and
+    monkey-patched onto Tensor, exactly like hand-written ops),
+  * the OpTest-style parity tests (tests/test_op_registry.py iterates
+    ``REGISTRY`` — adding a row here automatically adds its test).
+
+Rows lower through ``call_op`` so autograd/AMP/profiler hooks apply
+uniformly.  Ops whose semantics need bespoke python (optional tensor
+args, list inputs) are defined as plain functions below the table and
+registered with ``_register_manual`` so they still appear in REGISTRY for
+test generation.
+"""
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ._helpers import ensure_tensor, unwrap
+
+_mod = sys.modules[__name__]
+
+
+@dataclass
+class OpDef:
+    name: str
+    impl: Callable                      # jnp impl over raw arrays
+    arity: int = 1                      # leading tensor args
+    aliases: Tuple[str, ...] = ()
+    np_ref: Optional[Callable] = None   # numpy reference (None: skip test)
+    gen_cases: Optional[Callable] = None  # () -> list of numpy arg tuples
+    multi_out: bool = False
+    defaults: Dict[str, Any] = field(default_factory=dict)  # extra kwargs
+
+
+REGISTRY: Dict[str, OpDef] = {}
+
+
+def _float_cases(n=2):
+    rs = np.random.RandomState(0)
+    return [tuple(rs.randn(3, 4).astype("float32") for _ in range(n)),
+            tuple(rs.randn(2, 1, 5).astype("float32") for _ in range(n))]
+
+
+def _pos_cases(n=1):
+    rs = np.random.RandomState(1)
+    return [tuple(rs.rand(3, 4).astype("float32") + 0.1 for _ in range(n))]
+
+
+def _int_cases(n=2, lo=0, hi=8):
+    rs = np.random.RandomState(2)
+    return [tuple(rs.randint(lo, hi, (3, 4)).astype("int64")
+                  for _ in range(n))]
+
+
+def _complex_cases(n=1):
+    rs = np.random.RandomState(3)
+    return [tuple((rs.randn(3, 4) + 1j * rs.randn(3, 4)).astype("complex64")
+                  for _ in range(n))]
+
+
+def _register(op: OpDef):
+    """Materialize an OpDef as a module function + registry row."""
+    REGISTRY[op.name] = op
+
+    def fn(*args, name=None, **kwargs):
+        tensors = [ensure_tensor(a) for a in args[:op.arity]]
+        extra = dict(op.defaults)
+        extra.update(kwargs)
+        pos = args[op.arity:]
+        f = (lambda *arrs: op.impl(*arrs, *pos, **extra))
+        return call_op(f, tensors, multi_out=op.multi_out, op_name=op.name)
+
+    fn.__name__ = op.name
+    fn.__qualname__ = op.name
+    fn.__doc__ = (f"ref: paddle.{op.name} (yaml-registry generated; "
+                  f"see op_registry.py)")
+    setattr(_mod, op.name, fn)
+    for alias in op.aliases:
+        setattr(_mod, alias, fn)
+    return fn
+
+
+def _register_manual(name, np_ref=None, gen_cases=None, aliases=()):
+    """Register a hand-written function (defined in this module) so the
+    generated tests cover it too."""
+    fn = getattr(_mod, name)
+    REGISTRY[name] = OpDef(name=name, impl=fn, arity=-1, np_ref=np_ref,
+                           gen_cases=gen_cases, aliases=tuple(aliases))
+    for alias in aliases:
+        setattr(_mod, alias, fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# table rows: simple elementwise / linalg ops
+# ---------------------------------------------------------------------------
+
+_TABLE = [
+    # unary float
+    OpDef("signbit", jnp.signbit, np_ref=np.signbit,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("sinc", jnp.sinc, np_ref=np.sinc, gen_cases=lambda: _float_cases(1)),
+    OpDef("erfc", jax.scipy.special.erfc,
+          np_ref=lambda x: 1.0 - np.vectorize(_np_erf)(x),
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("i0e", jax.scipy.special.i0e, gen_cases=lambda: _float_cases(1)),
+    OpDef("i1", jax.scipy.special.i1, gen_cases=lambda: _float_cases(1)),
+    OpDef("i1e", jax.scipy.special.i1e, gen_cases=lambda: _float_cases(1)),
+    OpDef("isneginf", jnp.isneginf, np_ref=np.isneginf,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("isposinf", jnp.isposinf, np_ref=np.isposinf,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("isreal", jnp.isreal, np_ref=np.isreal,
+          gen_cases=lambda: _complex_cases(1)),
+    OpDef("negative", jnp.negative, np_ref=np.negative,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("positive", jnp.positive, np_ref=np.positive,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("sgn", jnp.sign, np_ref=np.sign,
+          gen_cases=lambda: _float_cases(1) + _complex_cases(1)),
+    OpDef("fliplr", jnp.fliplr, np_ref=np.fliplr,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("flipud", jnp.flipud, np_ref=np.flipud,
+          gen_cases=lambda: _float_cases(1)),
+    OpDef("matrix_exp", jax.scipy.linalg.expm,
+          gen_cases=lambda: [(np.eye(3, dtype="float32") * 0.5,)]),
+    # binary
+    OpDef("float_power", jnp.float_power, arity=2, np_ref=np.float_power,
+          gen_cases=lambda: _pos_cases(2)),
+    OpDef("true_divide", jnp.true_divide, arity=2, np_ref=np.true_divide,
+          gen_cases=lambda: _pos_cases(2)),
+    OpDef("xlogy", jax.scipy.special.xlogy, arity=2,
+          gen_cases=lambda: _pos_cases(2)),
+    OpDef("gammainc", jax.scipy.special.gammainc, arity=2,
+          gen_cases=lambda: _pos_cases(2)),
+    OpDef("gammaincc", jax.scipy.special.gammaincc, arity=2,
+          gen_cases=lambda: _pos_cases(2)),
+    OpDef("bitwise_left_shift", jnp.left_shift, arity=2,
+          np_ref=np.left_shift, gen_cases=lambda: _int_cases(2, 0, 7)),
+    OpDef("bitwise_right_shift", jnp.right_shift, arity=2,
+          np_ref=np.right_shift, gen_cases=lambda: _int_cases(2, 0, 7)),
+    OpDef("bitwise_invert", jnp.bitwise_not, np_ref=np.bitwise_not,
+          gen_cases=lambda: _int_cases(1)),
+    OpDef("nextafter", jnp.nextafter, arity=2, np_ref=np.nextafter,
+          gen_cases=lambda: _float_cases(2)),
+    # multi-out
+    OpDef("frexp", jnp.frexp, multi_out=True,
+          np_ref=np.frexp, gen_cases=lambda: _pos_cases(1)),
+    # complex views
+    OpDef("view_as_real",
+          lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1),
+          np_ref=lambda x: np.stack([x.real, x.imag], -1),
+          gen_cases=lambda: _complex_cases(1), aliases=("as_real",)),
+    OpDef("view_as_complex",
+          lambda x: jax.lax.complex(x[..., 0], x[..., 1]),
+          np_ref=lambda x: x[..., 0] + 1j * x[..., 1],
+          gen_cases=lambda: [(np.random.RandomState(0)
+                              .randn(3, 4, 2).astype("float32"),)],
+          aliases=("as_complex",)),
+]
+
+
+def _np_erf(x):
+    import math
+    return math.erf(x)
+
+
+for _op in _TABLE:
+    _register(_op)
+
+
+# ---------------------------------------------------------------------------
+# reductions with paddle (axis, keepdim) signature
+# ---------------------------------------------------------------------------
+
+def _reg_reduction(name, jfn, npfn):
+    def fn(x, axis=None, keepdim=False, name=None):
+        x = ensure_tensor(x)
+        return call_op(lambda a: jfn(a, axis=axis, keepdims=keepdim), [x],
+                       op_name=name)
+    fn.__name__ = name
+    setattr(_mod, name, fn)
+    REGISTRY[name] = OpDef(name, jfn, arity=-1, np_ref=npfn,
+                           gen_cases=lambda: _float_cases(1))
+    return fn
+
+
+_reg_reduction("nanmax", jnp.nanmax, np.nanmax)
+_reg_reduction("nanmin", jnp.nanmin, np.nanmin)
+
+
+# ---------------------------------------------------------------------------
+# manual ops (bespoke signatures) — registered below their definitions
+# ---------------------------------------------------------------------------
+
+def vander(x, n=None, increasing=False, name=None):
+    """ref: paddle.vander."""
+    x = ensure_tensor(x)
+    m = n if n is not None else x.shape[-1]
+    return call_op(lambda a: jnp.vander(a, N=m, increasing=increasing), [x],
+                   op_name="vander")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref: paddle.trapezoid."""
+    y = ensure_tensor(y)
+    if x is not None:
+        x = ensure_tensor(x)
+        return call_op(lambda ya, xa: jnp.trapezoid(ya, x=xa, axis=axis),
+                       [y, x], op_name="trapezoid")
+    d = 1.0 if dx is None else dx
+    return call_op(lambda ya: jnp.trapezoid(ya, dx=d, axis=axis), [y],
+                   op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """ref: paddle.cumulative_trapezoid."""
+    y = ensure_tensor(y)
+
+    def impl(ya, xa=None):
+        ya_m = jnp.moveaxis(ya, axis, -1)
+        if xa is not None:
+            xa_m = jnp.moveaxis(xa, axis, -1) if xa.ndim == ya.ndim else xa
+            d = jnp.diff(xa_m, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        seg = (ya_m[..., 1:] + ya_m[..., :-1]) * 0.5 * d
+        return jnp.moveaxis(jnp.cumsum(seg, axis=-1), -1, axis)
+
+    if x is not None:
+        return call_op(impl, [y, ensure_tensor(x)],
+                       op_name="cumulative_trapezoid")
+    return call_op(impl, [y], op_name="cumulative_trapezoid")
+
+
+def unflatten(x, axis, shape, name=None):
+    """ref: paddle.unflatten — split one axis into the given shape."""
+    x = ensure_tensor(x)
+    shape = [int(unwrap(s)) if isinstance(s, Tensor) else int(s)
+             for s in (shape if isinstance(shape, (list, tuple))
+                       else list(unwrap(shape)))]
+
+    def impl(a):
+        new = list(a.shape[:axis]) + list(shape) \
+            + list(a.shape[axis + 1:] if axis != -1 else [])
+        if axis == -1:
+            new = list(a.shape[:-1]) + list(shape)
+        return a.reshape(new)
+
+    return call_op(impl, [x], op_name="unflatten")
+
+
+def _stack_like(name, jfn, npfn):
+    def fn(x, name=None):
+        tensors = [ensure_tensor(t) for t in x]
+        return call_op(lambda *arrs: jfn(arrs), tensors, op_name=name)
+    fn.__name__ = name
+    setattr(_mod, name, fn)
+    REGISTRY[name] = OpDef(
+        name, jfn, arity=-1,
+        np_ref=lambda *arrs: npfn(list(arrs)),
+        gen_cases=lambda: [tuple(np.random.RandomState(0)
+                                 .randn(2, 3).astype("float32")
+                                 for _ in range(3))])
+    return fn
+
+
+hstack = _stack_like("hstack", jnp.hstack, np.hstack)
+vstack = _stack_like("vstack", jnp.vstack, np.vstack)
+dstack = _stack_like("dstack", jnp.dstack, np.dstack)
+column_stack = _stack_like("column_stack", jnp.column_stack,
+                           np.column_stack)
+setattr(_mod, "row_stack", vstack)
+REGISTRY["vstack"].aliases = ("row_stack",)
+
+
+def block_diag(inputs, name=None):
+    """ref: paddle.block_diag."""
+    tensors = [ensure_tensor(t) for t in inputs]
+    return call_op(lambda *arrs: jax.scipy.linalg.block_diag(*arrs),
+                   tensors, op_name="block_diag")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """ref: paddle.diagonal_scatter — write y onto a diagonal of x."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        am = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        n, m = am.shape[-2], am.shape[-1]
+        k = b.shape[-1] if b.ndim else 1
+        i = jnp.arange(k)
+        rows = max(-offset, 0) + i
+        cols = max(offset, 0) + i
+        out = am.at[..., rows, cols].set(b)
+        return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+    return call_op(impl, [x, y], op_name="diagonal_scatter")
+
+
+def index_fill(x, index, axis, value, name=None):
+    """ref: paddle.index_fill."""
+    x = ensure_tensor(x)
+    index = ensure_tensor(index)
+    v = float(unwrap(value)) if isinstance(value, Tensor) else value
+
+    def impl(a, idx):
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[idx].set(v)
+        return jnp.moveaxis(am, 0, axis)
+
+    return call_op(impl, [x, index], op_name="index_fill")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """ref: paddle.select_scatter."""
+    x, values = ensure_tensor(x), ensure_tensor(values)
+
+    def impl(a, v):
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[index].set(v)
+        return jnp.moveaxis(am, 0, axis)
+
+    return call_op(impl, [x, values], op_name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """ref: paddle.slice_scatter."""
+    x, value = ensure_tensor(x), ensure_tensor(value)
+
+    def impl(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v)
+
+    return call_op(impl, [x, value], op_name="slice_scatter")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """ref: paddle.cdist — batched pairwise p-norm distance."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0.0))
+        if p == float("inf"):
+            return jnp.abs(diff).max(-1)
+        if p == 0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+
+    return call_op(impl, [x, y], op_name="cdist")
+
+
+def addmv(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """ref: paddle.addmv — beta*input + alpha*(x @ y)."""
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda i, a, b: beta * i + alpha * (a @ b),
+                   [input, x, y], op_name="addmv")
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """ref: paddle.baddbmm — beta*input + alpha*bmm(x, y)."""
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return call_op(
+        lambda i, a, b: beta * i + alpha * jnp.einsum("bij,bjk->bik", a, b),
+        [input, x, y], op_name="baddbmm")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """ref: paddle.vecdot — conjugating vector dot along an axis."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return call_op(lambda a, b: (jnp.conj(a) * b).sum(axis=axis), [x, y],
+                   op_name="vecdot")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """ref: paddle.histogramdd."""
+    x = ensure_tensor(x)
+    w = ensure_tensor(weights) if weights is not None else None
+
+    def impl(a, *rest):
+        wa = rest[0] if rest else None
+        hist, edges = jnp.histogramdd(a, bins=bins, range=ranges,
+                                      density=density, weights=wa)
+        return (hist,) + tuple(edges)
+
+    args = [x] + ([w] if w is not None else [])
+    outs = call_op(impl, args, multi_out=True, op_name="histogramdd")
+    return outs[0], list(outs[1:])
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """ref: paddle.combinations — r-combinations of a 1-D tensor."""
+    import itertools
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(gen), dtype="int64").reshape(-1, r)
+    return call_op(lambda a: a[idx], [x], op_name="combinations")
+
+
+def is_complex(x):
+    """ref: paddle.is_complex (host predicate)."""
+    return bool(jnp.issubdtype(ensure_tensor(x)._data.dtype,
+                               jnp.complexfloating))
+
+
+def is_floating_point(x):
+    """ref: paddle.is_floating_point (host predicate)."""
+    return bool(jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.floating))
+
+
+def is_integer(x):
+    """ref: paddle.is_integer (host predicate)."""
+    return bool(jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.integer))
+
+
+def standard_gamma(alpha, name=None):
+    """ref: paddle.standard_gamma — Gamma(alpha, 1) draws."""
+    from .. import random_state
+    alpha = ensure_tensor(alpha)
+    key = random_state.next_key()
+    return Tensor(jax.random.gamma(key, alpha._data))
+
+
+_register_manual("vander", np_ref=lambda x: np.vander(x),
+                gen_cases=lambda: [(np.array([1., 2., 3.], "float32"),)])
+_register_manual("trapezoid", np_ref=lambda y: np.trapezoid(y),
+                gen_cases=lambda: [(np.array([1., 2., 3., 4.], "float32"),)])
+_register_manual(
+    "cumulative_trapezoid",
+    np_ref=lambda y: np.concatenate(
+        [np.cumsum((y[1:] + y[:-1]) * 0.5)]),
+    gen_cases=lambda: [(np.array([1., 2., 3., 4.], "float32"),)])
+_register_manual("cdist",
+                np_ref=lambda a, b: np.sqrt(
+                    ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)),
+                gen_cases=lambda: [(np.random.RandomState(0)
+                                    .randn(4, 3).astype("float32"),
+                                    np.random.RandomState(1)
+                                    .randn(5, 3).astype("float32"))])
+_register_manual("addmv")
+_register_manual("baddbmm")
+_register_manual("vecdot",
+                np_ref=lambda a, b: (a * b).sum(-1),
+                gen_cases=lambda: _float_cases(2))
+_register_manual("block_diag")
+_register_manual("diagonal_scatter")
+_register_manual("index_fill")
+_register_manual("select_scatter")
+_register_manual("slice_scatter")
+_register_manual("unflatten")
+_register_manual("histogramdd")
+_register_manual("combinations")
+_register_manual("is_complex")
+_register_manual("is_floating_point")
+_register_manual("is_integer")
+_register_manual("standard_gamma")

@@ -1,0 +1,38 @@
+"""paddle.vision (ref: python/paddle/vision/__init__.py)."""
+from . import datasets, models, ops, transforms
+from .ops import RoIAlign, RoIPool, box_coder, nms, roi_align, roi_pool
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """ref: vision/image.py set_image_backend."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported backend {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    """ref: vision/image.py get_image_backend."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """ref: vision/image.py image_load."""
+    backend = backend or _image_backend
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as np
+    arr = np.asarray(img.convert("RGB"))
+    if backend == "cv2":
+        return arr[:, :, ::-1].copy()
+    from ..core.tensor import Tensor
+    return Tensor(arr.transpose(2, 0, 1))
+
+
+__all__ = ["datasets", "models", "ops", "transforms", "nms", "roi_align",
+           "roi_pool", "box_coder", "RoIAlign", "RoIPool",
+           "set_image_backend", "get_image_backend", "image_load"]

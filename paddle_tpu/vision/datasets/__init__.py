@@ -1,0 +1,8 @@
+"""paddle.vision.datasets (ref: python/paddle/vision/datasets/)."""
+from .cifar import Cifar10, Cifar100
+from .flowers import Flowers
+from .folder import DatasetFolder, ImageFolder
+from .mnist import MNIST, FashionMNIST
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder"]

@@ -1,0 +1,82 @@
+"""Cifar10/Cifar100 (ref: python/paddle/vision/datasets/cifar.py).
+
+Parses the standard python-pickle tar.gz archives.  No network egress:
+``data_file`` must point at a local ``cifar-10-python.tar.gz`` /
+``cifar-100-python.tar.gz``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class Cifar10(Dataset):
+    """ref: vision/datasets/cifar.py Cifar10."""
+
+    NAME = "cifar-10-python.tar.gz"
+    _member_prefix = "cifar-10-batches-py"
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if backend is None:
+            backend = "cv2"  # reference default returns HWC ndarray
+        self.backend = backend
+        self.mode = mode.lower()
+        if self.mode not in ("train", "test"):
+            raise ValueError(f"mode must be 'train' or 'test', got {mode}")
+        if data_file is None:
+            root = os.environ.get(
+                "PADDLE_TPU_DATA_HOME",
+                os.path.expanduser("~/.cache/paddle/dataset"))
+            data_file = os.path.join(root, "cifar", self.NAME)
+        if not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__} archive not found at {data_file!r}. "
+                f"No network egress — place the archive there or pass "
+                f"data_file.")
+        self.transform = transform
+        self._load(data_file)
+
+    def _load(self, data_file):
+        members = (self._train_members if self.mode == "train"
+                   else self._test_members)
+        data, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in members:
+                f = tf.extractfile(f"{self._member_prefix}/{m}")
+                batch = pickle.load(f, encoding="bytes")
+                data.append(batch[b"data"])
+                labels.extend(batch[self._label_key])
+        self.data = np.concatenate(data).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype="int64")
+
+    def __getitem__(self, idx):
+        image = self.data[idx].transpose(1, 2, 0)  # HWC uint8
+        label = np.array([self.labels[idx]]).astype("int64")
+        if self.backend == "pil":
+            from PIL import Image
+            image = Image.fromarray(image.astype("uint8"))
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    """ref: vision/datasets/cifar.py Cifar100."""
+
+    NAME = "cifar-100-python.tar.gz"
+    _member_prefix = "cifar-100-python"
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"

@@ -1,0 +1,114 @@
+"""Flowers-102 (ref: python/paddle/vision/datasets/flowers.py).
+
+Reads the standard 102flowers.tgz + imagelabels.mat + setid.mat trio from
+local files (no network egress).  scipy is unavailable in this image, so
+the tiny .mat (v5) parsing needed for the two label files is implemented
+directly.
+"""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+
+def _read_mat_arrays(path):
+    """Minimal MATLAB v5 .mat reader for the simple integer matrices the
+    flowers metadata uses (single var, numeric class)."""
+    import struct
+    import zlib
+    out = {}
+    with open(path, "rb") as f:
+        header = f.read(128)
+        if not header[:4] == b"MATL":
+            raise ValueError(f"{path}: not a MATLAB 5 file")
+        data = f.read()
+    pos = 0
+
+    def parse_element(buf, pos):
+        dtype, nbytes = struct.unpack_from("<II", buf, pos)
+        if dtype & 0xFFFF0000:  # small data element format
+            nbytes = dtype >> 16
+            dtype &= 0xFFFF
+            payload = buf[pos + 4:pos + 4 + nbytes]
+            return dtype, payload, pos + 8
+        payload = buf[pos + 8:pos + 8 + nbytes]
+        aligned = (nbytes + 7) & ~7
+        return dtype, payload, pos + 8 + aligned
+
+    while pos < len(data):
+        dtype, payload, pos = parse_element(data, pos)
+        if dtype == 15:  # miCOMPRESSED
+            sub = zlib.decompress(payload)
+            dtype, payload, _ = parse_element(sub, 0)
+        if dtype != 14:  # miMATRIX
+            continue
+        # parse miMATRIX: flags, dims, name, real data
+        sp = 0
+        _, _flags, sp = parse_element(payload, sp)
+        _, dims_raw, sp = parse_element(payload, sp)
+        dims = np.frombuffer(dims_raw, dtype="<i4")
+        _, name_raw, sp = parse_element(payload, sp)
+        name = name_raw.tobytes().decode() if isinstance(
+            name_raw, np.ndarray) else name_raw.decode()
+        dt, real_raw, sp = parse_element(payload, sp)
+        np_dt = {1: "<i1", 2: "<u1", 3: "<i2", 4: "<u2", 5: "<i4",
+                 6: "<u4", 7: "<f4", 9: "<f8", 12: "<i8",
+                 13: "<u8"}.get(dt, "<f8")
+        arr = np.frombuffer(real_raw, dtype=np_dt).reshape(
+            tuple(dims), order="F")
+        out[name.strip("\x00")] = arr
+    return out
+
+
+class Flowers(Dataset):
+    """ref: vision/datasets/flowers.py Flowers."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if backend is None:
+            backend = "pil"
+        self.backend = backend
+        self.mode = mode.lower()
+        if self.mode not in ("train", "valid", "test"):
+            raise ValueError(f"mode must be train/valid/test, got {mode}")
+        root = os.environ.get("PADDLE_TPU_DATA_HOME",
+                              os.path.expanduser("~/.cache/paddle/dataset"))
+        data_file = data_file or os.path.join(root, "flowers",
+                                              "102flowers.tgz")
+        label_file = label_file or os.path.join(root, "flowers",
+                                                "imagelabels.mat")
+        setid_file = setid_file or os.path.join(root, "flowers", "setid.mat")
+        for p in (data_file, label_file, setid_file):
+            if not os.path.exists(p):
+                raise RuntimeError(
+                    f"Flowers file not found: {p!r}. No network egress — "
+                    f"place the files there or pass explicit paths.")
+        self.transform = transform
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[self.mode]
+        setid = _read_mat_arrays(setid_file)
+        self.indexes = setid[key].ravel().astype("int64")
+        labels = _read_mat_arrays(label_file)["labels"].ravel()
+        self.labels = labels.astype("int64")
+        self.data_tar = tarfile.open(data_file, "r:*")
+        self.name2member = {m.name: m for m in self.data_tar.getmembers()}
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]])
+        name = f"jpg/image_{index:05d}.jpg"
+        img_bytes = self.data_tar.extractfile(self.name2member[name]).read()
+        from PIL import Image
+        image = Image.open(io.BytesIO(img_bytes)).convert("RGB")
+        if self.backend == "cv2":
+            image = np.asarray(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label.astype("int64")
+
+    def __len__(self):
+        return len(self.indexes)

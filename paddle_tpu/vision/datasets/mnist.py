@@ -1,0 +1,96 @@
+"""MNIST / FashionMNIST (ref: python/paddle/vision/datasets/mnist.py).
+
+Parses the standard IDX file format.  This environment has no network
+egress, so ``download=True`` raises with instructions; point
+``image_path``/``label_path`` at local IDX files (gz or raw).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+_MODE_FILES = {
+    "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+    "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+}
+
+
+def _open_maybe_gz(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _parse_idx_images(path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _parse_idx_labels(path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+class MNIST(Dataset):
+    """ref: vision/datasets/mnist.py MNIST."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if backend is None:
+            backend = "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"unsupported backend {backend}")
+        self.backend = backend
+        self.mode = mode.lower()
+        if self.mode not in ("train", "test"):
+            raise ValueError(f"mode must be 'train' or 'test', got {mode}")
+        root = os.environ.get("PADDLE_TPU_DATA_HOME",
+                              os.path.expanduser("~/.cache/paddle/dataset"))
+        img_name, lbl_name = _MODE_FILES[self.mode]
+        if image_path is None:
+            image_path = os.path.join(root, self.NAME, img_name)
+        if label_path is None:
+            label_path = os.path.join(root, self.NAME, lbl_name)
+        if not os.path.exists(image_path) or not os.path.exists(label_path):
+            raise RuntimeError(
+                f"{type(self).__name__} files not found at {image_path!r} / "
+                f"{label_path!r}. This environment has no network egress — "
+                f"place the IDX files there or pass image_path/label_path.")
+        self.transform = transform
+        self.images = _parse_idx_images(image_path)
+        self.labels = _parse_idx_labels(label_path)
+
+    def __getitem__(self, idx):
+        image = self.images[idx]
+        label = np.array([self.labels[idx]]).astype("int64")
+        if self.backend == "pil":
+            from PIL import Image
+            image = Image.fromarray(image, mode="L")
+        if self.transform is not None:
+            image = self.transform(image)
+        if self.backend == "pil" and self.transform is None:
+            image = np.asarray(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    """ref: vision/datasets/mnist.py FashionMNIST — same IDX format."""
+
+    NAME = "fashion-mnist"

@@ -1,0 +1,104 @@
+"""GoogLeNet / Inception v1 (ref: python/paddle/vision/models/googlenet.py)."""
+from ... import concat, flatten, nn
+from .resnet import _load_pretrained
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c2_1, c2_3, c3_1, c3_5, proj):
+        super().__init__()
+        self.relu = nn.ReLU()
+        self.branch1 = nn.Conv2D(in_c, c1, 1)
+        self.branch2_1 = nn.Conv2D(in_c, c2_1, 1)
+        self.branch2_3 = nn.Conv2D(c2_1, c2_3, 3, padding=1)
+        self.branch3_1 = nn.Conv2D(in_c, c3_1, 1)
+        self.branch3_5 = nn.Conv2D(c3_1, c3_5, 5, padding=2)
+        self.branch4_pool = nn.MaxPool2D(kernel_size=3, stride=1, padding=1)
+        self.branch4_proj = nn.Conv2D(in_c, proj, 1)
+
+    def forward(self, x):
+        b1 = self.relu(self.branch1(x))
+        b2 = self.relu(self.branch2_3(self.relu(self.branch2_1(x))))
+        b3 = self.relu(self.branch3_5(self.relu(self.branch3_1(x))))
+        b4 = self.relu(self.branch4_proj(self.branch4_pool(x)))
+        return concat([b1, b2, b3, b4], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """ref: vision/models/googlenet.py GoogLeNet — returns (out, out1, out2)
+    with the two auxiliary heads, like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.relu = nn.ReLU()
+
+        self._conv = nn.Conv2D(3, 64, 7, stride=2, padding=3)
+        # no padding: the aux heads' 1152-dim fc depends on the 13x13
+        # feature map this pooling chain yields at 224x224 input
+        self._pool = nn.MaxPool2D(kernel_size=3, stride=2)
+        self._conv_1 = nn.Conv2D(64, 64, 1)
+        self._conv_2 = nn.Conv2D(64, 192, 3, padding=1)
+
+        self._ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self._ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self._ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self._ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self._ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self._ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self._ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self._ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self._ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self._pool_5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._drop = nn.Dropout(p=0.4)
+            self._fc_out = nn.Linear(1024, num_classes)
+            # aux head 1
+            self._pool_o1 = nn.AvgPool2D(kernel_size=5, stride=3)
+            self._conv_o1 = nn.Conv2D(512, 128, 1)
+            self._fc_o1 = nn.Linear(1152, 1024)
+            self._drop_o1 = nn.Dropout(p=0.7)
+            self._out1 = nn.Linear(1024, num_classes)
+            # aux head 2
+            self._pool_o2 = nn.AvgPool2D(kernel_size=5, stride=3)
+            self._conv_o2 = nn.Conv2D(528, 128, 1)
+            self._fc_o2 = nn.Linear(1152, 1024)
+            self._drop_o2 = nn.Dropout(p=0.7)
+            self._out2 = nn.Linear(1024, num_classes)
+
+    def forward(self, inputs):
+        x = self._pool(self.relu(self._conv(inputs)))
+        x = self.relu(self._conv_1(x))
+        x = self._pool(self.relu(self._conv_2(x)))
+        x = self._ince3b(self._ince3a(x))
+        x = self._pool(x)
+        ince4a = self._ince4a(x)
+        x = self._ince4c(self._ince4b(ince4a))
+        ince4d = self._ince4d(x)
+        x = self._pool(self._ince4e(ince4d))
+        x = self._ince5b(self._ince5a(x))
+
+        if self.with_pool:
+            x = self._pool_5(x)
+        if self.num_classes <= 0:
+            return x
+        x = self._drop(x)
+        x = flatten(x, 1)
+        out = self._fc_out(x)
+
+        o1 = self.relu(self._conv_o1(self._pool_o1(ince4a)))
+        o1 = flatten(o1, 1)
+        o1 = self._drop_o1(self.relu(self._fc_o1(o1)))
+        out1 = self._out1(o1)
+
+        o2 = self.relu(self._conv_o2(self._pool_o2(ince4d)))
+        o2 = flatten(o2, 1)
+        o2 = self._drop_o2(self.relu(self._fc_o2(o2)))
+        out2 = self._out2(o2)
+        return [out, out1, out2]
+
+
+def googlenet(pretrained=False, **kwargs):
+    return _load_pretrained(GoogLeNet(**kwargs), "googlenet", pretrained)

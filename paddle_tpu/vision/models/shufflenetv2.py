@@ -1,0 +1,141 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py)."""
+from ... import concat, flatten, nn
+from .resnet import _load_pretrained
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_channels, out_channels, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_features = out_channels // 2
+        act_layer = nn.ReLU if act == "relu" else nn.Hardswish
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_channels, in_channels, 3, stride=stride,
+                          padding=1, groups=in_channels, bias_attr=False),
+                nn.BatchNorm2D(in_channels),
+                nn.Conv2D(in_channels, branch_features, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer())
+            branch2_in = in_channels
+        else:
+            self.branch1 = None
+            branch2_in = in_channels // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(branch2_in, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features), act_layer(),
+            nn.Conv2D(branch_features, branch_features, 3, stride=stride,
+                      padding=1, groups=branch_features, bias_attr=False),
+            nn.BatchNorm2D(branch_features),
+            nn.Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features), act_layer())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """ref: vision/models/shufflenetv2.py ShuffleNetV2."""
+
+    _CFG = {
+        0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+        0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+        1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+    }
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        channels = self._CFG[scale]
+
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(channels[0]),
+            nn.ReLU() if act == "relu" else nn.Hardswish())
+        self.max_pool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+
+        blocks = []
+        in_c = channels[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_c = channels[stage + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidual(in_c, out_c,
+                                               stride=2 if i == 0 else 1,
+                                               act=act))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[-1]),
+            nn.ReLU() if act == "relu" else nn.Hardswish())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.blocks(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(arch, scale, act, pretrained, **kwargs):
+    model = ShuffleNetV2(scale=scale, act=act, **kwargs)
+    return _load_pretrained(model, arch, pretrained)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x0_25", 0.25, "relu", pretrained,
+                       **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x0_33", 0.33, "relu", pretrained,
+                       **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x0_5", 0.5, "relu", pretrained,
+                       **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x1_0", 1.0, "relu", pretrained,
+                       **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x1_5", 1.5, "relu", pretrained,
+                       **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_x2_0", 2.0, "relu", pretrained,
+                       **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet("shufflenet_v2_swish", 1.0, "swish", pretrained,
+                       **kwargs)

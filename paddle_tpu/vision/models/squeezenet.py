@@ -1,0 +1,79 @@
+"""SqueezeNet (ref: python/paddle/vision/models/squeezenet.py)."""
+from ... import concat, flatten, nn
+from .resnet import _load_pretrained
+
+
+class MakeFire(nn.Layer):
+    def __init__(self, in_channels, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels):
+        super().__init__()
+        self._conv = nn.Conv2D(in_channels, squeeze_channels, 1)
+        self._conv_path1 = nn.Conv2D(squeeze_channels, expand1x1_channels, 1)
+        self._conv_path2 = nn.Conv2D(squeeze_channels, expand3x3_channels, 3,
+                                     padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self._conv(x))
+        x1 = self.relu(self._conv_path1(x))
+        x2 = self.relu(self._conv_path2(x))
+        return concat([x1, x2], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """ref: vision/models/squeezenet.py SqueezeNet (version 1.0/1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version not in ("1.0", "1.1"):
+            raise ValueError("supported versions are 1.0 and 1.1")
+
+        if version == "1.0":
+            self._conv = nn.Conv2D(3, 96, 7, stride=2)
+            self._fire_layers = nn.Sequential(
+                nn.MaxPool2D(3, 2),
+                MakeFire(96, 16, 64, 64), MakeFire(128, 16, 64, 64),
+                MakeFire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                MakeFire(256, 32, 128, 128), MakeFire(256, 48, 192, 192),
+                MakeFire(384, 48, 192, 192), MakeFire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), MakeFire(512, 64, 256, 256))
+        else:
+            self._conv = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            self._fire_layers = nn.Sequential(
+                nn.MaxPool2D(3, 2),
+                MakeFire(64, 16, 64, 64), MakeFire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                MakeFire(128, 32, 128, 128), MakeFire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                MakeFire(256, 48, 192, 192), MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256), MakeFire(512, 64, 256, 256))
+        self.relu = nn.ReLU()
+        if num_classes > 0:
+            self._drop = nn.Dropout(p=0.5)
+            self._conv9 = nn.Conv2D(512, num_classes, 1)
+        if with_pool:
+            self._avg_pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.relu(self._conv(x))
+        x = self._fire_layers(x)
+        if self.num_classes > 0:
+            x = self.relu(self._conv9(self._drop(x)))
+        if self.with_pool:
+            x = self._avg_pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    model = SqueezeNet(version="1.0", **kwargs)
+    return _load_pretrained(model, "squeezenet1_0", pretrained)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    model = SqueezeNet(version="1.1", **kwargs)
+    return _load_pretrained(model, "squeezenet1_1", pretrained)

@@ -1,0 +1,253 @@
+"""Vision ops (ref: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, ... backed by phi CUDA kernels there; here jnp compositions that
+XLA fuses, gather-based bilinear sampling on the MXU-friendly layout).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """ref: vision/ops.py nms — returns kept box indices (descending
+    score).  Host-side greedy suppression (data-dependent output size
+    cannot live under jit; the reference's CUDA kernel is likewise a
+    sync point)."""
+    boxes_np = boxes.numpy() if isinstance(boxes, Tensor) else np.asarray(boxes)
+    n = boxes_np.shape[0]
+    if scores is None:
+        order = np.arange(n)
+    else:
+        s = scores.numpy() if isinstance(scores, Tensor) else np.asarray(scores)
+        order = np.argsort(-s)
+
+    if category_idxs is not None:
+        cats = (category_idxs.numpy() if isinstance(category_idxs, Tensor)
+                else np.asarray(category_idxs))
+        kept_all = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            mask = cats == c
+            idxs = np.nonzero(mask)[0]
+            if idxs.size == 0:
+                continue
+            sub_scores = None if scores is None else s[idxs]
+            sub_kept = nms(Tensor(jnp.asarray(boxes_np[idxs])),
+                           iou_threshold,
+                           None if sub_scores is None
+                           else Tensor(jnp.asarray(sub_scores)))
+            kept_all.extend(idxs[sub_kept.numpy()])
+        kept_all = np.asarray(sorted(
+            kept_all,
+            key=(lambda i: -s[i]) if scores is not None else None),
+            dtype="int64")
+        if top_k is not None:
+            kept_all = kept_all[:top_k]
+        return Tensor(jnp.asarray(kept_all))
+
+    x1, y1, x2, y2 = (boxes_np[:, 0], boxes_np[:, 1], boxes_np[:, 2],
+                      boxes_np[:, 3])
+    areas = (x2 - x1) * (y2 - y1)
+    keep = []
+    suppressed = np.zeros(n, dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1)
+        yy1 = np.maximum(y1[i], y1)
+        xx2 = np.minimum(x2[i], x2)
+        yy2 = np.minimum(y2[i], y2)
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    kept = np.asarray(keep, dtype="int64")
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept))
+
+
+def _roi_align_impl(x, boxes, boxes_num, output_size, spatial_scale,
+                    sampling_ratio, aligned):
+    """Gather-based bilinear ROI align — pure jnp, differentiable."""
+    N, C, H, W = x.shape
+    ph, pw = output_size
+    offset = 0.5 if aligned else 0.0
+    # map each box to its batch image
+    box_batch = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                           total_repeat_length=boxes.shape[0])
+
+    def one_roi(box, b):
+        x1, y1, x2, y2 = box * spatial_scale - offset
+        roi_w = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+        roi_h = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        s = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: (ph*s, pw*s)
+        iy = (jnp.arange(ph * s) + 0.5) / s
+        ix = (jnp.arange(pw * s) + 0.5) / s
+        ys = y1 + iy * bin_h
+        xs = x1 + ix * bin_w
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        wy = ys - y0
+        wx = xs - x0
+        y0c = jnp.clip(y0, 0, H - 1)
+        y1c = jnp.clip(y0 + 1, 0, H - 1)
+        x0c = jnp.clip(x0, 0, W - 1)
+        x1c = jnp.clip(x0 + 1, 0, W - 1)
+        img = x[b]  # (C,H,W)
+        top = (img[:, y0c][:, :, x0c] * (1 - wx)[None, None, :]
+               + img[:, y0c][:, :, x1c] * wx[None, None, :])
+        bot = (img[:, y1c][:, :, x0c] * (1 - wx)[None, None, :]
+               + img[:, y1c][:, :, x1c] * wx[None, None, :])
+        vals = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+        # average the s*s samples per bin
+        vals = vals.reshape(C, ph, s, pw, s).mean(axis=(2, 4))
+        return vals
+
+    import jax
+    return jax.vmap(one_roi)(boxes, box_batch)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ref: vision/ops.py roi_align."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    x, boxes, boxes_num = (_as_tensor(x), _as_tensor(boxes),
+                           _as_tensor(boxes_num))
+    return call_op(
+        lambda xa, ba, bn: _roi_align_impl(xa, ba, bn, output_size,
+                                           spatial_scale, sampling_ratio,
+                                           aligned),
+        [x, boxes, boxes_num], op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """ref: vision/ops.py roi_pool (max pooling inside each bin)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x, boxes, boxes_num = (_as_tensor(x), _as_tensor(boxes),
+                           _as_tensor(boxes_num))
+
+    def impl(xa, ba, bn):
+        import jax
+        N, C, H, W = xa.shape
+        box_batch = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=ba.shape[0])
+
+        def one_roi(box, b):
+            x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1)
+            img = xa[b]
+            ys = jnp.arange(H)
+            xs = jnp.arange(W)
+            # bin index of each pixel, -1 outside roi
+            by = jnp.where((ys >= y1) & (ys <= y2),
+                           jnp.clip((ys - y1) * ph // roi_h, 0, ph - 1), -1)
+            bx = jnp.where((xs >= x1) & (xs <= x2),
+                           jnp.clip((xs - x1) * pw // roi_w, 0, pw - 1), -1)
+            neg = jnp.finfo(xa.dtype).min
+            out = jnp.full((C, ph, pw), neg, xa.dtype)
+            onehot_y = (by[:, None] == jnp.arange(ph)[None, :])  # (H,ph)
+            onehot_x = (bx[:, None] == jnp.arange(pw)[None, :])  # (W,pw)
+            masked = jnp.where(onehot_y.T[None, :, :, None],
+                               img[:, None, :, :], neg)  # (C,ph,H,W)
+            rowmax = masked.max(axis=2)  # (C,ph,W)
+            masked2 = jnp.where(onehot_x.T[None, None, :, :],
+                                rowmax[:, :, None, :], neg)  # (C,ph,pw,W)
+            return masked2.max(axis=3)
+
+        return jax.vmap(one_roi)(ba, box_batch)
+
+    return call_op(impl, [x, boxes, boxes_num], op_name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """ref: vision/ops.py box_coder (encode/decode center-size)."""
+    pb = _as_tensor(prior_box)
+    tb = _as_tensor(target_box)
+    pbv = None if prior_box_var is None else _as_tensor(prior_box_var)
+
+    def impl(pba, tba, *rest):
+        pbva = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = pba[:, 2] - pba[:, 0] + norm
+        ph_ = pba[:, 3] - pba[:, 1] + norm
+        px = pba[:, 0] + pw * 0.5
+        py = pba[:, 1] + ph_ * 0.5
+        if code_type == "encode_center_size":
+            tw = tba[:, 2] - tba[:, 0] + norm
+            th = tba[:, 3] - tba[:, 1] + norm
+            tx = tba[:, 0] + tw * 0.5
+            ty = tba[:, 1] + th * 0.5
+            ox = (tx[:, None] - px[None, :]) / pw[None, :]
+            oy = (ty[:, None] - py[None, :]) / ph_[None, :]
+            ow = jnp.log(tw[:, None] / pw[None, :])
+            oh = jnp.log(th[:, None] / ph_[None, :])
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)
+            if pbva is not None:
+                out = out / pbva[None, :, :]
+            return out
+        # decode
+        if pbva is not None:
+            tba = tba * (pbva[None, :, :] if pbva.ndim == 2 else pbva)
+        t = tba if tba.ndim == 3 else tba[:, None, :]
+        if axis == 0:
+            ox = t[..., 0] * pw[None, :] + px[None, :]
+            oy = t[..., 1] * ph_[None, :] + py[None, :]
+            ow = jnp.exp(t[..., 2]) * pw[None, :]
+            oh = jnp.exp(t[..., 3]) * ph_[None, :]
+        else:
+            ox = t[..., 0] * pw[:, None] + px[:, None]
+            oy = t[..., 1] * ph_[:, None] + py[:, None]
+            ow = jnp.exp(t[..., 2]) * pw[:, None]
+            oh = jnp.exp(t[..., 3]) * ph_[:, None]
+        return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                          ox + ow * 0.5 - norm, oy + oh * 0.5 - norm],
+                         axis=-1)
+
+    args = [pb, tb] + ([pbv] if pbv is not None else [])
+    return call_op(impl, args, op_name="box_coder")
+
+
+class RoIAlign:
+    """ref: vision/ops.py RoIAlign layer facade."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    """ref: vision/ops.py RoIPool layer facade."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

@@ -1,0 +1,24 @@
+"""paddle.vision.transforms (ref: python/paddle/vision/transforms/)."""
+from . import functional
+from .functional import (adjust_brightness, adjust_contrast, adjust_hue,
+                         adjust_saturation, center_crop, crop, erase, hflip,
+                         normalize, pad, resize, rotate, to_grayscale,
+                         to_tensor, vflip)
+from .transforms import (BaseTransform, BrightnessTransform, CenterCrop,
+                         ColorJitter, Compose, ContrastTransform, Grayscale,
+                         HueTransform, Normalize, Pad, RandomCrop,
+                         RandomErasing, RandomHorizontalFlip, RandomResizedCrop,
+                         RandomRotation, RandomVerticalFlip, Resize,
+                         SaturationTransform, ToTensor, Transpose)
+
+__all__ = [
+    "BaseTransform", "Compose", "ToTensor", "Normalize", "Resize",
+    "RandomResizedCrop", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "RandomRotation", "Pad",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "Grayscale", "RandomErasing",
+    "Transpose",
+    "to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
+    "hflip", "vflip", "rotate", "adjust_brightness", "adjust_contrast",
+    "adjust_saturation", "adjust_hue", "to_grayscale", "erase",
+]

@@ -1,0 +1,364 @@
+"""Functional image transforms (ref: python/paddle/vision/transforms/
+functional.py + functional_pil.py + functional_cv2.py + functional_tensor.py).
+
+Operates on PIL Images, numpy HWC arrays, and paddle Tensors.  The PIL
+path mirrors the reference's default backend; the numpy/tensor paths are
+pure-array implementations (no cv2 dependency in this image).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+try:
+    from PIL import Image, ImageEnhance, ImageOps
+    _HAS_PIL = True
+except ImportError:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def _is_pil(img) -> bool:
+    return _HAS_PIL and isinstance(img, Image.Image)
+
+
+def _is_numpy(img) -> bool:
+    return isinstance(img, np.ndarray)
+
+
+def _is_tensor(img) -> bool:
+    return isinstance(img, Tensor)
+
+
+_PIL_INTERP = {}
+if _HAS_PIL:
+    _PIL_INTERP = {
+        "nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+        "bicubic": Image.BICUBIC, "box": Image.BOX, "lanczos": Image.LANCZOS,
+        "hamming": Image.HAMMING,
+    }
+
+
+def to_tensor(pic, data_format="CHW") -> Tensor:
+    """ref: transforms.functional.to_tensor — PIL/ndarray → float32 Tensor
+    scaled to [0,1] (uint8 inputs) in CHW (default) or HWC."""
+    if _is_tensor(pic):
+        return pic
+    if _is_pil(pic):
+        arr = np.asarray(pic)
+    elif _is_numpy(pic):
+        arr = pic
+    else:
+        raise TypeError(f"unsupported image type {type(pic)}")
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype("float32") / 255.0
+    else:
+        arr = arr.astype("float32")
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    """ref: functional.normalize."""
+    if _is_pil(img):
+        img = np.asarray(img).astype("float32")
+        if img.ndim == 2:
+            img = img[:, :, None]
+        data_format = "HWC"
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    if _is_tensor(img):
+        from ... import to_tensor as tt
+        m = tt(mean.reshape(shape))
+        s = tt(std.reshape(shape))
+        return (img - m) / s
+    arr = img.astype("float32")
+    if to_rgb and data_format == "HWC":
+        arr = arr[..., ::-1]
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def _np_resize(arr: np.ndarray, size: Tuple[int, int],
+               interpolation="bilinear") -> np.ndarray:
+    """Pure-numpy separable resize (nearest / bilinear)."""
+    h, w = arr.shape[:2]
+    oh, ow = size
+    if interpolation == "nearest":
+        ry = (np.arange(oh) * (h / oh)).astype(np.int64).clip(0, h - 1)
+        rx = (np.arange(ow) * (w / ow)).astype(np.int64).clip(0, w - 1)
+        return arr[ry][:, rx]
+    # bilinear with align_corners=False convention
+    dtype = arr.dtype
+    fy = (np.arange(oh) + 0.5) * h / oh - 0.5
+    fx = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.floor(fy).astype(np.int64)
+    x0 = np.floor(fx).astype(np.int64)
+    wy = (fy - y0)[:, None]
+    wx = (fx - x0)[None, :]
+    y0c = y0.clip(0, h - 1)
+    y1c = (y0 + 1).clip(0, h - 1)
+    x0c = x0.clip(0, w - 1)
+    x1c = (x0 + 1).clip(0, w - 1)
+    a = arr.astype("float32")
+    if a.ndim == 2:
+        a = a[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    wy3 = wy[..., None]
+    wx3 = wx[..., None]
+    top = a[y0c][:, x0c] * (1 - wx3) + a[y0c][:, x1c] * wx3
+    bot = a[y1c][:, x0c] * (1 - wx3) + a[y1c][:, x1c] * wx3
+    out = top * (1 - wy3) + bot * wy3
+    if squeeze:
+        out = out[:, :, 0]
+    if dtype == np.uint8:
+        out = np.round(out).clip(0, 255).astype(np.uint8)
+    return out.astype(dtype) if dtype != np.uint8 else out
+
+
+def _target_size(img_size: Tuple[int, int], size) -> Tuple[int, int]:
+    """(w, h) of input, paddle size semantics: int = short side."""
+    w, h = img_size
+    if isinstance(size, int):
+        if (w <= h and w == size) or (h <= w and h == size):
+            return h, w
+        if w < h:
+            ow = size
+            oh = int(size * h / w)
+        else:
+            oh = size
+            ow = int(size * w / h)
+        return oh, ow
+    return size[0], size[1]  # (h, w)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """ref: functional.resize — int size resizes the short side."""
+    if _is_pil(img):
+        oh, ow = _target_size(img.size, size)
+        return img.resize((ow, oh), _PIL_INTERP[interpolation])
+    if _is_tensor(img):
+        arr = img.numpy()
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            arr = arr.transpose(1, 2, 0)
+        oh, ow = _target_size((arr.shape[1], arr.shape[0]), size)
+        out = _np_resize(arr, (oh, ow), interpolation)
+        if chw:
+            out = out.transpose(2, 0, 1)
+        return Tensor(out)
+    oh, ow = _target_size((img.shape[1], img.shape[0]), size)
+    return _np_resize(img, (oh, ow), interpolation)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """ref: functional.pad."""
+    if isinstance(padding, numbers.Number):
+        padding = (padding, padding, padding, padding)
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    if _is_pil(img):
+        if padding_mode == "constant":
+            return ImageOps.expand(img, (left, top, right, bottom),
+                                   fill=fill)
+        img = np.asarray(img)
+        out = pad(img, (left, top, right, bottom), fill, padding_mode)
+        return Image.fromarray(out)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else img
+    chw = was_tensor and arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+    if chw:
+        arr = arr.transpose(1, 2, 0)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    pads = [(top, bottom), (left, right)] + [(0, 0)] * (arr.ndim - 2)
+    if mode == "constant":
+        out = np.pad(arr, pads, mode=mode, constant_values=fill)
+    else:
+        out = np.pad(arr, pads, mode=mode)
+    if chw:
+        out = out.transpose(2, 0, 1)
+    return Tensor(out) if was_tensor else out
+
+
+def crop(img, top, left, height, width):
+    """ref: functional.crop."""
+    if _is_pil(img):
+        return img.crop((left, top, left + width, top + height))
+    if _is_tensor(img):
+        arr = img.numpy()
+        if arr.ndim == 3 and arr.shape[0] in (1, 3, 4):
+            return Tensor(arr[:, top:top + height, left:left + width])
+        return Tensor(arr[top:top + height, left:left + width])
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    """ref: functional.center_crop."""
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    if _is_pil(img):
+        w, h = img.size
+    elif _is_tensor(img) and img.ndim == 3 and img.shape[0] in (1, 3, 4):
+        h, w = img.shape[1], img.shape[2]
+    else:
+        h, w = img.shape[0], img.shape[1]
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    """ref: functional.hflip."""
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    if _is_tensor(img):
+        arr = img.numpy()
+        axis = 2 if (arr.ndim == 3 and arr.shape[0] in (1, 3, 4)) else 1
+        return Tensor(np.flip(arr, axis=axis).copy())
+    return np.flip(img, axis=1).copy()
+
+
+def vflip(img):
+    """ref: functional.vflip."""
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    if _is_tensor(img):
+        arr = img.numpy()
+        axis = 1 if (arr.ndim == 3 and arr.shape[0] in (1, 3, 4)) else 0
+        return Tensor(np.flip(arr, axis=axis).copy())
+    return np.flip(img, axis=0).copy()
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """ref: functional.rotate (PIL backend; array inputs round-trip
+    through PIL)."""
+    if _is_pil(img):
+        return img.rotate(angle, _PIL_INTERP[interpolation], expand, center,
+                          fillcolor=fill)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and was_tensor
+    if chw:
+        arr = arr.transpose(1, 2, 0)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil = Image.fromarray(arr[:, :, 0] if squeeze else arr)
+    out = np.asarray(rotate(pil, angle, interpolation, expand, center, fill))
+    if squeeze:
+        out = out[:, :, None]
+    if chw:
+        out = out.transpose(2, 0, 1)
+    return Tensor(out) if was_tensor else out
+
+
+def adjust_brightness(img, brightness_factor):
+    """ref: functional.adjust_brightness."""
+    if _is_pil(img):
+        return ImageEnhance.Brightness(img).enhance(brightness_factor)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else img
+    dtype = arr.dtype
+    out = arr.astype("float32") * brightness_factor
+    if dtype == np.uint8:
+        out = out.clip(0, 255).astype(np.uint8)
+    else:
+        out = out.astype(dtype)
+    return Tensor(out) if was_tensor else out
+
+
+def adjust_contrast(img, contrast_factor):
+    """ref: functional.adjust_contrast."""
+    if _is_pil(img):
+        return ImageEnhance.Contrast(img).enhance(contrast_factor)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else img
+    dtype = arr.dtype
+    f = arr.astype("float32")
+    mean = f.mean()
+    out = (f - mean) * contrast_factor + mean
+    if dtype == np.uint8:
+        out = out.clip(0, 255).astype(np.uint8)
+    else:
+        out = out.astype(dtype)
+    return Tensor(out) if was_tensor else out
+
+
+def adjust_saturation(img, saturation_factor):
+    """ref: functional.adjust_saturation."""
+    if _is_pil(img):
+        return ImageEnhance.Color(img).enhance(saturation_factor)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else img
+    dtype = arr.dtype
+    f = arr.astype("float32")
+    gray = f.mean(axis=-1, keepdims=True)
+    out = (f - gray) * saturation_factor + gray
+    if dtype == np.uint8:
+        out = out.clip(0, 255).astype(np.uint8)
+    else:
+        out = out.astype(dtype)
+    return Tensor(out) if was_tensor else out
+
+
+def adjust_hue(img, hue_factor):
+    """ref: functional.adjust_hue (|hue_factor| <= 0.5)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    if _is_pil(img):
+        h, s, v = img.convert("HSV").split()
+        np_h = np.asarray(h, dtype=np.uint8)
+        np_h = (np_h.astype(np.int16)
+                + np.int16(hue_factor * 255)).astype(np.uint8)
+        hsv = Image.merge("HSV", (Image.fromarray(np_h, "L"), s, v))
+        return hsv.convert(img.mode)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else np.asarray(img)
+    pil = Image.fromarray(arr.astype(np.uint8))
+    out = np.asarray(adjust_hue(pil, hue_factor))
+    return Tensor(out) if was_tensor else out
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ref: functional.to_grayscale."""
+    if _is_pil(img):
+        if num_output_channels == 1:
+            return img.convert("L")
+        return Image.merge("RGB", [img.convert("L")] * 3)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy() if was_tensor else img
+    w = np.array([0.299, 0.587, 0.114], dtype="float32")
+    gray = (arr.astype("float32") @ w)
+    if arr.dtype == np.uint8:
+        gray = gray.clip(0, 255).astype(np.uint8)
+    out = np.stack([gray] * num_output_channels, axis=-1)
+    return Tensor(out) if was_tensor else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """ref: functional.erase — fill the region [i:i+h, j:j+w] with v."""
+    if _is_pil(img):
+        arr = np.asarray(img).copy()
+        arr[i:i + h, j:j + w] = v
+        return Image.fromarray(arr)
+    was_tensor = _is_tensor(img)
+    arr = img.numpy().copy() if was_tensor else (
+        img if inplace else img.copy())
+    if arr.ndim == 3 and was_tensor and arr.shape[0] in (1, 3, 4):
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return Tensor(arr) if was_tensor else arr

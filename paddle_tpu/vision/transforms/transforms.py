@@ -1,0 +1,416 @@
+"""Transform classes (ref: python/paddle/vision/transforms/transforms.py).
+
+Each transform follows the reference's BaseTransform protocol: callable on
+PIL Image / ndarray / Tensor; ``keys`` support for paired inputs.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import functional as F
+
+
+class BaseTransform:
+    """ref: transforms.BaseTransform — keys-aware callable."""
+
+    def __init__(self, keys=None):
+        self.keys = keys if keys is not None else ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, tuple):
+            inputs = (inputs,)
+        self.params = self._get_params(inputs)
+        outputs = []
+        for i, key in enumerate(self.keys):
+            if i >= len(inputs):
+                break
+            apply = getattr(self, f"_apply_{key}", None)
+            outputs.append(apply(inputs[i]) if apply else inputs[i])
+        outputs.extend(inputs[len(self.keys):])
+        if len(outputs) == 1:
+            return outputs[0]
+        return tuple(outputs)
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class Compose:
+    """ref: transforms.Compose."""
+
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+    def __repr__(self):
+        inner = ", ".join(repr(t) for t in self.transforms)
+        return f"Compose([{inner}])"
+
+
+class ToTensor(BaseTransform):
+    """ref: transforms.ToTensor."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return F.to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    """ref: transforms.Normalize."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+        self.to_rgb = to_rgb
+
+    def _apply_image(self, img):
+        return F.normalize(img, self.mean, self.std, self.data_format,
+                           self.to_rgb)
+
+
+class Resize(BaseTransform):
+    """ref: transforms.Resize."""
+
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return F.resize(img, self.size, self.interpolation)
+
+
+class RandomResizedCrop(BaseTransform):
+    """ref: transforms.RandomResizedCrop."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _dims(self, img):
+        if F._is_pil(img):
+            w, h = img.size
+        elif F._is_tensor(img) and img.ndim == 3 and img.shape[0] in (1, 3, 4):
+            h, w = img.shape[1], img.shape[2]
+        else:
+            h, w = img.shape[0], img.shape[1]
+        return h, w
+
+    def _apply_image(self, img):
+        import math
+        h, w = self._dims(img)
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            aspect = math.exp(random.uniform(*log_ratio))
+            cw = int(round(math.sqrt(target_area * aspect)))
+            ch = int(round(math.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                img = F.crop(img, top, left, ch, cw)
+                return F.resize(img, self.size, self.interpolation)
+        # fallback: center crop
+        in_ratio = w / h
+        if in_ratio < self.ratio[0]:
+            cw, ch = w, int(round(w / self.ratio[0]))
+        elif in_ratio > self.ratio[1]:
+            ch, cw = h, int(round(h * self.ratio[1]))
+        else:
+            cw, ch = w, h
+        top = (h - ch) // 2
+        left = (w - cw) // 2
+        img = F.crop(img, top, left, ch, cw)
+        return F.resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    """ref: transforms.CenterCrop."""
+
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return F.center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    """ref: transforms.RandomCrop."""
+
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = F.pad(img, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        if F._is_pil(img):
+            w, h = img.size
+        elif F._is_tensor(img) and img.ndim == 3 and img.shape[0] in (1, 3, 4):
+            h, w = img.shape[1], img.shape[2]
+        else:
+            h, w = img.shape[0], img.shape[1]
+        if self.pad_if_needed and w < tw:
+            img = F.pad(img, (tw - w, 0), self.fill, self.padding_mode)
+            w = tw
+        if self.pad_if_needed and h < th:
+            img = F.pad(img, (0, th - h), self.fill, self.padding_mode)
+            h = th
+        if w == tw and h == th:
+            return img
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return F.crop(img, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    """ref: transforms.RandomHorizontalFlip."""
+
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return F.hflip(img)
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    """ref: transforms.RandomVerticalFlip."""
+
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return F.vflip(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    """ref: transforms.RandomRotation."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return F.rotate(img, angle, self.interpolation, self.expand,
+                        self.center, self.fill)
+
+
+class Pad(BaseTransform):
+    """ref: transforms.Pad."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    """ref: transforms.BrightnessTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_brightness(img, factor)
+
+
+class ContrastTransform(BaseTransform):
+    """ref: transforms.ContrastTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    """ref: transforms.SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    """ref: transforms.HueTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(-self.value, self.value)
+        return F.adjust_hue(img, factor)
+
+
+class ColorJitter(BaseTransform):
+    """ref: transforms.ColorJitter — random order of the four jitters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        transforms = []
+        if self.brightness:
+            transforms.append(BrightnessTransform(self.brightness))
+        if self.contrast:
+            transforms.append(ContrastTransform(self.contrast))
+        if self.saturation:
+            transforms.append(SaturationTransform(self.saturation))
+        if self.hue:
+            transforms.append(HueTransform(self.hue))
+        random.shuffle(transforms)
+        for t in transforms:
+            img = t._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    """ref: transforms.Grayscale."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """ref: transforms.RandomErasing."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        import math
+        if random.random() >= self.prob:
+            return img
+        if F._is_pil(img):
+            w, h = img.size
+            c = len(img.getbands())
+        elif F._is_tensor(img) and img.ndim == 3 and img.shape[0] in (1, 3, 4):
+            c, h, w = img.shape
+        else:
+            h, w = img.shape[0], img.shape[1]
+            c = img.shape[2] if img.ndim == 3 else 1
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = math.exp(random.uniform(math.log(self.ratio[0]),
+                                             math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target / aspect)))
+            ew = int(round(math.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                v = self.value
+                if v == "random":
+                    v = np.random.rand(eh, ew, c).astype("float32")
+                return F.erase(img, top, left, eh, ew, v, self.inplace)
+        return img
+
+
+class Transpose(BaseTransform):
+    """ref: transforms.Transpose — HWC->CHW by default."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        if F._is_pil(img):
+            img = np.asarray(img)
+        if F._is_tensor(img):
+            return img.transpose(list(self.order))
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)

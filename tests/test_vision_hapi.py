@@ -1,0 +1,341 @@
+"""Vision + metric + hapi vertical slice (BASELINE config 1 shape).
+
+ref test strategy: test/legacy_test/test_vision_models.py,
+test_hapi_model.py, test_metrics.py — forward-shape checks on the model
+zoo, Model.fit on a tiny synthetic dataset, streaming-metric math vs
+numpy.
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset, DataLoader
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall, accuracy
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import MNIST
+
+
+# ---------------------------------------------------------------------------
+# models
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ctor,n_cls,in_hw", [
+    (lambda: models.resnet18(num_classes=7), 7, 64),
+    (lambda: models.resnet50(num_classes=7), 7, 64),
+    (lambda: models.mobilenet_v2(num_classes=7), 7, 64),
+    (lambda: models.mobilenet_v3_small(num_classes=7), 7, 64),
+    (lambda: models.squeezenet1_1(num_classes=7), 7, 64),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=7), 7, 64),
+])
+def test_model_forward_shapes(ctor, n_cls, in_hw):
+    m = ctor()
+    m.eval()
+    x = paddle.randn([2, 3, in_hw, in_hw])
+    out = m(x)
+    assert list(out.shape) == [2, n_cls]
+
+
+def test_resnet_backbone_mode():
+    m = models.resnet18(num_classes=0, with_pool=False)
+    m.eval()
+    out = m(paddle.randn([1, 3, 64, 64]))
+    assert out.shape[1] == 512
+
+
+def test_lenet_and_vgg_forward():
+    le = models.LeNet()
+    le.eval()
+    assert list(le(paddle.randn([2, 1, 28, 28])).shape) == [2, 10]
+    vg = models.vgg11(num_classes=5)
+    vg.eval()
+    assert list(vg(paddle.randn([1, 3, 224, 224])).shape) == [1, 5]
+
+
+def test_densenet_googlenet_forward():
+    dn = models.densenet121(num_classes=4)
+    dn.eval()
+    assert list(dn(paddle.randn([1, 3, 64, 64])).shape) == [1, 4]
+    gn = models.googlenet(num_classes=4)
+    gn.eval()
+    out, o1, o2 = gn(paddle.randn([1, 3, 224, 224]))
+    assert list(out.shape) == [1, 4]
+
+
+def test_resnet_trains():
+    paddle.seed(0)
+    m = models.resnet18(num_classes=4)
+    m.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    ce = nn.CrossEntropyLoss()
+    x = paddle.randn([8, 3, 32, 32])
+    y = paddle.to_tensor(np.random.randint(0, 4, (8,)))
+    losses = []
+    for _ in range(5):
+        loss = ce(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+def test_transforms_pipeline_pil():
+    from PIL import Image
+    img = Image.fromarray(
+        np.random.randint(0, 255, (40, 60, 3), dtype=np.uint8))
+    tf = transforms.Compose([
+        transforms.Resize(32),
+        transforms.CenterCrop(24),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    out = tf(img)
+    assert list(out.shape) == [3, 24, 24]
+    assert str(out.dtype) in ("paddle.float32", "float32")
+
+
+def test_transforms_numpy_and_functional():
+    img = np.random.randint(0, 255, (32, 48, 3), dtype=np.uint8)
+    r = transforms.resize(img, (16, 24))
+    assert r.shape[:2] == (16, 24)
+    f = transforms.hflip(img)
+    np.testing.assert_array_equal(f[:, ::-1], img)
+    p = transforms.pad(img, 2)
+    assert p.shape[:2] == (36, 52)
+    c = transforms.center_crop(img, 20)
+    assert c.shape[:2] == (20, 20)
+    g = transforms.to_grayscale(img)
+    assert g.shape[-1] == 1
+    b = transforms.adjust_brightness(img, 1.5)
+    assert b.dtype == np.uint8
+
+
+def test_random_resized_crop_and_erasing():
+    img = np.random.randint(0, 255, (50, 50, 3), dtype=np.uint8)
+    rrc = transforms.RandomResizedCrop(24)
+    assert rrc(img).shape[:2] == (24, 24)
+    t = transforms.ToTensor()(img)
+    er = transforms.RandomErasing(prob=1.0)(t)
+    assert er.shape == t.shape
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+def _write_mnist_idx(tmp_path, n=20):
+    """Write a tiny valid IDX pair (the real parser is under test)."""
+    img_path = os.path.join(tmp_path, "imgs.idx3.gz")
+    lbl_path = os.path.join(tmp_path, "lbls.idx1.gz")
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    lbls = rs.randint(0, 10, (n,), dtype=np.uint8)
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    return img_path, lbl_path, imgs, lbls
+
+
+def test_mnist_dataset(tmp_path):
+    img_path, lbl_path, imgs, lbls = _write_mnist_idx(str(tmp_path))
+    ds = MNIST(image_path=img_path, label_path=lbl_path, mode="train",
+               transform=transforms.ToTensor())
+    assert len(ds) == 20
+    x, y = ds[3]
+    assert list(x.shape) == [1, 28, 28]
+    assert int(y[0]) == lbls[3]
+
+
+def test_mnist_missing_file_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="not found"):
+        MNIST(image_path=str(tmp_path / "nope"),
+              label_path=str(tmp_path / "nope2"))
+
+
+def test_dataset_folder(tmp_path):
+    from PIL import Image
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.fromarray(np.zeros((8, 8, 3), dtype=np.uint8)).save(
+                d / f"{i}.png")
+    from paddle_tpu.vision.datasets import DatasetFolder
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, target = ds[0]
+    assert target == 0
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+
+def test_nms():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], dtype="float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], dtype="float32"))
+    kept = paddle.vision.ops.nms(boxes, 0.5, scores)
+    assert list(kept.numpy()) == [0, 2]
+
+
+def test_roi_align_shape_and_value():
+    x = paddle.to_tensor(
+        np.arange(1 * 1 * 8 * 8, dtype="float32").reshape(1, 1, 8, 8))
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], dtype="float32"))
+    boxes_num = paddle.to_tensor(np.array([1], dtype="int32"))
+    out = paddle.vision.ops.roi_align(x, boxes, boxes_num, 4,
+                                      sampling_ratio=2, aligned=False)
+    assert list(out.shape) == [1, 1, 4, 4]
+    # bilinear sampling of the linear ramp x[y,j]=8y+j is exact away from
+    # the clamped border: interior bin (i,j) = 8*(2i+1) + (2j+1)
+    got = out.numpy()[0, 0]
+    for i in range(3):
+        for j in range(3):
+            np.testing.assert_allclose(got[i, j], 8 * (2 * i + 1)
+                                       + (2 * j + 1), rtol=1e-5)
+
+
+def test_roi_pool_shape():
+    x = paddle.randn([1, 2, 8, 8])
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], dtype="float32"))
+    boxes_num = paddle.to_tensor(np.array([1], dtype="int32"))
+    out = paddle.vision.ops.roi_pool(x, boxes, boxes_num, 2)
+    assert list(out.shape) == [1, 2, 2, 2]
+    np.testing.assert_allclose(float(out.numpy().max()),
+                               float(x.numpy().max()), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_accuracy_metric_stream():
+    m = Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.array(
+        [[0.1, 0.7, 0.2], [0.8, 0.1, 0.1], [0.1, 0.2, 0.7]], "float32"))
+    label = paddle.to_tensor(np.array([[1], [2], [2]], "int64"))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 3) < 1e-6
+    assert abs(top2 - 2 / 3) < 1e-6 or top2 >= top1
+
+
+def test_precision_recall_auc():
+    p = Precision()
+    r = Recall()
+    preds = np.array([1, 1, 0, 1])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+    a = Auc()
+    probs = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]])
+    lab = np.array([[1], [0], [1], [0]])
+    a.update(probs, lab)
+    assert a.accumulate() == 1.0  # perfectly separable
+
+
+def test_functional_accuracy():
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+    lab = paddle.to_tensor(np.array([[1], [0]], "int64"))
+    acc = accuracy(pred, lab)
+    assert float(acc) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# hapi Model — the config-1 vertical slice
+# ---------------------------------------------------------------------------
+
+class _SynthImages(Dataset):
+    def __init__(self, n=32, n_cls=4, hw=16, seed=0):
+        rs = np.random.RandomState(seed)
+        self.y = rs.randint(0, n_cls, (n,)).astype("int64")
+        # class-dependent mean makes the task learnable
+        self.x = (rs.randn(n, 3, hw, hw).astype("float32")
+                  + self.y[:, None, None, None].astype("float32"))
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i:i + 1]
+
+    def __len__(self):
+        return len(self.y)
+
+
+def test_model_fit_evaluate_predict(tmp_path, capsys):
+    paddle.seed(0)
+    net = models.resnet18(num_classes=4)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    train = _SynthImages(n=32)
+    val = _SynthImages(n=16, seed=1)
+    model.fit(train, val, batch_size=8, epochs=2, verbose=0,
+              save_dir=str(tmp_path / "ck"))
+    res = model.evaluate(val, batch_size=8, verbose=0)
+    assert "acc" in res and "eval_loss" in res
+    preds = model.predict(val, batch_size=8, stack_outputs=True, verbose=0)
+    assert preds[0].shape == (16, 4)
+    # checkpoints written
+    assert os.path.exists(str(tmp_path / "ck" / "final.pdparams"))
+    # load round-trip
+    m2 = paddle.Model(models.resnet18(num_classes=4))
+    m2.load(str(tmp_path / "ck" / "final"))
+
+
+def test_model_fit_learns():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(3 * 16 * 16, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    data = _SynthImages(n=64)
+    model.fit(data, batch_size=16, epochs=12, verbose=0)
+    res = model.evaluate(data, batch_size=16, verbose=0)
+    assert res["acc"] > 0.8
+
+
+def test_early_stopping():
+    from paddle_tpu.hapi import EarlyStopping
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(3 * 16 * 16, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.0,
+                                       parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    data = _SynthImages(n=16)
+    es = EarlyStopping(monitor="acc", patience=0, verbose=0,
+                       save_best_model=False)
+    # lr=0 → no improvement → stops after patience+1 evals
+    model.fit(data, data, batch_size=8, epochs=5, verbose=0, callbacks=[es])
+    assert model.stop_training
+
+
+def test_summary():
+    net = models.LeNet()
+    info = paddle.summary(net)
+    assert info["total_params"] > 0
