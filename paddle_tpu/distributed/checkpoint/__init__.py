@@ -6,6 +6,20 @@ on load across topologies.  TPU-native: orbax/tensorstore (the production
 TPU checkpoint stack) — every array is saved with its global shape +
 sharding metadata and restored under the CURRENT sharding, which IS the
 reference's cross-topology resharding load (SURVEY.md §5 checkpoint).
+
+Kwarg semantics (all honored, none silently ignored):
+- ``async_save``      — orbax AsyncCheckpointer: the save is committed on
+                        a background thread; ``wait_async_save()`` (or the
+                        next save/load touching the same path) joins it.
+- ``unique_id``       — versioned save: writes into ``path/<unique_id>``;
+                        load with unique_id=None picks the newest version
+                        (the reference's dir-versioning contract).
+- ``process_group``   — single-controller SPMD has exactly one (global)
+                        group; passing a non-default group is rejected
+                        rather than ignored.
+- ``coordinator_rank``— metadata writer; under the single-controller
+                        runtime the controller IS rank 0, so only 0 is
+                        accepted.
 """
 from __future__ import annotations
 
@@ -18,7 +32,10 @@ import numpy as np
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save"]
+
+# in-flight async saves: path -> AsyncCheckpointer (joined on demand)
+_ASYNC_SAVES: Dict[str, Any] = {}
 
 
 def _to_arrays(state_dict: Dict[str, Any]):
@@ -35,15 +52,83 @@ def _to_arrays(state_dict: Dict[str, Any]):
     return out
 
 
+def _check_group_rank(process_group, coordinator_rank):
+    if process_group is not None:
+        raise ValueError(
+            "paddle_tpu's single-controller runtime has one global process "
+            "group; per-group checkpointing is expressed by sharding, not "
+            "by passing process_group (got a non-None group)")
+    if coordinator_rank != 0:
+        raise ValueError(
+            "single-controller runtime: the controller is always "
+            f"coordinator rank 0 (got {coordinator_rank})")
+
+
+def _versioned_path(path: str, unique_id) -> str:
+    path = os.path.abspath(path)
+    if unique_id is None:
+        return path
+    return os.path.join(path, str(unique_id))
+
+
+def _latest_version(path: str) -> str:
+    """For load with unique_id=None: if `path` holds only versioned
+    subdirs (no checkpoint metadata at top level), pick the newest."""
+    if os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")) or \
+            os.path.exists(os.path.join(path, "manifest.ocdbt")) or \
+            os.path.exists(os.path.join(path, "_METADATA")):
+        return path
+    subs = [d for d in (os.listdir(path) if os.path.isdir(path) else [])
+            if os.path.isdir(os.path.join(path, d))]
+    if not subs:
+        return path
+    def _key(d):
+        try:
+            return (1, int(d))
+        except ValueError:
+            return (0, os.path.getmtime(os.path.join(path, d)))
+    return os.path.join(path, max(subs, key=_key))
+
+
+def wait_async_save(path: Optional[str] = None):
+    """Join outstanding async saves — all of them, or those under `path`
+    (prefix match, so waiting on the base dir joins versioned saves made
+    with unique_id into ``path/<unique_id>``)."""
+    if path is None:
+        keys = list(_ASYNC_SAVES)
+    else:
+        p = os.path.abspath(path)
+        keys = [k for k in _ASYNC_SAVES
+                if k == p or k.startswith(p + os.sep)]
+    for k in keys:
+        ckptr = _ASYNC_SAVES.pop(k, None)
+        if ckptr is not None:
+            ckptr.wait_until_finished()
+            close = getattr(ckptr, "close", None)
+            if close is not None:
+                close()
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save: bool = False):
     """ref: checkpoint/save_state_dict.py — sharded save."""
     import orbax.checkpoint as ocp
+    _check_group_rank(process_group, coordinator_rank)
     arrays = _to_arrays(state_dict)
-    path = os.path.abspath(path)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, arrays, force=True)
+    dest = _versioned_path(path, unique_id)
+    wait_async_save(dest)  # one in-flight save per path
+    if async_save:
+        # bound in-flight saves: join the oldest beyond a small window so
+        # a save-every-epoch loop can't accumulate checkpointer threads
+        while len(_ASYNC_SAVES) >= 4:
+            wait_async_save(next(iter(_ASYNC_SAVES)))
+        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        ckptr.save(dest, arrays, force=True)
+        _ASYNC_SAVES[dest] = ckptr
+    else:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(dest, arrays, force=True)
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
@@ -54,22 +139,27 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     destination tensor's current sharding."""
     import warnings
     import orbax.checkpoint as ocp
-    path = os.path.abspath(path)
+    _check_group_rank(process_group, coordinator_rank)
+    wait_async_save()  # a pending async save must land before any load
+    src = (_versioned_path(path, unique_id) if unique_id is not None
+           else _latest_version(os.path.abspath(path)))
     ckptr = ocp.PyTreeCheckpointer()
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # sharding-from-file notice
-        restored = ckptr.restore(path)
+        restored = ckptr.restore(src)
 
-    def assign(dst, src):
+    def assign(dst, src_tree):
         for k, v in dst.items():
-            if k not in src:
+            if k not in src_tree:
                 continue
             if isinstance(v, dict):
-                assign(v, src[k])
+                assign(v, src_tree[k])
             elif isinstance(v, Tensor):
-                arr = src[k]
-                arr = jnp.asarray(arr)
-                if hasattr(v._data, "sharding"):
+                arr = jnp.asarray(src_tree[k])
+                if offload:
+                    # ref semantics: keep loaded params in host memory
+                    arr = jax.device_put(arr, jax.devices("cpu")[0])
+                elif hasattr(v._data, "sharding"):
                     try:
                         arr = jax.device_put(arr, v._data.sharding)
                     except Exception:

@@ -7,8 +7,8 @@ Single-controller semantics: ``train_batch`` splits the batch into
 micro-batches and accumulates gradients — with layers' activations placed
 per-stage by GSPMD annotations, XLA pipelines the stage computations and
 inserts the inter-stage transfers the reference does with p2p send/recv.
-The shard_map-explicit 1F1B schedule (per-stage stacked params + ppermute
-ring, see paddle_tpu.distributed.fleet.meta_parallel.pp_1f1b) is the
+The shard_map-explicit schedule (per-stage stacked params + ppermute
+ring, see paddle_tpu.distributed.fleet.meta_parallel.pp_spmd) is the
 compiled fast path used by the jit engine when pp_degree > 1.
 """
 from __future__ import annotations

@@ -49,11 +49,19 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self._optimizer = None
+        self._amp_level = "O0"
+        self._amp_cast_kwargs = {}
+        self._scaler = None
         self.stop_training = False
 
     # -- configuration -----------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
-        """ref: Model.prepare."""
+        """ref: Model.prepare.
+
+        ``amp_configs`` mirrors the reference: either a level string
+        ("O1"/"O2") or a dict with a "level" key plus auto_cast/GradScaler
+        kwargs (custom_white_list, custom_black_list, dtype,
+        init_loss_scaling, use_dynamic_loss_scaling...)."""
         self._optimizer = optimizer
         if loss is not None and not isinstance(loss, nn.Layer) \
                 and not callable(loss):
@@ -65,7 +73,46 @@ class Model:
             if not isinstance(m, Metric):
                 raise TypeError(f"metric {m} is not a paddle.metric.Metric")
         self._metrics = list(metrics)
-        self._amp_configs = amp_configs
+        self._parse_amp_configs(amp_configs)
+
+    def _parse_amp_configs(self, amp_configs):
+        """ref: Model._parse_amp_configs — normalise to level + kwargs and
+        build the GradScaler (dynamic loss scaling for O1/O2 fp16)."""
+        self._amp_level = "O0"
+        self._amp_cast_kwargs = {}
+        self._scaler = None
+        if amp_configs is None:
+            return
+        if isinstance(amp_configs, str):
+            amp_configs = {"level": amp_configs}
+        if not isinstance(amp_configs, dict):
+            raise TypeError("amp_configs must be a level str or a dict")
+        cfg = dict(amp_configs)
+        level = cfg.pop("level", "O1")
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"amp level must be O0/O1/O2, got {level!r}")
+        self._amp_level = level
+        if level == "O0":
+            return
+        scaler_keys = {"init_loss_scaling", "incr_ratio", "decr_ratio",
+                       "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                       "use_dynamic_loss_scaling"}
+        scaler_kwargs = {k: cfg.pop(k) for k in list(cfg)
+                         if k in scaler_keys}
+        cast_keys = {"custom_white_list", "custom_black_list", "dtype",
+                     "use_promote"}
+        unknown = set(cfg) - cast_keys
+        if unknown:
+            raise ValueError(
+                f"unknown amp_configs keys {sorted(unknown)}; supported: "
+                f"level, {sorted(scaler_keys | cast_keys)}")
+        self._amp_cast_kwargs = cfg
+        from .. import amp
+        self._scaler = amp.GradScaler(**scaler_kwargs)
+        if level == "O2" and self._optimizer is not None:
+            self.network, self._optimizer = amp.decorate(
+                models=self.network, optimizers=self._optimizer, level="O2",
+                dtype=self._amp_cast_kwargs.get("dtype", "float16"))
 
     # -- single-batch ops --------------------------------------------------
     def _compute_loss(self, outputs, labels):
@@ -75,24 +122,47 @@ class Model:
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         return self._loss(*(list(outs) + list(labels)))
 
+    def _update_metrics(self, outputs, labels):
+        """Run each metric's compute→update chain; compute may return a
+        single value or a tuple (multi-output metrics get all of them)."""
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        results = []
+        for metric in self._metrics:
+            computed = metric.compute(outs[0], *labels)
+            if not isinstance(computed, (tuple, list)):
+                computed = (computed,)
+            results.append(metric.update(*computed))
+        return results
+
     def train_batch(self, inputs, labels=None, update=True):
-        """ref: Model.train_batch — one optimizer step."""
+        """ref: Model.train_batch — one optimizer step (AMP-aware when
+        prepare() got amp_configs)."""
+        import contextlib
         self.network.train()
         inputs = _to_tensor_batch(inputs)
         labels = _to_tensor_batch(labels) if labels is not None else []
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
-        loss.backward()
-        if update and self._optimizer is not None:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
-        metrics = []
-        for metric in self._metrics:
-            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-            m = metric.update(*[
-                v for v in [metric.compute(outs[0], *labels)]
-                for v in (v if isinstance(v, tuple) else (v,))])
-            metrics.append(m)
+        if self._amp_level != "O0":
+            from .. import amp
+            ctx = amp.auto_cast(level=self._amp_level,
+                                **self._amp_cast_kwargs)
+        else:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        scaler = self._scaler if self._amp_level != "O0" else None
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            if update and self._optimizer is not None:
+                scaler.step(self._optimizer)
+                scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            loss.backward()
+            if update and self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
         vals = [float(loss)]
         return (vals, metrics) if metrics else vals
 
@@ -107,14 +177,7 @@ class Model:
             vals = []
             if self._loss is not None and labels:
                 vals = [float(self._compute_loss(outputs, labels))]
-            metrics = []
-            for metric in self._metrics:
-                outs = (outputs if isinstance(outputs, (list, tuple))
-                        else [outputs])
-                m = metric.update(*[
-                    v for v in [metric.compute(outs[0], *labels)]
-                    for v in (v if isinstance(v, tuple) else (v,))])
-                metrics.append(m)
+            metrics = self._update_metrics(outputs, labels)
         return (vals, metrics) if metrics else vals
 
     def predict_batch(self, inputs):
