@@ -62,6 +62,32 @@ def _param_count(cfg) -> int:
     return V * H + S * H + L * (12 * H * H + 13 * H) + 2 * H
 
 
+def run_probe():
+    """Tiny TPU liveness check: backend init + one 128x128 matmul.
+
+    Separating this from the real bench means a hung compile/execute
+    tunnel costs the parent one small timeout instead of the whole
+    stage budget, and the JSON records WHERE the stack died (init vs
+    compute) rather than just that it died."""
+    import time
+    import jax
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    t_init = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    x = jax.numpy.ones((128, 128))
+    (x @ x).block_until_ready()
+    t_compute = time.perf_counter() - t0
+    print(json.dumps({
+        "probe": "ok",
+        "platform": devices[0].platform,
+        "device_kind": getattr(devices[0], "device_kind", "?"),
+        "n_devices": len(devices),
+        "t_init_s": round(t_init, 1),
+        "t_compute_s": round(t_compute, 1),
+    }))
+
+
 def run_bench():
     import jax
     if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -70,7 +96,10 @@ def run_bench():
         # only reliable override is the config API (see tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
     devices = jax.devices()  # may raise on backend-init failure
-    on_tpu = any(d.platform == "tpu" for d in devices)
+    # the attached chip may surface under platform "tpu" or via a proxy
+    # platform (e.g. "axon" tunnel) whose device_kind still says TPU —
+    # anything that is not the host CPU counts as the accelerator
+    on_tpu = any(d.platform != "cpu" for d in devices)
     platform = devices[0].platform
 
     import paddle_tpu as paddle
@@ -131,7 +160,7 @@ def run_bench():
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt
-    n_chips = sum(1 for d in devices if d.platform == "tpu") or 1
+    n_chips = sum(1 for d in devices if d.platform != "cpu") or 1
     value = tokens_per_sec / (n_chips if on_tpu else 1)
     n_params = _param_count(cfg)
     baseline = _baseline_tokens_per_sec(n_params)
@@ -147,12 +176,34 @@ def run_bench():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(value / baseline, 4),
         "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", "?"),
         "preset": preset,
         "n_params": n_params,
+        "batch": batch, "seq": seq, "steps": steps,
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
     print(json.dumps(out))
+
+
+def _run_child(extra_env, budget, mode=None):
+    """Run one child stage; returns (json_line_or_None, err_string)."""
+    import subprocess
+    env = dict(os.environ, BENCH_CHILD="1", **extra_env)
+    if mode:
+        env["BENCH_MODE"] = mode
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=budget, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout>{budget}s"
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode == 0 and line:
+        return line, ""
+    err = (proc.stderr.strip().splitlines() or ["?"])[-1]
+    return None, f"rc={proc.returncode}: {err}"
 
 
 def main():
@@ -160,44 +211,74 @@ def main():
 
     A hung TPU tunnel blocks inside a C call, so in-process watchdogs
     (SIGALRM) never fire — the only robust guard is a parent that can
-    SIGKILL the child.  Stages: (1) default backend (TPU when attached),
-    (2) one retry for transient tunnel errors, (3) BENCH_FORCE_CPU=1
-    virtual-CPU fallback (config-API platform switch, see run_bench).
-    Whatever happens, exactly one JSON line is printed.
+    SIGKILL the child.  Stage ladder (VERDICT r2 item 2: never let a
+    broken/hung TPU stack zero the round, and record WHY in the JSON):
+      0. probe      — tiny matmul, small budget: is the chip alive, and
+                      does it die at init or at compute?
+      1. tpu        — the real bench (only if the probe passed).
+      2. tpu-retry  — smaller preset, fewer steps, compilation cache
+                      off: survives client/terminal skew & slow tunnels.
+      3. cpu        — BENCH_FORCE_CPU=1 virtual-CPU smoke so the driver
+                      always records a parsable line.
+    Whatever happens, exactly one JSON line is printed, carrying the
+    full error chain of every stage that failed.
     """
-    import subprocess
+    mode = os.environ.get("BENCH_MODE", "")
     if os.environ.get("BENCH_CHILD") == "1":
-        run_bench()
+        run_probe() if mode == "probe" else run_bench()
         return
-    t_tpu = int(os.environ.get("BENCH_STAGE_TIMEOUT", "420"))
-    # retry + CPU stages get tighter budgets: worst case stays ~14 min
-    stages = [({}, t_tpu), ({}, min(t_tpu, 180)),
-              ({"BENCH_FORCE_CPU": "1"}, min(t_tpu, 240))]
-    last_err = "no stage ran"
-    for i, (extra, budget) in enumerate(stages):
-        env = dict(os.environ, BENCH_CHILD="1", **extra)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                timeout=budget, capture_output=True, text=True)
-        except subprocess.TimeoutExpired:
-            last_err = f"stage {i} exceeded {budget}s"
-            sys.stderr.write(last_err + "\n")
-            continue
-        line = next((ln for ln in reversed(proc.stdout.splitlines())
-                     if ln.startswith("{")), None)
-        if proc.returncode == 0 and line:
-            print(line)
+
+    errors = {}
+
+    # budget invariant: worst case (every stage hung) stays <= ~14 min
+    # (120 + 360 + 240 + 120 = 840s), matching the pre-ladder contract —
+    # an outer driver budget must always see the fail-safe JSON line
+    probe_line, err = _run_child({}, int(os.environ.get(
+        "BENCH_PROBE_TIMEOUT", "120")), mode="probe")
+    probe = json.loads(probe_line) if probe_line else None
+    if err:
+        errors["probe"] = err
+
+    if probe and probe.get("platform") != "cpu":
+        t_tpu = int(os.environ.get("BENCH_STAGE_TIMEOUT", "360"))
+        line, err = _run_child({}, t_tpu)
+        if line:
+            out = json.loads(line)
+            out["probe"] = probe
+            print(json.dumps(out))
             return
-        last_err = (proc.stderr.strip().splitlines() or ["?"])[-1]
-        sys.stderr.write(f"stage {i} rc={proc.returncode}: {last_err}\n")
+        errors["tpu"] = err
+        # retry smaller + cache off: a skewed persistent/compile cache or
+        # a slow tunnel must not zero the round
+        retry_env = {"BENCH_PRESET": "gpt3-350M", "BENCH_STEPS": "3",
+                     "BENCH_SEQ": "1024",
+                     "JAX_ENABLE_COMPILATION_CACHE": "false"}
+        line, err = _run_child(retry_env, min(t_tpu, 240))
+        if line:
+            out = json.loads(line)
+            out["probe"] = probe
+            out["errors"] = errors
+            print(json.dumps(out))
+            return
+        errors["tpu-retry"] = err
+
+    line, err = _run_child({"BENCH_FORCE_CPU": "1"}, 120)
+    if line:
+        out = json.loads(line)
+        if probe:
+            out["probe"] = probe
+        if errors:
+            out["errors"] = errors
+        print(json.dumps(out))
+        return
+    errors["cpu"] = err
     print(json.dumps({
         "metric": "bench_failed",
         "value": 0.0,
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "platform": "none",
-        "error": last_err[-300:],
+        "errors": {k: v[-300:] for k, v in errors.items()},
     }))
 
 

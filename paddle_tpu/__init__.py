@@ -15,7 +15,7 @@ import os as _os
 import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # kept in sync with paddle.version.full_version
 
 from . import flags as _flags_mod
 from .flags import set_flags, get_flags

@@ -1,0 +1,16 @@
+"""paddle.audio — audio features + functional DSP (ref:
+python/paddle/audio/: features/layers.py, functional/functional.py,
+backends).
+
+TPU-native: mel/DCT matrices are precomputed host-side (numpy, trace
+constants) and the per-frame pipeline (frame → window → rfft → mel
+matmul → log) is jnp traced through the op layer, so a feature extractor
+jits and batches on device — the reference runs the same pipeline as
+eager CUDA ops.
+"""
+from . import functional
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+from . import backends
+
+__all__ = ["functional", "features", "backends", "Spectrogram",
+           "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

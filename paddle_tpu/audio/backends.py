@@ -1,0 +1,87 @@
+"""paddle.audio.backends — wave IO (ref: python/paddle/audio/backends/
+wave_backend.py, which also uses the stdlib wave module)."""
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"only wave_backend is built in (got {backend_name!r}); "
+            f"the reference's soundfile backend needs the optional "
+            f"paddleaudio package the same way")
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> AudioInfo:
+    """ref: backends info()."""
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """ref: backends load() — (waveform (C, T) float32, sample_rate)."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n_ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, n_ch)
+    if width == 1:
+        data = data.astype(np.float32) - 128.0
+        scale = 128.0
+    else:
+        data = data.astype(np.float32)
+        scale = float(2 ** (8 * width - 1))
+    out = data / scale if normalize else data
+    if channels_first:
+        out = out.T
+    return Tensor(out.copy()), sr
+
+
+def save(filepath: str, src: Tensor, sample_rate: int,
+         channels_first: bool = True, bits_per_sample: int = 16):
+    """ref: backends save() — 16-bit PCM wav."""
+    data = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if channels_first:
+        data = data.T
+    if bits_per_sample != 16:
+        raise NotImplementedError("wave backend writes 16-bit PCM")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(pcm.shape[1] if pcm.ndim == 2 else 1)
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())

@@ -1,0 +1,108 @@
+"""paddle.audio.features (ref: python/paddle/audio/features/layers.py).
+
+Feature extractors are nn.Layers whose mel/DCT bases are precomputed
+trace constants; the per-call pipeline is pure jnp (stft → |.|^power →
+mel matmul → log/DCT) so a whole batch extracts in one fused XLA
+computation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn, signal
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    """ref: features.Spectrogram — |stft|^power."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = signal.stft(x, n_fft=self.n_fft, hop_length=self.hop_length,
+                           win_length=self.win_length, window=self.window,
+                           center=self.center, pad_mode=self.pad_mode)
+        return call_op(
+            lambda s: jnp.abs(s) ** self.power, [spec],
+            op_name="spectrogram")
+
+
+class MelSpectrogram(nn.Layer):
+    """ref: features.MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.fbank = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                            f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return call_op(
+            lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+            [spec, self.fbank], op_name="mel_spectrogram")
+
+
+class LogMelSpectrogram(nn.Layer):
+    """ref: features.LogMelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(nn.Layer):
+    """ref: features.MFCC — DCT-II of the log-mel spectrogram."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return call_op(
+            lambda s, d: jnp.einsum("mk,...mt->...kt", d, s),
+            [lm, self.dct], op_name="mfcc")

@@ -1,0 +1,150 @@
+"""paddle.audio.functional (ref: python/paddle/audio/functional/
+functional.py + window.py)."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """ref: functional.hz_to_mel (slaney default, htk option)."""
+    scalar = not isinstance(freq, (Tensor, np.ndarray, jnp.ndarray))
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   "float32")
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10)
+                                             / min_log_hz) / logstep,
+                        mels)
+        out = mels
+    return float(out) if scalar else Tensor(jnp.asarray(out))
+
+
+def mel_to_hz(mel, htk=False):
+    """ref: functional.mel_to_hz."""
+    scalar = not isinstance(mel, (Tensor, np.ndarray, jnp.ndarray))
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   "float32")
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = np.where(m >= min_log_mel,
+                         min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                         freqs)
+        out = freqs
+    return float(out) if scalar else Tensor(jnp.asarray(out))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """ref: functional.mel_frequencies."""
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray([mel_to_hz(float(m), htk) for m in mels], dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """ref: functional.fft_frequencies."""
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """ref: functional.compute_fbank_matrix — (n_mels, 1+n_fft//2)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy(), "float64")
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(dtype)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """ref: functional.create_dct — (n_mels, n_mfcc) DCT-II basis."""
+    n = np.arange(n_mels, dtype="float64")
+    k = np.arange(n_mfcc, dtype="float64")[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.astype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    """ref: functional.power_to_db."""
+    x = ensure_tensor(spect)
+
+    def impl(s):
+        log_spec = 10.0 * (jnp.log10(jnp.maximum(s, amin))
+                           - jnp.log10(jnp.maximum(jnp.asarray(ref_value),
+                                                   amin)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return call_op(impl, [x], op_name="power_to_db")
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """ref: functional/window.py get_window — hann/hamming/blackman/
+    bartlett/ones + (gaussian, std) tuples."""
+    if isinstance(window, (tuple, list)):
+        name, *params = window
+    else:
+        name, params = window, []
+    n = win_length
+    m = np.arange(n, dtype="float64")
+    denom = n if fftbins else n - 1
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * m / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * m / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * m / denom)
+             + 0.08 * np.cos(4 * math.pi * m / denom))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * m / denom - 1.0)
+    elif name in ("ones", "boxcar", "rectangular"):
+        w = np.ones(n)
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = np.exp(-0.5 * ((m - (n - 1) / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)))

@@ -1,0 +1,1037 @@
+"""paddle.distribution — probability distributions + KL registry (ref:
+python/paddle/distribution/: ~20 distributions, kl.py registry,
+transform.py flows).
+
+TPU-native: densities/entropies are jnp expressions traced through the
+op layer (they jit and differentiate like any op); sampling draws keys
+from the global generator (paddle_tpu.random_state) and uses jax.random
+— reparameterized (rsample) wherever the reference supports it.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import random_state
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Beta",
+    "Bernoulli", "Binomial", "Categorical", "Cauchy", "Chi2",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+    "Laplace", "LogNormal", "Multinomial", "MultivariateNormal",
+    "Poisson", "StudentT", "Independent", "TransformedDistribution",
+    "kl_divergence", "register_kl",
+    "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "AbsTransform", "ChainTransform",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(np.asarray(x), jnp.float32) \
+        if not isinstance(x, jnp.ndarray) else x
+
+
+def _shape(shape) -> Tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """ref: distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        # default: sampling without reparameterization = stop-grad rsample
+        return Tensor(jax.lax.stop_gradient(self.rsample(shape)._data))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterized sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return _shape(shape) + self._batch_shape + self._event_shape
+
+
+class ExponentialFamily(Distribution):
+    """ref: distribution/exponential_family.py — entropy via Bregman
+    identity is subsumed by per-class closed forms here."""
+
+
+class Normal(Distribution):
+    """ref: distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        eps = jax.random.normal(key, self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self._batch_shape))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class LogNormal(Distribution):
+    """ref: distribution/lognormal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        eps = jax.random.normal(key, self._extend(shape))
+        return Tensor(jnp.exp(self.loc + self.scale * eps))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        var = self.scale ** 2
+        return Tensor(-((logv - self.loc) ** 2) / (2 * var) - logv
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+            + jnp.log(self.scale), self._batch_shape))
+
+
+class Uniform(Distribution):
+    """ref: distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        u = jax.random.uniform(key, self._extend(shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self._batch_shape))
+
+
+class Beta(ExponentialFamily):
+    """ref: distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (t * t * (t + 1)))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        k1, k2 = jax.random.split(key)
+        sh = self._extend(shape)
+        ga = jax.random.gamma(k1, jnp.broadcast_to(self.alpha, sh))
+        gb = jax.random.gamma(k2, jnp.broadcast_to(self.beta, sh))
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        from jax.scipy.special import betaln
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Bernoulli(ExponentialFamily):
+    """ref: distribution/bernoulli.py."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        return Tensor(jax.random.bernoulli(
+            key, self.probs, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Binomial(Distribution):
+    """ref: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        n = int(np.max(np.asarray(self.total_count)))
+        u = jax.random.uniform(key, _shape(shape) + (n,)
+                               + self._batch_shape)
+        i = jnp.arange(n).reshape((1,) * len(_shape(shape)) + (n,)
+                                  + (1,) * len(self._batch_shape))
+        draws = (u < self.probs) & (i < self.total_count)
+        return Tensor(draws.sum(axis=len(_shape(shape))).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                      + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class Categorical(Distribution):
+    """ref: distribution/categorical.py (logits parameterization)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        super().__init__(self.logits.shape[:-1])
+        self._n = self.logits.shape[-1]
+
+    @property
+    def _log_pmf(self):
+        return self.logits - jax.scipy.special.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=_shape(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._log_pmf, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value=None):
+        p = jnp.exp(self._log_pmf)
+        if value is None:
+            return Tensor(p)
+        v = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        lp = self._log_pmf
+        return Tensor(-(jnp.exp(lp) * lp).sum(-1))
+
+
+class Cauchy(Distribution):
+    """ref: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        u = jax.random.uniform(key, self._extend(shape), minval=1e-6,
+                               maxval=1 - 1e-6)
+        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return Tensor(jnp.arctan((v - self.loc) / self.scale) / math.pi
+                      + 0.5)
+
+
+class Gamma(ExponentialFamily):
+    """ref: distribution/gamma.py (concentration/rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        sh = self._extend(shape)
+        g = jax.random.gamma(key, jnp.broadcast_to(self.concentration, sh))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a)
+                      + (1 - a) * digamma(a))
+
+
+class Chi2(Gamma):
+    """ref: distribution/chi2.py — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        df = _arr(df)
+        self.df = df
+        super().__init__(df / 2.0, jnp.full_like(df, 0.5))
+
+
+class Dirichlet(ExponentialFamily):
+    """ref: distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = a.sum(-1, keepdims=True)
+        return Tensor(a * (a0 - a) / (a0 * a0 * (a0 + 1)))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        sh = _shape(shape) + self.concentration.shape
+        g = jax.random.gamma(key, jnp.broadcast_to(self.concentration, sh))
+        return Tensor(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a = self.concentration
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1)
+                      + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        return Tensor(gammaln(a).sum(-1) - gammaln(a0)
+                      + (a0 - k) * digamma(a0)
+                      - ((a - 1) * digamma(a)).sum(-1))
+
+
+class Exponential(ExponentialFamily):
+    """ref: distribution/exponential.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        u = jax.random.uniform(key, self._extend(shape), minval=1e-7,
+                               maxval=1.0)
+        return Tensor(-jnp.log(u) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Geometric(Distribution):
+    """ref: distribution/geometric.py — failures before first success."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        u = jax.random.uniform(key, self._extend(shape), minval=1e-7,
+                               maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log1p(-p) + jnp.log(p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Gumbel(Distribution):
+    """ref: distribution/gumbel.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        u = jax.random.uniform(key, self._extend(shape), minval=1e-7,
+                               maxval=1 - 1e-7)
+        return Tensor(self.loc - self.scale * jnp.log(-jnp.log(u)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + np.euler_gamma
+                      + jnp.zeros(self._batch_shape))
+
+
+class Laplace(Distribution):
+    """ref: distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale ** 2)
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        u = jax.random.uniform(key, self._extend(shape), minval=-0.5 + 1e-7,
+                               maxval=0.5 - 1e-7)
+        return Tensor(self.loc
+                      - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+
+class Multinomial(Distribution):
+    """ref: distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        logits = jnp.log(jnp.clip(self.probs, 1e-12, None))
+        draws = jax.random.categorical(
+            key, logits, shape=(self.total_count,) + _shape(shape)
+            + self._batch_shape)
+        k = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(onehot.sum(0))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-12, None)
+        return Tensor(gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+                      + (v * jnp.log(p)).sum(-1))
+
+
+class MultivariateNormal(Distribution):
+    """ref: distribution/multivariate_normal.py (loc + covariance)."""
+
+    def __init__(self, loc, covariance_matrix=None, name=None):
+        self.loc = _arr(loc)
+        if covariance_matrix is None:
+            covariance_matrix = jnp.eye(self.loc.shape[-1])
+        self.covariance_matrix = _arr(covariance_matrix)
+        self._chol = jnp.linalg.cholesky(self.covariance_matrix)
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.diagonal(self.covariance_matrix, axis1=-2,
+                                   axis2=-1) + jnp.zeros_like(self.loc))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        eps = jax.random.normal(key, _shape(shape) + self.loc.shape)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._chol, eps))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        d = v - self.loc
+        L = jnp.broadcast_to(self._chol,
+                             d.shape[:-1] + self._chol.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(L, d[..., None],
+                                                lower=True)[..., 0]
+        k = self.loc.shape[-1]
+        logdet = jnp.log(jnp.diagonal(self._chol, axis1=-2,
+                                      axis2=-1)).sum(-1)
+        return Tensor(-0.5 * (sol ** 2).sum(-1) - logdet
+                      - 0.5 * k * math.log(2 * math.pi))
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        logdet = jnp.log(jnp.diagonal(self._chol, axis1=-2,
+                                      axis2=-1)).sum(-1)
+        return Tensor(0.5 * k * (1 + math.log(2 * math.pi)) + logdet)
+
+
+class Poisson(ExponentialFamily):
+    """ref: distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        key = random_state.next_key()
+        return Tensor(jax.random.poisson(
+            key, self.rate, self._extend(shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
+
+
+class StudentT(Distribution):
+    """ref: distribution/student_t.py."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        var = self.scale ** 2 * self.df / (self.df - 2)
+        return Tensor(jnp.where(self.df > 2, var, jnp.nan))
+
+    def rsample(self, shape=()):
+        key = random_state.next_key()
+        k1, k2 = jax.random.split(key)
+        sh = self._extend(shape)
+        z = jax.random.normal(k1, sh)
+        g = jax.random.gamma(k2, jnp.broadcast_to(self.df / 2, sh))
+        chi2 = 2 * g
+        return Tensor(self.loc
+                      + self.scale * z * jnp.sqrt(self.df / chi2))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        d, s = self.df, self.scale
+        z = (v - self.loc) / s
+        return Tensor(gammaln((d + 1) / 2) - gammaln(d / 2)
+                      - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                      - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+
+# ---------------------------------------------------------------------------
+# transforms + TransformedDistribution (ref: distribution/transform.py)
+# ---------------------------------------------------------------------------
+
+class Transform:
+    """ref: transform.py Transform base (forward/inverse/log_det)."""
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_arr(y))))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _arr(loc), _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2 * (math.log(2) - x - jax.nn.softplus(-2 * x))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """ref: distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transform = (transforms if isinstance(transforms, Transform)
+                          else ChainTransform(transforms))
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transform.forward(x)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transform._inverse(y)
+        base_lp = self.base.log_prob(Tensor(x))._data
+        return Tensor(base_lp - self.transform._fldj(x))
+
+
+class Independent(Distribution):
+    """ref: distribution/independent.py — reinterpret batch dims as event
+    dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        b = base._batch_shape
+        super().__init__(b[:len(b) - self.rank],
+                         b[len(b) - self.rank:] + base._event_shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        return Tensor(lp.sum(axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = self.base.entropy()._data
+        return Tensor(e.sum(axis=tuple(range(-self.rank, 0))))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (ref: distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    """ref: kl.register_kl — decorator registering a pairwise KL rule."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    """ref: kl.kl_divergence — registry dispatch with MRO fallback."""
+    matches = [(cp, cq) for (cp, cq) in _KL_REGISTRY
+               if isinstance(p, cp) and isinstance(q, cq)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL rule registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    # most-derived match wins
+    def _key(pair):
+        return (type(p).__mro__.index(pair[0])
+                + type(q).__mro__.index(pair[1]))
+    cp, cq = min(matches, key=_key)
+    return _KL_REGISTRY[(cp, cq)](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    vr = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    out = jnp.log((q.high - q.low) / (p.high - p.low))
+    oob = (p.low < q.low) | (p.high > q.high)
+    return Tensor(jnp.where(oob, jnp.inf, out))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    lp, lq = p._log_pmf, q._log_pmf
+    return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a1, b1, a2, b2 = (p.concentration, p.rate, q.concentration, q.rate)
+    return Tensor((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+                  + a2 * (jnp.log(b1) - jnp.log(b2))
+                  + a1 * (b2 - b1) / b1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t1 = betaln(a2, b2) - betaln(a1, b1)
+    return Tensor(t1 + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                  + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    return Tensor(gammaln(a0) - gammaln(a).sum(-1)
+                  - gammaln(b.sum(-1)) + gammaln(b).sum(-1)
+                  + ((a - b) * (digamma(a)
+                                - digamma(a0[..., None]))).sum(-1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    r = p.scale / q.scale
+    return Tensor(jnp.log(q.scale / p.scale) + r * jnp.exp(-d / p.scale)
+                  + d / q.scale - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return Tensor(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                  - p.rate + q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geo_geo(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor((jnp.log(pp) - jnp.log(qq)
+                   + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq))))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    k = p.loc.shape[-1]
+    ql, pl = q._chol, p._chol
+    m = jax.scipy.linalg.solve_triangular(ql, pl, lower=True)
+    tr = (m ** 2).sum((-2, -1))
+    d = q.loc - p.loc
+    Lq = jnp.broadcast_to(ql, d.shape[:-1] + ql.shape[-2:])
+    sol = jax.scipy.linalg.solve_triangular(Lq, d[..., None],
+                                            lower=True)[..., 0]
+    logdet = (jnp.log(jnp.diagonal(ql, axis1=-2, axis2=-1)).sum(-1)
+              - jnp.log(jnp.diagonal(pl, axis1=-2, axis2=-1)).sum(-1))
+    return Tensor(0.5 * (tr + (sol ** 2).sum(-1) - k) + logdet)

@@ -1,0 +1,117 @@
+"""paddle.fft — discrete Fourier transforms (ref: python/paddle/fft.py).
+
+TPU-native: every transform lowers to jnp.fft (XLA FFT HLO), traced
+through ``call_op`` so autograd/AMP/profiler hooks apply like any other
+op.  The reference's pocketfft third-party dependency is subsumed by the
+XLA FFT implementation.  API/kwarg names match the reference
+(``n``/``s``, ``axis``/``axes``, ``norm`` in backward|ortho|forward).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = (None, "backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"norm must be one of backward/ortho/forward, got {norm!r}")
+    return norm or "backward"
+
+
+def _make_1d(name, jfn):
+    def fn(x, n=None, axis=-1, norm="backward", name_=None):
+        nrm = _check_norm(norm)
+        x = ensure_tensor(x)
+        return call_op(lambda a: jfn(a, n=n, axis=axis, norm=nrm), [x],
+                       op_name=name)
+    fn.__name__ = name
+    fn.__doc__ = f"ref: paddle.fft.{name} — lowers to jnp.fft.{name}."
+    return fn
+
+
+def _make_2d(name, jfn):
+    def fn(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        nrm = _check_norm(norm)
+        x = ensure_tensor(x)
+        return call_op(lambda a: jfn(a, s=s, axes=axes, norm=nrm), [x],
+                       op_name=name)
+    fn.__name__ = name
+    fn.__doc__ = f"ref: paddle.fft.{name} — lowers to jnp.fft.{name}."
+    return fn
+
+
+def _make_nd(name, jfn):
+    def fn(x, s=None, axes=None, norm="backward", name_=None):
+        nrm = _check_norm(norm)
+        x = ensure_tensor(x)
+        return call_op(lambda a: jfn(a, s=s, axes=axes, norm=nrm), [x],
+                       op_name=name)
+    fn.__name__ = name
+    fn.__doc__ = f"ref: paddle.fft.{name} — lowers to jnp.fft.{name}."
+    return fn
+
+
+fft = _make_1d("fft", jnp.fft.fft)
+ifft = _make_1d("ifft", jnp.fft.ifft)
+rfft = _make_1d("rfft", jnp.fft.rfft)
+irfft = _make_1d("irfft", jnp.fft.irfft)
+hfft = _make_1d("hfft", jnp.fft.hfft)
+ihfft = _make_1d("ihfft", jnp.fft.ihfft)
+
+fft2 = _make_2d("fft2", jnp.fft.fft2)
+ifft2 = _make_2d("ifft2", jnp.fft.ifft2)
+rfft2 = _make_2d("rfft2", jnp.fft.rfft2)
+irfft2 = _make_2d("irfft2", jnp.fft.irfft2)
+
+fftn = _make_nd("fftn", jnp.fft.fftn)
+ifftn = _make_nd("ifftn", jnp.fft.ifftn)
+rfftn = _make_nd("rfftn", jnp.fft.rfftn)
+irfftn = _make_nd("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """ref: paddle.fft.fftfreq."""
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .. import dtype as dtypes
+        out = out.astype(dtypes.to_jax(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    """ref: paddle.fft.rfftfreq."""
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .. import dtype as dtypes
+        out = out.astype(dtypes.to_jax(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    """ref: paddle.fft.fftshift."""
+    x = ensure_tensor(x)
+    return call_op(lambda a: jnp.fft.fftshift(a, axes=axes), [x],
+                   op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    """ref: paddle.fft.ifftshift."""
+    x = ensure_tensor(x)
+    return call_op(lambda a: jnp.fft.ifftshift(a, axes=axes), [x],
+                   op_name="ifftshift")

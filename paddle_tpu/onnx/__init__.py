@@ -1,0 +1,26 @@
+"""paddle.onnx (ref: python/paddle/onnx/export.py).
+
+The reference's ``paddle.onnx.export`` delegates to the optional
+``paddle2onnx`` package and raises if it is missing; this build has the
+same contract against the ``onnx`` package.  The native serialized
+artifact of this framework is StableHLO via ``paddle.jit.save``
+(jit/save_load.py), which is the XLA-world interchange format.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref: paddle.onnx.export — requires the optional onnx package."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "paddle.onnx.export requires the optional 'onnx' package "
+            "(the reference requires 'paddle2onnx' the same way). For a "
+            "portable serialized artifact use paddle.jit.save(layer, "
+            "path, input_spec=...) which exports StableHLO.")
+    raise NotImplementedError(
+        "onnx emission is not implemented; use paddle.jit.save "
+        "(StableHLO) for deployment artifacts")

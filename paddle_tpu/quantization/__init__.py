@@ -1,0 +1,256 @@
+"""paddle.quantization — QAT/PTQ over fake-quant ops (ref:
+python/paddle/quantization/: config.py, qat.py, ptq.py, quanters/,
+observers/).
+
+TPU-native: fake-quantization is a pure jnp round-trip
+(scale → round → clip → dequant) with a straight-through estimator, so
+QAT graphs jit and differentiate like any other op; the reference's
+dedicated fake_quantize CUDA kernels are one fused XLA expression here.
+PTQ wraps layers with observers that track absmax on the host between
+calls (calibration is eager by definition).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
+           "BaseQuanter", "BaseObserver", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserver", "QuantedLinear", "QuantedConv2D"]
+
+
+def _fake_quant(x, scale, bits=8):
+    """Symmetric fake-quant with straight-through estimator."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9) / qmax
+    q = jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+    # STE: identity gradient through the rounding
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class BaseObserver:
+    """ref: observers/abs_max.py base — tracks calibration statistics."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scale(self):
+        if self._scale is None:
+            raise RuntimeError("observer has seen no data")
+        return self._scale
+
+
+class AbsmaxObserver(BaseObserver):
+    """ref: observers/abs_max.py AbsmaxObserver."""
+
+    def observe(self, x: Tensor):
+        m = float(jnp.abs(ensure_tensor(x)._data).max())
+        self._scale = m if self._scale is None else max(self._scale, m)
+        return x
+
+
+class BaseQuanter(nn.Layer):
+    """ref: quanter base: a layer that fake-quantizes its input."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """ref: quanters/abs_max.py — moving-absmax fake quant for QAT."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, name=None):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._state = 1.0
+        self._accum = 1.0
+        self._scale = 1.0
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+        if self.training:
+            absmax = float(jnp.abs(x._data).max())
+            r = self.moving_rate
+            self._state = r * self._state + 1.0
+            self._accum = r * self._accum + absmax
+            self._scale = self._accum / self._state
+        scale = self._scale
+        return call_op(lambda a: _fake_quant(a, scale, self.quant_bits),
+                       [x], op_name="fake_quantize_dequantize")
+
+
+class QuantConfig:
+    """ref: config.py QuantConfig — maps layers/types to quanters."""
+
+    def __init__(self, activation: Optional[BaseQuanter] = None,
+                 weight: Optional[BaseQuanter] = None):
+        self._global_activation = activation
+        self._global_weight = weight
+        self._type_configs: Dict[Type, dict] = {}
+        self._layer_configs: Dict[int, dict] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs[t] = {"activation": activation,
+                                     "weight": weight}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = {"activation": activation,
+                                          "weight": weight}
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global_activation or self._global_weight:
+            return {"activation": self._global_activation,
+                    "weight": self._global_weight}
+        return None
+
+
+def _make_quanter(proto):
+    if proto is None:
+        return None
+    if isinstance(proto, type):
+        return proto()
+    return copy.deepcopy(proto)
+
+
+class QuantedLinear(nn.Layer):
+    """ref: nn/quant_layers QuantizedLinear — fake-quant w + activation."""
+
+    def __init__(self, inner: "nn.Linear", act_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = w_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    """ref: nn/quant_layers QuantizedConv2D."""
+
+    def __init__(self, inner: "nn.Conv2D", act_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = w_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.conv2d(x, w, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+_QUANT_WRAPPERS = {}
+
+
+def _wrap_layer(layer, cfg):
+    act = _make_quanter(cfg["activation"])
+    wq = _make_quanter(cfg["weight"])
+    if isinstance(layer, nn.Linear):
+        return QuantedLinear(layer, act, wq)
+    if isinstance(layer, nn.Conv2D):
+        return QuantedConv2D(layer, act, wq)
+    return None
+
+
+def _apply(model: nn.Layer, config: QuantConfig):
+    # walk the sublayer tree, replacing supported leaves in place
+    for name, child in list(model._sub_layers.items()):
+        if child is None:
+            continue
+        cfg = config._config_for(child)
+        wrapped = _wrap_layer(child, cfg) if cfg else None
+        if wrapped is not None:
+            model._sub_layers[name] = wrapped
+        else:
+            _apply(child, config)
+    return model
+
+
+class QAT:
+    """ref: qat.py QAT — quantize() inserts fake-quant, convert() strips
+    observers leaving quantized weights."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace=False):
+        m = model if inplace else copy.deepcopy(model)
+        return _apply(m, self.config)
+
+    def convert(self, model: nn.Layer, inplace=False):
+        m = model if inplace else copy.deepcopy(model)
+        self._bake(m)
+        return m
+
+    def _bake(self, model):
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                inner = child.inner
+                if child.weight_quanter is not None:
+                    child.weight_quanter.eval()
+                    q = child.weight_quanter(inner.weight)
+                    inner.weight.set_value(q)
+                model._sub_layers[name] = inner
+            elif isinstance(child, nn.Layer):
+                self._bake(child)
+
+
+class PTQ:
+    """ref: ptq.py PTQ — observer pass then convert."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace=False):
+        m = model if inplace else copy.deepcopy(model)
+        return _apply(m, self.config)
+
+    convert = QAT.convert
+    _bake = QAT._bake
+
+
+class quanters:
+    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+
+
+class observers:
+    AbsmaxObserver = AbsmaxObserver

@@ -1,0 +1,144 @@
+"""paddle.signal — STFT/ISTFT (ref: python/paddle/signal.py).
+
+TPU-native: framing is a gather/reshape and the transform is jnp.fft —
+all traced through ``call_op`` so the ops jit/grad like everything else
+(the reference backs these with frame/overlap_add CUDA kernels).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """ref: paddle.signal.frame — sliding windows along the time axis.
+
+    axis=-1: (..., seq_len) -> (..., frame_length, num_frames);
+    axis=0:  (seq_len, ...) -> (num_frames, frame_length, ...).
+    (The reference accepts exactly these two axis values.)"""
+    x = ensure_tensor(x)
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError(f"frame: axis must be 0 or -1, got {axis}")
+
+    def impl(a):
+        am = a if axis == -1 else jnp.moveaxis(a, 0, -1)
+        n = am.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        framed = am[..., idx]                 # (..., n_frames, frame_length)
+        if axis == -1:
+            return jnp.swapaxes(framed, -2, -1)   # (..., fl, nf)
+        return jnp.moveaxis(framed, (-2, -1), (0, 1))  # (nf, fl, ...)
+
+    return call_op(impl, [x], op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """ref: paddle.signal.overlap_add — inverse of frame.
+
+    axis=-1: (..., frame_length, num_frames) -> (..., seq_len);
+    axis=0:  (num_frames, frame_length, ...) -> (seq_len, ...)."""
+    x = ensure_tensor(x)
+    if axis not in (0, -1):
+        raise ValueError(f"overlap_add: axis must be 0 or -1, got {axis}")
+
+    def impl(a):
+        # normalize to (..., frame_length, n_frames)
+        am = a if axis == -1 else jnp.moveaxis(a, (0, 1), (-1, -2))
+        fl, nf = am.shape[-2], am.shape[-1]
+        out_len = (nf - 1) * hop_length + fl
+        out = jnp.zeros(am.shape[:-2] + (out_len,), am.dtype)
+        for i in range(nf):   # static python loop: nf is a trace constant
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                am[..., :, i])
+        return out if axis == -1 else jnp.moveaxis(out, -1, 0)
+
+    return call_op(impl, [x], op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """ref: paddle.signal.stft."""
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = ensure_tensor(window)
+
+    def impl(a, *rest):
+        w = rest[0] if rest else jnp.ones((win_length,), a.dtype)
+        # pad the window to n_fft, centered
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[..., idx] * w              # (..., n_frames, n_fft)
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        # paddle returns (..., n_fft//2+1, n_frames)
+        return jnp.swapaxes(spec, -2, -1)
+
+    args = [x] + ([window] if window is not None else [])
+    return call_op(impl, args, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """ref: paddle.signal.istft — least-squares inverse with window
+    envelope normalization."""
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = ensure_tensor(window)
+
+    def impl(s, *rest):
+        w = rest[0] if rest else jnp.ones((win_length,), jnp.float32)
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(s, -2, -1)        # (..., n_frames, n_freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * w                   # synthesis windowing
+        nf = frames.shape[-2]
+        out_len = (nf - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        env = jnp.zeros((out_len,), frames.dtype)
+        wsq = w * w
+        for i in range(nf):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            env = env.at[sl].add(wsq)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out_len - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [x] + ([window] if window is not None else [])
+    return call_op(impl, args, op_name="istft")

@@ -1,0 +1,263 @@
+"""paddle.sparse — COO/CSR sparse tensors (ref: python/paddle/sparse/ +
+paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native: backed by jax.experimental.sparse BCOO/BCSR, whose matmuls
+lower to XLA gather/scatter-dot kernels.  The reference's dedicated CUDA
+sparse kernels (paddle/phi/kernels/sparse/) are subsumed by that
+lowering; this module supplies the paddle API shape: constructors,
+``is_sparse_coo/csr``, conversions, and the elementwise/matmul entry
+points used by the sparse nn layers.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "is_same_shape",
+    "SparseCooTensor", "SparseCsrTensor",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "relu", "transpose", "coalesce",
+]
+
+
+class SparseCooTensor:
+    """ref: phi SparseCooTensor — COO (indices [sparse_dim, nnz])."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle Tensor-protocol surface --
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.asarray(self._bcoo.indices).T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def to_sparse_csr(self):
+        d = self._bcoo.todense()
+        return _dense_to_csr(d)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """ref: phi SparseCsrTensor — CSR (crows/cols/values)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_dense(self):
+        n_rows = self._shape[0]
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz)
+        d = jnp.zeros(self._shape, self._values.dtype)
+        return Tensor(d.at[rows, self._cols].add(self._values))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        d = self.to_dense()._data
+        return _dense_to_coo(d, sparse_dim)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _dense_to_coo(dense, sparse_dim=None):
+    bcoo = jsparse.BCOO.fromdense(dense)
+    return SparseCooTensor(bcoo)
+
+
+def _dense_to_csr(dense):
+    dn = np.asarray(dense)
+    if dn.ndim != 2:
+        raise ValueError("CSR requires a 2-D tensor")
+    rows, cols = np.nonzero(dn)
+    values = dn[rows, cols]
+    crows = np.zeros(dn.shape[0] + 1, np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows).astype(np.int32)
+    return SparseCsrTensor(crows, cols.astype(np.int32), values, dn.shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """ref: paddle.sparse.sparse_coo_tensor."""
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy(), np.int32)
+    vals = np.asarray(values if not isinstance(values, Tensor)
+                      else values.numpy())
+    if dtype is not None:
+        from .. import dtype as dtypes
+        vals = vals.astype(np.dtype(str(dtypes.to_jax(dtype))))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx.T)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """ref: paddle.sparse.sparse_csr_tensor."""
+    unwrap = lambda v: v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+    vals = unwrap(values)
+    if dtype is not None:
+        from .. import dtype as dtypes
+        vals = vals.astype(np.dtype(str(dtypes.to_jax(dtype))))
+    return SparseCsrTensor(unwrap(crows), unwrap(cols), vals, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return jsparse.BCOO.fromdense(x.to_dense()._data)
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _rewrap(bcoo, like):
+    out = SparseCooTensor(bcoo)
+    if isinstance(like, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def add(x, y, name=None):
+    """ref: paddle.sparse.add."""
+    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
+                                          + _coo(y).todense()), x)
+
+
+def subtract(x, y, name=None):
+    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
+                                          - _coo(y).todense()), x)
+
+
+def multiply(x, y, name=None):
+    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
+                                          * _coo(y).todense()), x)
+
+
+def divide(x, y, name=None):
+    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
+                                          / _coo(y).todense()), x)
+
+
+def matmul(x, y, name=None):
+    """ref: paddle.sparse.matmul — sparse @ dense via BCOO dot_general
+    (stays sparse on the lhs; XLA lowers to a gather-dot)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        lhs = _coo(x)
+        rhs = ensure_tensor(y)._data
+        return Tensor(lhs @ rhs)
+    lhs = ensure_tensor(x)._data
+    rhs = _coo(y)
+    return Tensor(lhs @ rhs.todense())
+
+
+def masked_matmul(x, y, mask, name=None):
+    """ref: paddle.sparse.masked_matmul — dense@dense sampled at mask."""
+    xa, ya = ensure_tensor(x)._data, ensure_tensor(y)._data
+    m = _coo(mask)
+    full = xa @ ya
+    idx = m.indices
+    vals = full[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=full.shape))
+
+
+def relu(x, name=None):
+    """ref: paddle.sparse.nn.functional.relu — elementwise on values."""
+    c = _coo(x)
+    return _rewrap(jsparse.BCOO((jnp.maximum(c.data, 0), c.indices),
+                                shape=c.shape), x)
+
+
+def transpose(x, perm, name=None):
+    c = _coo(x)
+    return _rewrap(c.transpose(tuple(perm)), x)
+
+
+def coalesce(x, name=None):
+    return SparseCooTensor(_coo(x).sum_duplicates())
+
+
+# paddle.sparse.nn namespace (layers operating on sparse tensors)
+class _SparseNNFunctional:
+    relu = staticmethod(relu)
+
+
+class nn:
+    functional = _SparseNNFunctional
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

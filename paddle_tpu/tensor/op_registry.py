@@ -14,6 +14,14 @@ uniformly.  Ops whose semantics need bespoke python (optional tensor
 args, list inputs) are defined as plain functions below the table and
 registered with ``_register_manual`` so they still appear in REGISTRY for
 test generation.
+
+``build_full_registry()`` (bottom of this file) then absorbs the whole
+public op surface — tensor/*, nn.functional, linalg, fft, signal,
+sparse, vision/audio/text/distribution functionals — into REGISTRY and
+overlays the ``_PARITY`` spec table (numpy reference + cases + grad
+flag), making this registry the single queryable index of 600+ ops with
+generated forward-parity and numeric-gradient coverage
+(tests/test_op_registry.py).
 """
 from __future__ import annotations
 
@@ -42,6 +50,14 @@ class OpDef:
     gen_cases: Optional[Callable] = None  # () -> list of numpy arg tuples
     multi_out: bool = False
     defaults: Dict[str, Any] = field(default_factory=dict)  # extra kwargs
+    # -- full-surface index fields (see build_full_registry) --
+    paddle_fn: Optional[Callable] = None  # resolved public fn (Tensor level)
+    kwargs: Dict[str, Any] = field(default_factory=dict)   # call kwargs
+    np_kwargs: Optional[Dict[str, Any]] = None  # np_ref kwargs (default: same)
+    grad: bool = False                  # numeric-vs-analytic grad check
+    list_input: bool = False            # fn takes [tensors] as first arg
+    tol: float = 1e-5
+    source: str = "table"               # table | manual | absorbed
 
 
 REGISTRY: Dict[str, OpDef] = {}
@@ -273,7 +289,8 @@ def _stack_like(name, jfn, npfn):
         np_ref=lambda *arrs: npfn(list(arrs)),
         gen_cases=lambda: [tuple(np.random.RandomState(0)
                                  .randn(2, 3).astype("float32")
-                                 for _ in range(3))])
+                                 for _ in range(3))],
+        list_input=True)
     return fn
 
 
@@ -417,6 +434,79 @@ def combinations(x, r=2, with_replacement=False, name=None):
     return call_op(lambda a: a[idx], [x], op_name="combinations")
 
 
+def dist(x, y, p=2.0, name=None):
+    """ref: paddle.dist — p-norm of (x - y)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def impl(a, b):
+        d = a - b
+        if p == 0:
+            return (d != 0).sum().astype(a.dtype)
+        if p == float("inf"):
+            return jnp.abs(d).max()
+        if p == float("-inf"):
+            return jnp.abs(d).min()
+        return (jnp.abs(d) ** p).sum() ** (1.0 / p)
+
+    return call_op(impl, [x, y], op_name="dist")
+
+
+def pdist(x, p=2.0, name=None):
+    """ref: paddle.pdist — condensed pairwise distances of an (N, D) set."""
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def impl(a):
+        diff = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0.0))
+        elif p == float("inf"):
+            m = jnp.abs(diff).max(-1)
+        else:
+            m = (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+        return m[iu]
+
+    return call_op(impl, [x], op_name="pdist")
+
+
+def rank(x, name=None):
+    """ref: paddle.rank — ndim as a 0-d int tensor."""
+    return Tensor(jnp.asarray(ensure_tensor(x)._data.ndim, jnp.int32))
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """ref: paddle.shard_index — recompute label ids for a sharded range."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} not in [0, {nshards})")
+    x = ensure_tensor(x)
+    size = (index_num + nshards - 1) // nshards
+
+    def impl(a):
+        in_shard = (a // size) == shard_id
+        return jnp.where(in_shard, a % size, ignore_value)
+
+    return call_op(impl, [x], op_name="shard_index")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """ref: paddle.nn.clip_by_norm — rescale if l2 norm exceeds max_norm."""
+    x = ensure_tensor(x)
+
+    def impl(a):
+        nrm = jnp.sqrt((a * a).sum())
+        return jnp.where(nrm > max_norm, a * (max_norm / nrm), a)
+
+    return call_op(impl, [x], op_name="clip_by_norm")
+
+
+def tolist(x):
+    """ref: paddle.tolist — nested python list of the tensor's values."""
+    return ensure_tensor(x).tolist()
+
+
 def is_complex(x):
     """ref: paddle.is_complex (host predicate)."""
     return bool(jnp.issubdtype(ensure_tensor(x)._data.dtype,
@@ -474,3 +564,365 @@ _register_manual("is_complex")
 _register_manual("is_floating_point")
 _register_manual("is_integer")
 _register_manual("standard_gamma")
+_register_manual("dist",
+                 np_ref=lambda a, b: np.linalg.norm((a - b).ravel()),
+                 gen_cases=lambda: _float_cases(2)[:1])
+_register_manual("pdist",
+                 np_ref=lambda a: np.sqrt(
+                     ((a[:, None, :] - a[None, :, :]) ** 2).sum(-1))[
+                         np.triu_indices(a.shape[0], k=1)],
+                 gen_cases=lambda: [(np.random.RandomState(0)
+                                     .randn(5, 3).astype("float32"),)])
+_register_manual("rank")
+_register_manual("shard_index")
+_register_manual("clip_by_norm")
+_register_manual("tolist")
+
+
+# ---------------------------------------------------------------------------
+# Full-surface registry: absorb every public op + overlay parity specs
+# ---------------------------------------------------------------------------
+#
+# The reference's ops.yaml drives ~2000 symbols from one table.  Here the
+# table is built in two passes: (1) absorb every public callable of the
+# tensor/nn.functional/linalg/fft/signal surface into REGISTRY as an
+# indexed row; (2) overlay _PARITY specs (numpy reference + case
+# generator + grad flag) on the mechanical subset.  tests/
+# test_op_registry.py iterates the result — adding a spec row here
+# automatically adds its forward-parity (and, with grad=True, its
+# numeric-vs-analytic gradient) test.
+
+def _f(*shapes, seed=0, scale=1.0, shift=0.0):
+    """Case generator helper: float32 arrays of the given shapes."""
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [tuple(np.asarray(rs.randn(*s) * scale + shift, "float32")
+                      for s in shapes)]
+    return gen
+
+
+def _fpos(*shapes, seed=0, lo=0.1, hi=2.0):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [tuple(np.asarray(rs.uniform(lo, hi, s), "float32")
+                      for s in shapes)]
+    return gen
+
+
+def _funit(*shapes, seed=0):  # open interval (0.05, 0.95)
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [tuple(np.asarray(rs.uniform(0.05, 0.95, s), "float32")
+                      for s in shapes)]
+    return gen
+
+
+def _fsym(*shapes, seed=0):  # (-0.9, 0.9), for atanh/erfinv domains
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [tuple(np.asarray(rs.uniform(-0.9, 0.9, s), "float32")
+                      for s in shapes)]
+    return gen
+
+
+def _i(*shapes, seed=0, lo=0, hi=8):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [tuple(rs.randint(lo, hi, s).astype("int64")
+                      for s in shapes)]
+    return gen
+
+
+def _b(*shapes, seed=0):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [tuple(rs.rand(*s) > 0.5 for s in shapes)]
+    return gen
+
+
+def _special():  # nan/inf mix for is* predicates
+    def gen():
+        return [(np.array([[0.0, np.nan, np.inf, -np.inf, 1.5, -2.0]],
+                           "float32"),)]
+    return gen
+
+
+def _np_std(x, axis=None, keepdims=False):
+    return np.std(x, axis=axis, keepdims=keepdims, ddof=1)
+
+
+def _np_var(x, axis=None, keepdims=False):
+    return np.var(x, axis=axis, keepdims=keepdims, ddof=1)
+
+
+def _np_logsumexp(x, axis=None, keepdims=False):
+    m = np.max(x, axis=axis, keepdims=True)
+    s = np.log(np.sum(np.exp(x - m), axis=axis, keepdims=True)) + m
+    return s if keepdims else np.squeeze(s, axis=axis)
+
+
+def _vec(f):
+    return lambda x: np.vectorize(f)(x).astype(np.asarray(x).dtype)
+
+
+class P:
+    """Parity spec row: overlay for an absorbed/registered op."""
+
+    def __init__(self, name, gen, np_ref=None, kwargs=None, np_kwargs=None,
+                 grad=False, list_input=False, tol=1e-5):
+        self.name = name
+        self.gen = gen
+        self.np_ref = np_ref
+        self.kwargs = kwargs or {}
+        self.np_kwargs = np_kwargs
+        self.grad = grad
+        self.list_input = list_input
+        self.tol = tol
+
+
+import math as _math
+
+_PARITY: List[P] = [
+    # ---- unary float (elementwise) ----
+    P("sin", _f((3, 4)), np.sin, grad=True),
+    P("cos", _f((3, 4)), np.cos, grad=True),
+    P("tan", _fsym((3, 4)), np.tan, grad=True),
+    P("asin", _fsym((3, 4)), np.arcsin, grad=True),
+    P("acos", _fsym((3, 4)), np.arccos, grad=True),
+    P("atan", _f((3, 4)), np.arctan, grad=True),
+    P("sinh", _f((3, 4)), np.sinh, grad=True),
+    P("cosh", _f((3, 4)), np.cosh, grad=True),
+    P("tanh", _f((3, 4)), np.tanh, grad=True),
+    P("asinh", _f((3, 4)), np.arcsinh, grad=True),
+    P("acosh", _fpos((3, 4), lo=1.1, hi=3.0), np.arccosh, grad=True),
+    P("atanh", _fsym((3, 4)), np.arctanh, grad=True),
+    P("exp", _f((3, 4)), np.exp, grad=True),
+    P("expm1", _f((3, 4)), np.expm1, grad=True),
+    P("log", _fpos((3, 4)), np.log, grad=True),
+    P("log1p", _fpos((3, 4)), np.log1p, grad=True),
+    P("log2", _fpos((3, 4)), np.log2, grad=True),
+    P("log10", _fpos((3, 4)), np.log10, grad=True),
+    P("sqrt", _fpos((3, 4)), np.sqrt, grad=True),
+    P("rsqrt", _fpos((3, 4)), lambda x: 1.0 / np.sqrt(x), grad=True),
+    P("square", _f((3, 4)), np.square, grad=True),
+    P("abs", _f((3, 4)), np.abs),
+    P("sign", _f((3, 4)), np.sign),
+    P("floor", _f((3, 4)), np.floor),
+    P("ceil", _f((3, 4)), np.ceil),
+    P("trunc", _f((3, 4)), np.trunc),
+    P("round", _f((3, 4)), np.round),
+    P("frac", _f((3, 4)), lambda x: x - np.trunc(x)),
+    P("reciprocal", _fpos((3, 4)), np.reciprocal, grad=True),
+    P("neg", _f((3, 4)), np.negative),
+    P("deg2rad", _f((3, 4)), np.deg2rad),
+    P("rad2deg", _f((3, 4)), np.rad2deg),
+    P("logit", _funit((3, 4)), lambda x: np.log(x / (1 - x)), grad=True,
+      tol=1e-4),
+    P("erf", _f((3, 4)), _vec(_math.erf), grad=True),
+    P("erfinv", _fsym((3, 4)), None, grad=True),  # checked via smoke+grad
+    P("lgamma", _fpos((3, 4)), _vec(_math.lgamma), grad=True, tol=1e-4),
+    P("stanh", _f((3, 4)), None, grad=True),
+    P("softplus", _f((3, 4)), lambda x: np.log1p(np.exp(x)), grad=True,
+      tol=1e-4),
+    P("softsign", _f((3, 4)), lambda x: x / (1 + np.abs(x)), grad=True),
+    P("sigmoid", _f((3, 4)), lambda x: 1 / (1 + np.exp(-x)), grad=True),
+    P("hardshrink", _f((3, 4)), lambda x: np.where(np.abs(x) > 0.5, x, 0.0)),
+    P("isfinite", _special(), np.isfinite),
+    P("isinf", _special(), np.isinf),
+    P("isnan", _special(), np.isnan),
+    P("nan_to_num", _special(), np.nan_to_num),
+    # ---- binary elementwise ----
+    P("add", _f((3, 4), (3, 4)), np.add, grad=True),
+    P("subtract", _f((3, 4), (3, 4)), np.subtract, grad=True),
+    P("multiply", _f((3, 4), (3, 4)), np.multiply, grad=True),
+    P("divide", _fpos((3, 4), (3, 4)), np.divide, grad=True),
+    P("maximum", _f((3, 4), (3, 4)), np.maximum),
+    P("minimum", _f((3, 4), (3, 4)), np.minimum),
+    P("fmax", _f((3, 4), (3, 4)), np.fmax),
+    P("fmin", _f((3, 4), (3, 4)), np.fmin),
+    P("pow", _fpos((3, 4), (3, 4)), np.power, grad=True, tol=1e-4),
+    P("atan2", _f((3, 4), (3, 4)), np.arctan2, grad=True),
+    P("heaviside", _f((3, 4), (3, 4)), np.heaviside),
+    P("hypot", _f((3, 4), (3, 4)), np.hypot, grad=True),
+    P("copysign", _f((3, 4), (3, 4)), np.copysign),
+    P("logaddexp", _f((3, 4), (3, 4)), np.logaddexp, grad=True),
+    P("mod", _fpos((3, 4), (3, 4)), np.mod),
+    P("remainder", _fpos((3, 4), (3, 4)), np.remainder),
+    P("floor_divide", _fpos((3, 4), (3, 4)), np.floor_divide),
+    P("gcd", _i((3, 4), (3, 4), lo=1, hi=24), np.gcd),
+    P("lcm", _i((3, 4), (3, 4), lo=1, hi=12), np.lcm),
+    P("ldexp", lambda: [(np.random.RandomState(0).randn(3, 4)
+                         .astype("float32"),
+                         np.random.RandomState(1).randint(-3, 4, (3, 4))
+                         .astype("int32"))], np.ldexp),
+    # ---- linalg-ish ----
+    P("matmul", _f((3, 4), (4, 5)), np.matmul, grad=True, tol=1e-4),
+    P("mm", _f((3, 4), (4, 5)), np.matmul, grad=True, tol=1e-4),
+    P("bmm", _f((2, 3, 4), (2, 4, 5)), np.matmul, grad=True, tol=1e-4),
+    P("dot", _f((5,), (5,)), np.dot, grad=True),
+    P("inner", _f((3, 4), (5, 4)), np.inner, grad=True, tol=1e-4),
+    P("outer", _f((3,), (4,)), np.outer, grad=True),
+    P("kron", _f((2, 3), (3, 2)), np.kron, tol=1e-4),
+    P("cross", _f((3, 3), (3, 3)), np.cross, kwargs={"axis": 1},
+      np_kwargs={"axis": 1}, tol=1e-5),
+    P("trace", _f((4, 4)), np.trace, grad=True),
+    P("t", _f((3, 4)), np.transpose),
+    P("tensordot", _f((3, 4), (4, 5)), lambda a, b: np.tensordot(a, b, 1),
+      kwargs={"axes": 1}, np_kwargs={}, tol=1e-4),
+    # ---- comparison / logical ----
+    P("equal", _i((3, 4), (3, 4)), np.equal),
+    P("not_equal", _i((3, 4), (3, 4)), np.not_equal),
+    P("greater_than", _f((3, 4), (3, 4)), np.greater),
+    P("greater_equal", _f((3, 4), (3, 4)), np.greater_equal),
+    P("less_than", _f((3, 4), (3, 4)), np.less),
+    P("less_equal", _f((3, 4), (3, 4)), np.less_equal),
+    P("logical_and", _b((3, 4), (3, 4)), np.logical_and),
+    P("logical_or", _b((3, 4), (3, 4)), np.logical_or),
+    P("logical_xor", _b((3, 4), (3, 4)), np.logical_xor),
+    P("logical_not", _b((3, 4)), np.logical_not),
+    P("isclose", _f((3, 4), (3, 4)), np.isclose),
+    P("bitwise_and", _i((3, 4), (3, 4)), np.bitwise_and),
+    P("bitwise_or", _i((3, 4), (3, 4)), np.bitwise_or),
+    P("bitwise_xor", _i((3, 4), (3, 4)), np.bitwise_xor),
+    P("bitwise_not", _i((3, 4)), np.bitwise_not),
+    # ---- reductions ----
+    P("sum", _f((3, 4)), np.sum, kwargs={"axis": 1}, grad=True),
+    P("mean", _f((3, 4)), np.mean, kwargs={"axis": 0}, grad=True),
+    P("prod", _fpos((3, 4)), np.prod, kwargs={"axis": 1}, grad=True,
+      tol=1e-4),
+    P("max", _f((3, 4)), np.max, kwargs={"axis": 1}),
+    P("min", _f((3, 4)), np.min, kwargs={"axis": 1}),
+    P("amax", _f((3, 4)), np.max, kwargs={"axis": 1}),
+    P("amin", _f((3, 4)), np.min, kwargs={"axis": 1}),
+    P("std", _f((3, 4)), _np_std, kwargs={"axis": 1}),
+    P("var", _f((3, 4)), _np_var, kwargs={"axis": 1}),
+    P("median", _f((3, 5)), np.median, kwargs={"axis": 1}),
+    P("nansum", _special(), np.nansum),
+    P("nanmean", _special(), np.nanmean),
+    P("logsumexp", _f((3, 4)), _np_logsumexp, kwargs={"axis": 1},
+      grad=True),
+    P("all", _b((3, 4)), np.all, kwargs={"axis": 1}),
+    P("any", _b((3, 4)), np.any, kwargs={"axis": 1}),
+    P("count_nonzero", _i((3, 4)), np.count_nonzero, kwargs={"axis": 1}),
+    P("cumsum", _f((3, 4)), np.cumsum, kwargs={"axis": 1}, grad=True),
+    P("cumprod", _fpos((3, 4)), np.cumprod, kwargs={"dim": 1},
+      np_kwargs={"axis": 1}, grad=True, tol=1e-4),
+    P("logcumsumexp", _f((3, 4)), None, kwargs={"axis": 1}, grad=True),
+    # ---- manipulation ----
+    P("reshape", _f((3, 4)), np.reshape, kwargs={"shape": [4, 3]},
+      np_kwargs={"newshape": (4, 3)}, grad=True),
+    P("transpose", _f((3, 4)), np.transpose, kwargs={"perm": [1, 0]},
+      np_kwargs={"axes": (1, 0)}, grad=True),
+    P("flip", _f((3, 4)), np.flip, kwargs={"axis": 1},
+      np_kwargs={"axis": 1}),
+    P("roll", _f((3, 4)), np.roll, kwargs={"shifts": 1, "axis": 1},
+      np_kwargs={"shift": 1, "axis": 1}),
+    P("rot90", _f((3, 4)), np.rot90),
+    P("tile", _f((3, 4)), np.tile, kwargs={"repeat_times": [2, 1]},
+      np_kwargs={"reps": (2, 1)}),
+    P("squeeze", _f((3, 1)), np.squeeze, grad=True),
+    P("flatten", _f((3, 4)), np.ravel, grad=True),
+    P("tril", _f((4, 4)), np.tril, grad=True),
+    P("triu", _f((4, 4)), np.triu, grad=True),
+    P("diag", _f((4, 4)), np.diag),
+    P("diagonal", _f((4, 4)), np.diagonal, grad=True),
+    P("diagflat", _f((4,)), np.diagflat),
+    P("moveaxis", _f((2, 3, 4)), np.moveaxis,
+      kwargs={"source": 0, "destination": 2}),
+    P("broadcast_to", _f((1, 4)), np.broadcast_to,
+      kwargs={"shape": [3, 4]}, np_kwargs={"shape": (3, 4)}),
+    P("concat", _f((2, 3), (2, 3)), lambda *a: np.concatenate(a, 0),
+      list_input=True),
+    P("stack", _f((2, 3), (2, 3)), lambda *a: np.stack(a, 0),
+      list_input=True),
+    P("sort", _f((3, 4)), np.sort, kwargs={"axis": 1}),
+    P("argsort", _f((3, 4)), np.argsort, kwargs={"axis": 1}),
+    P("argmax", _f((3, 4)), np.argmax, kwargs={"axis": 1}),
+    P("argmin", _f((3, 4)), np.argmin, kwargs={"axis": 1}),
+    P("unbind", _f((3, 4)), None),
+    P("nonzero", _i((3, 4), lo=0, hi=2), None),
+    P("searchsorted", lambda: [(np.sort(np.random.RandomState(0)
+                                        .randn(8)).astype("float32"),
+                                np.random.RandomState(1).randn(5)
+                                .astype("float32"))], np.searchsorted),
+    P("bincount", _i((10,), lo=0, hi=6), np.bincount),
+    P("clip", _f((3, 4)), np.clip, kwargs={"min": -0.5, "max": 0.5},
+      np_kwargs={"a_min": -0.5, "a_max": 0.5}, grad=True),
+    P("where", lambda: [(np.random.RandomState(0).rand(3, 4) > 0.5,
+                         np.random.RandomState(1).randn(3, 4)
+                         .astype("float32"),
+                         np.random.RandomState(2).randn(3, 4)
+                         .astype("float32"))], np.where),
+    # ---- creation ----
+    P("zeros_like", _f((3, 4)), np.zeros_like),
+    P("ones_like", _f((3, 4)), np.ones_like),
+]
+
+
+def _surface_modules():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.tensor as T
+    mods = [("", T), ("nn.functional.", F)]
+    for name in ("linalg", "fft", "signal", "sparse", "geometric"):
+        try:
+            ns = getattr(paddle, name, None)
+        except ModuleNotFoundError:
+            ns = None
+        if ns is not None:
+            mods.append((name + ".", ns))
+    for prefix, path in (
+            ("vision.ops.", "paddle_tpu.vision.ops"),
+            ("vision.transforms.", "paddle_tpu.vision.transforms.functional"),
+            ("incubate.nn.functional.", "paddle_tpu.incubate.nn.functional"),
+            ("audio.functional.", "paddle_tpu.audio.functional"),
+            ("text.", "paddle_tpu.text"),
+            ("distribution.", "paddle_tpu.distribution")):
+        try:
+            import importlib
+            mods.append((prefix, importlib.import_module(path)))
+        except Exception:
+            pass
+    return mods
+
+
+_FULL_BUILT = False
+
+
+def build_full_registry() -> Dict[str, OpDef]:
+    """Pass 2: absorb the whole public op surface into REGISTRY and
+    overlay the _PARITY specs.  Idempotent; called lazily (from the
+    generated tests and paddle_tpu.__init__ consumers) to avoid import
+    cycles at package-import time."""
+    global _FULL_BUILT
+    if _FULL_BUILT:
+        return REGISTRY
+    import inspect
+    for prefix, mod in _surface_modules():
+        for k in dir(mod):
+            if k.startswith("_"):
+                continue
+            fn = getattr(mod, k)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            qual = prefix + k
+            if qual not in REGISTRY:
+                REGISTRY[qual] = OpDef(name=qual, impl=fn, arity=-1,
+                                       paddle_fn=fn, source="absorbed")
+            elif REGISTRY[qual].paddle_fn is None:
+                REGISTRY[qual].paddle_fn = fn
+    for spec in _PARITY:
+        row = REGISTRY.get(spec.name)
+        if row is None:  # e.g. only under nn.functional.
+            row = REGISTRY.get("nn.functional." + spec.name)
+        if row is None:
+            raise KeyError(f"_PARITY spec for unknown op {spec.name!r}")
+        row.np_ref = spec.np_ref if spec.np_ref is not None else row.np_ref
+        row.gen_cases = spec.gen
+        row.kwargs = spec.kwargs
+        row.np_kwargs = spec.np_kwargs
+        row.grad = spec.grad
+        row.list_input = spec.list_input
+        row.tol = spec.tol
+    _FULL_BUILT = True
+    return REGISTRY

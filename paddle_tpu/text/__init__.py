@@ -1,0 +1,246 @@
+"""paddle.text — text-domain API (ref: python/paddle/text/).
+
+The reference ships dataset loaders (Imdb, Imikolov, Movielens,
+UCIHousing, WMT14/16, Conll05) plus ``ViterbiDecoder``.  TPU-native:
+the Viterbi decode is a ``lax.scan`` over the sequence (compiles to one
+fused XLA loop instead of the reference's CUDA viterbi_decode kernel);
+dataset classes keep the reference constructor/API but require a local
+``data_file`` (this environment has no network egress, matching
+offline-cluster usage of the reference's DATA_HOME cache).
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "Imikolov",
+           "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """ref: paddle.text.viterbi_decode — max-score path per batch.
+
+    potentials: (B, T, N) emission scores; transition_params: (N, N);
+    lengths: (B,) int64.  Returns (scores (B,), paths (B, T)).
+    """
+    pot = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    B, T, N = pot.shape
+    if lengths is None:
+        lengths = Tensor(jnp.full((B,), T, jnp.int64))
+    else:
+        lengths = ensure_tensor(lengths)
+
+    def impl(p, tr, lens):
+        # optional BOS/EOS augmentation (ref semantics: tags n-2/n-1)
+        def step(alpha, t):
+            # alpha: (B, N) best score ending in tag j at t-1
+            scores = alpha[:, :, None] + tr[None, :, :]  # (B, i, j)
+            best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+            best_score = jnp.max(scores, axis=1) + p[:, t, :]
+            # sequences shorter than t keep their alpha
+            active = (t < lens)[:, None]
+            alpha_new = jnp.where(active, best_score, alpha)
+            return alpha_new, best_prev
+
+        alpha0 = p[:, 0, :]
+        if include_bos_eos_tag:
+            # BOS tag = N-2: start scores get transition from BOS
+            alpha0 = alpha0 + tr[N - 2, :][None, :]
+        alpha, backs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + tr[:, N - 1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+
+        # backtrack (scan in reverse over the backpointers): backs[t]
+        # maps tag-at-(t+1) -> best tag-at-t
+        def back_step(tag, t):
+            bp = backs[t]                                # (B, N)
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            valid = (t + 1 < lens)   # beyond a short seq's end: hold
+            prev = jnp.where(valid, prev, tag)
+            return prev, prev
+
+        _, path = jax.lax.scan(back_step, last_tag,
+                               jnp.arange(T - 2, -1, -1))
+        # path is (T-1, B): tags at T-2 .. 0; reconstruct forward order
+        full = jnp.concatenate([path[::-1], last_tag[None, :]], axis=0)
+        return scores, full.T.astype(jnp.int64)
+
+    outs = call_op(impl, [pot, trans, lengths], multi_out=True,
+                   op_name="viterbi_decode")
+    return outs[0], outs[1]
+
+
+class ViterbiDecoder:
+    """ref: paddle.text.ViterbiDecoder (layer wrapper)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _LocalFileDataset(Dataset):
+    """Shared base: the reference downloads into DATA_HOME; offline, a
+    local ``data_file`` is required and errors say exactly that."""
+
+    _NAME = "dataset"
+
+    def __init__(self, data_file=None, mode="train"):
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"paddle.text.{self._NAME}: no network egress in this "
+                f"environment — pass data_file= pointing at a local copy "
+                f"(the reference caches the same archive in ~/.cache/"
+                f"paddle/dataset)")
+        self.data_file = data_file
+        self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(_LocalFileDataset):
+    """ref: text/datasets/uci_housing.py — 13-feature regression."""
+
+    _NAME = "UCIHousing"
+
+    def _load(self):
+        raw = np.loadtxt(self.data_file)
+        feats = raw[:, :-1].astype("float32")
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        labels = raw[:, -1:].astype("float32")
+        n = len(raw)
+        split = int(n * 0.8)
+        if self.mode == "train":
+            self.data = [(feats[i], labels[i]) for i in range(split)]
+        else:
+            self.data = [(feats[i], labels[i]) for i in range(split, n)]
+
+
+class Imdb(_LocalFileDataset):
+    """ref: text/datasets/imdb.py — sentiment; expects the aclImdb tar."""
+
+    _NAME = "Imdb"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.cutoff = cutoff
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        import re
+        pat = re.compile(rf"aclImdb/{self.mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = {}
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                toks = text.split()
+                docs.append(toks)
+                labels.append(0 if m.group(1) == "pos" else 1)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= self.cutoff}
+        self.word_idx = vocab
+        unk = len(vocab)
+        self.data = [
+            (np.asarray([vocab.get(t, unk) for t in d], "int64"),
+             np.asarray([l], "int64"))
+            for d, l in zip(docs, labels)]
+
+
+class Imikolov(_LocalFileDataset):
+    """ref: text/datasets/imikolov.py — PTB-style n-gram LM."""
+
+    _NAME = "Imikolov"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        super().__init__(data_file, mode)
+
+    def _load(self):
+        split = "train" if self.mode == "train" else "valid"
+        lines = []
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                if member.name.endswith(f"ptb.{split}.txt"):
+                    lines = tf.extractfile(member).read().decode(
+                        "utf-8").splitlines()
+        freq = {}
+        for ln in lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        vocab = {w: i for i, w in enumerate(
+            w for w, c in sorted(freq.items()) if c >= self.min_word_freq)}
+        self.word_idx = vocab
+        unk = len(vocab)
+        self.data = []
+        for ln in lines:
+            ids = [vocab.get(w, unk) for w in ln.split()]
+            for i in range(len(ids) - self.window_size + 1):
+                self.data.append(
+                    np.asarray(ids[i:i + self.window_size], "int64"))
+
+
+class Conll05st(_LocalFileDataset):
+    _NAME = "Conll05st"
+
+    def _load(self):
+        raise NotImplementedError(
+            "Conll05st parsing requires the licensed archive; provide and "
+            "parse locally")
+
+
+class Movielens(_LocalFileDataset):
+    _NAME = "Movielens"
+
+    def _load(self):
+        raise NotImplementedError(
+            "Movielens parsing not implemented; provide the ml-1m archive")
+
+
+class WMT14(_LocalFileDataset):
+    _NAME = "WMT14"
+
+    def _load(self):
+        raise NotImplementedError(
+            "WMT14 parsing not implemented; provide the archive locally")
+
+
+class WMT16(_LocalFileDataset):
+    _NAME = "WMT16"
+
+    def _load(self):
+        raise NotImplementedError(
+            "WMT16 parsing not implemented; provide the archive locally")

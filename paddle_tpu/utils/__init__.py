@@ -1,0 +1,76 @@
+"""paddle.utils (ref: python/paddle/utils/): unique_name, deprecated,
+try_import, download, dlpack, cpp_extension (custom-op build path).
+"""
+from __future__ import annotations
+
+import importlib
+import warnings
+
+from . import cpp_extension, unique_name
+
+__all__ = ["cpp_extension", "unique_name", "deprecated", "try_import",
+           "run_check", "to_dlpack", "from_dlpack"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """ref: utils/deprecated.py — decorator emitting DeprecationWarning."""
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f". reason: {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """ref: utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"required optional module {module_name!r} is not "
+            f"installed")
+
+
+def run_check():
+    """ref: utils/install_check.py — verify the runtime works end to end
+    (one matmul + grad on the default device)."""
+    import paddle_tpu as paddle
+    x = paddle.randn([4, 4])
+    x.stop_gradient = False
+    y = paddle.matmul(x, x).sum()
+    y.backward()
+    assert x.grad is not None
+    dev = paddle.device.get_device()
+    print(f"paddle_tpu is installed successfully on {dev}!")
+
+
+def to_dlpack(x):
+    """ref: utils/dlpack.py to_dlpack — zero-copy export.
+
+    Returns the underlying array, which implements ``__dlpack__``/
+    ``__dlpack_device__`` (the modern dlpack exchange protocol that
+    torch.from_dlpack / jnp.from_dlpack consume directly; raw capsules
+    are deprecated in both)."""
+    from ..core.tensor import Tensor
+    assert isinstance(x, Tensor)
+    return x._data
+
+
+def from_dlpack(capsule):
+    """ref: utils/dlpack.py from_dlpack."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    if hasattr(capsule, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(capsule))
+    return Tensor(jax.dlpack.from_dlpack(capsule))

@@ -1,0 +1,191 @@
+"""paddle.utils.cpp_extension — custom-op extension path (ref:
+python/paddle/utils/cpp_extension/ + fluid/framework/custom_operator.cc).
+
+Two registration paths, mirroring how the reference splits CPU C++ ops
+from device kernels:
+
+* **C++ host ops** — ``load(name, sources)`` compiles user C++ with g++
+  into a shared library (the reference JIT-compiles against installed
+  headers the same way) and ``custom_op`` wraps an exported symbol as a
+  paddle op.  The C symbol operates on raw buffers
+  (``void f(const float* x, float* y, int64_t n)``); it executes via
+  ``jax.pure_callback`` so it composes with jit — XLA calls back to the
+  host for this op, exactly the role of a CPU custom kernel.
+* **Device (Pallas/JAX) ops** — ``register_custom_op(name, fwd, vjp)``
+  registers a jnp/Pallas implementation with an optional custom VJP;
+  this is the TPU-native analogue of a CUDA custom kernel and runs fully
+  on device inside jit.
+
+Both paths lower through ``call_op`` so autograd/AMP/profiler treat the
+op like any built-in.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["load", "custom_op", "register_custom_op", "CppExtension",
+           "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """ref: cpp_extension.CppExtension — a build spec (sources+flags)."""
+
+    def __init__(self, sources: Sequence[str],
+                 extra_compile_args: Optional[List[str]] = None, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_cflags: Optional[List[str]] = None,
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """ref: cpp_extension.load — JIT-compile C++ sources to a shared
+    library and return a handle exposing its ``extern "C"`` symbols.
+
+    Returns a ``ctypes.CDLL``; wrap individual symbols with
+    :func:`custom_op` to get paddle ops.
+    """
+    build_dir = build_directory or get_build_directory()
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    tag = hashlib.sha1(
+        ("|".join(srcs) + "".join(open(s).read() for s in srcs))
+        .encode()).hexdigest()[:12]
+    lib_path = os.path.join(build_dir, f"{name}-{tag}.so")
+    if not os.path.exists(lib_path):
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + list(extra_cxx_cflags or [])
+               + srcs + ["-o", lib_path])
+        if verbose:
+            print("compiling:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{proc.stderr}")
+    return ctypes.CDLL(lib_path)
+
+
+def custom_op(library, symbol: str, vjp_symbol: Optional[str] = None,
+              dtype="float32"):
+    """Wrap an ``extern "C" void f(const T* x, T* y, int64_t n)`` symbol
+    (same-shape, elementwise-style contract — the common case of the
+    reference's CPU custom ops) as a differentiable paddle op.
+
+    ``vjp_symbol`` names an optional
+    ``void g(const T* x, const T* gy, T* gx, int64_t n)`` gradient.
+    """
+    cfn = getattr(library, symbol)
+    cfn.restype = None
+    np_dtype = np.dtype(dtype)
+
+    def _host(x):
+        x = np.ascontiguousarray(x, dtype=np_dtype)
+        out = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(x.size))
+        return out
+
+    def _fwd_array(a):
+        return jax.pure_callback(
+            _host, jax.ShapeDtypeStruct(a.shape, np_dtype), a,
+            vmap_method="sequential")
+
+    if vjp_symbol is not None:
+        gfn = getattr(library, vjp_symbol)
+        gfn.restype = None
+
+        def _host_grad(x, gy):
+            x = np.ascontiguousarray(x, dtype=np_dtype)
+            gy = np.ascontiguousarray(gy, dtype=np_dtype)
+            gx = np.empty_like(x)
+            gfn(x.ctypes.data_as(ctypes.c_void_p),
+                gy.ctypes.data_as(ctypes.c_void_p),
+                gx.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(x.size))
+            return gx
+
+        @jax.custom_vjp
+        def op(a):
+            return _fwd_array(a)
+
+        def op_fwd(a):
+            return _fwd_array(a), a
+
+        def op_bwd(a, gy):
+            gx = jax.pure_callback(
+                _host_grad, jax.ShapeDtypeStruct(a.shape, np_dtype), a, gy,
+                vmap_method="sequential")
+            return (gx,)
+
+        op.defvjp(op_fwd, op_bwd)
+    else:
+        op = _fwd_array
+
+    def paddle_op(x, name=None):
+        return call_op(op, [ensure_tensor(x)], op_name=symbol)
+
+    paddle_op.__name__ = symbol
+    paddle_op.__doc__ = f"custom C++ op {symbol} (cpp_extension.load)"
+    return paddle_op
+
+
+def register_custom_op(name: str, fwd: Callable,
+                       vjp: Optional[Callable] = None):
+    """Register a device-side custom op from a jnp/Pallas implementation
+    (the TPU-native analogue of a CUDA custom kernel).
+
+    ``fwd(*arrays) -> array``; ``vjp(arrays, grad_out) -> tuple(grads)``.
+    Returns the paddle-level op and also exposes it as
+    ``paddle.utils.cpp_extension.ops.<name>``.
+    """
+    if vjp is not None:
+        @jax.custom_vjp
+        def op(*arrays):
+            return fwd(*arrays)
+
+        def op_f(*arrays):
+            return fwd(*arrays), arrays
+
+        def op_b(arrays, g):
+            return tuple(vjp(arrays, g))
+
+        op.defvjp(op_f, op_b)
+    else:
+        op = fwd
+
+    def paddle_op(*args, name_=None):
+        tensors = [ensure_tensor(a) for a in args]
+        return call_op(op, tensors, op_name=name)
+
+    paddle_op.__name__ = name
+    setattr(ops, name, paddle_op)
+    return paddle_op
+
+
+class ops:
+    """Namespace for registered custom ops (ref: generated custom-op
+    python modules)."""

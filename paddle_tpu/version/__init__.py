@@ -1,0 +1,49 @@
+"""paddle.version (ref: generated python/paddle/version/__init__.py)."""
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+commit = "unknown"
+istaged = False
+with_pip = True
+
+# accelerator toolkit versions: the reference reports cuda/cudnn/nccl;
+# this build targets TPU via XLA, so those are explicitly absent.
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = "False"
+nccl_version = "False"
+xpu_version = "False"
+
+
+def show():
+    """ref: paddle.version.show()."""
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def nccl():
+    return nccl_version
+
+
+def xpu():
+    return xpu_version
+
+
+def tensorrt():
+    return tensorrt_version
