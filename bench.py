@@ -239,7 +239,10 @@ def main():
     if err:
         errors["probe"] = err
 
-    if probe and probe.get("platform") != "cpu":
+    # run the real TPU stage unless the probe POSITIVELY reported a
+    # cpu-only backend — a probe timeout (slow-but-working tunnel) must
+    # not forfeit the TPU attempt, only inform the error chain
+    if probe is None or probe.get("platform") != "cpu":
         t_tpu = int(os.environ.get("BENCH_STAGE_TIMEOUT", "360"))
         line, err = _run_child({}, t_tpu)
         if line:

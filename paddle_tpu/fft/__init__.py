@@ -35,7 +35,7 @@ def _check_norm(norm):
 
 
 def _make_1d(name, jfn):
-    def fn(x, n=None, axis=-1, norm="backward", name_=None):
+    def fn(x, n=None, axis=-1, norm="backward", name=None):
         nrm = _check_norm(norm)
         x = ensure_tensor(x)
         return call_op(lambda a: jfn(a, n=n, axis=axis, norm=nrm), [x],
@@ -46,7 +46,7 @@ def _make_1d(name, jfn):
 
 
 def _make_2d(name, jfn):
-    def fn(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+    def fn(x, s=None, axes=(-2, -1), norm="backward", name=None):
         nrm = _check_norm(norm)
         x = ensure_tensor(x)
         return call_op(lambda a: jfn(a, s=s, axes=axes, norm=nrm), [x],
@@ -57,7 +57,7 @@ def _make_2d(name, jfn):
 
 
 def _make_nd(name, jfn):
-    def fn(x, s=None, axes=None, norm="backward", name_=None):
+    def fn(x, s=None, axes=None, norm="backward", name=None):
         nrm = _check_norm(norm)
         x = ensure_tensor(x)
         return call_op(lambda a: jfn(a, s=s, axes=axes, norm=nrm), [x],

@@ -28,10 +28,20 @@ def _example_arrays(input_spec):
     from .. import dtype as dtypes
     arrays = []
     scope = None
+
     sym_count = [0]
 
-    def dim_str(s):
+    def dim_str(s, axis):
         if s is None or int(s) < 0:
+            if axis == 0:
+                # dynamic LEADING dims share one symbol: InputSpec([None,
+                # S]) across several inputs means THE SAME batch (paddle's
+                # dynamic-batch convention) — independent symbols would
+                # make embeddings of two inputs unbroadcastable at export.
+                return "_batch"
+            # non-leading dynamic dims (e.g. src vs tgt seq lengths) stay
+            # independent symbols; equating them would bake a false
+            # constraint into the artifact
             sym_count[0] += 1
             return f"_d{sym_count[0]}"
         return str(int(s))
@@ -40,7 +50,7 @@ def _example_arrays(input_spec):
         if isinstance(spec, InputSpec):
             shape = spec.shape or [1]
             if any(s is None or int(s) < 0 for s in shape):
-                expr = ",".join(dim_str(s) for s in shape)
+                expr = ",".join(dim_str(s, i) for i, s in enumerate(shape))
                 if scope is None:
                     sym = jexport.symbolic_shape(expr)
                     scope = sym[0].scope if hasattr(sym[0], "scope") else None

@@ -2,3 +2,7 @@
 paddle_tpu.vision.models)."""
 from .gpt import (GPTConfig, GPTModel, GPTForPretraining,
                   GPTPretrainingCriterion, gpt_config, PRESETS)
+from .bert import (BertConfig, BertModel, BertForPretraining,
+                   BertForQuestionAnswering,
+                   BertForSequenceClassification,
+                   BertPretrainingCriterion, bert_config, BERT_PRESETS)

@@ -1,0 +1,320 @@
+"""BERT model family — BASELINE config 2 flagship (BERT-base SQuAD
+under @to_static).
+
+Reference: PaddleNLP transformers/bert/modeling.py (BertModel,
+BertForPretraining, BertForQuestionAnswering) driven through
+paddle.jit.to_static (survey §2.4 config 2; python/paddle/jit/).
+
+TPU-native design notes:
+- the encoder is built from the fleet tensor-parallel layers
+  (Column/RowParallelLinear, VocabParallelEmbedding) exactly like the
+  GPT flagship, so mp/sharding come from GSPMD weight specs;
+- the attention mask is an additive bias computed from the [B, S]
+  padding mask inside the traced graph — to_static guards re-trace on
+  mask presence/shape (mask vs no-mask are different specialized
+  graphs, the reference's dy2static control-flow case);
+- bidirectional attention (is_causal=False) + mask goes down the XLA
+  softmax path; long-sequence variants can slot the Pallas kernel in
+  via nn.functional.scaled_dot_product_attention.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Constant, Normal
+from ..framework.param_attr import ParamAttr
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..distributed.shard_utils import sharding_constraint
+from ..distributed.fleet.recompute import recompute
+import paddle_tpu as paddle
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForQuestionAnswering", "BertForSequenceClassification",
+           "BertPretrainingCriterion", "bert_config", "BERT_PRESETS"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None      # default 4*hidden
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    use_recompute: bool = False
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+BERT_PRESETS = {
+    "bert-base": dict(num_layers=12, hidden_size=768, num_heads=12),
+    "bert-large": dict(num_layers=24, hidden_size=1024, num_heads=16),
+    "tiny": dict(num_layers=2, hidden_size=64, num_heads=4,
+                 vocab_size=256, max_position_embeddings=128),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    cfg = dict(BERT_PRESETS[name])
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+class BertEmbeddings(nn.Layer):
+    """word + position + token_type embeddings, LN, dropout."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.word_embeddings = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            c.max_position_embeddings, c.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            c.type_vocab_size, c.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.drop_p = c.hidden_dropout_prob
+
+    def forward(self, input_ids, token_type_ids=None):
+        S = input_ids.shape[-1]
+        pos = paddle.arange(0, S, dtype="int64")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = paddle.zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        x = self.layer_norm(x)
+        return F.dropout(x, self.drop_p, training=self.training)
+
+
+class BertSelfAttention(nn.Layer):
+    """Bidirectional self-attention with additive padding mask."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.hidden_size = c.hidden_size
+        self.attn_drop = c.attention_dropout_prob
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, weight_attr=init,
+            has_bias=True, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, weight_attr=init, has_bias=True,
+            input_is_parallel=True)
+
+    def forward(self, x, attn_bias=None):
+        B, S, H = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = sharding_constraint(q, None, None, "mp", None)
+        k = sharding_constraint(k, None, None, "mp", None)
+        v = sharding_constraint(v, None, None, "mp", None)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_bias,
+            dropout_p=self.attn_drop if self.training else 0.0,
+            is_causal=False, training=self.training)
+        out = out.reshape([B, S, H])
+        out = sharding_constraint(out, None, None, "mp")
+        return self.out_proj(out)
+
+
+class BertLayer(nn.Layer):
+    """post-LN transformer encoder block (BERT ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.attention = BertSelfAttention(c)
+        self.ln1 = nn.LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.fc1 = ColumnParallelLinear(c.hidden_size, c.intermediate_size,
+                                        weight_attr=init, has_bias=True,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(c.intermediate_size, c.hidden_size,
+                                     weight_attr=init, has_bias=True,
+                                     input_is_parallel=True)
+        self.ln2 = nn.LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.drop_p = c.hidden_dropout_prob
+
+    def forward(self, x, attn_bias=None):
+        h = self.attention(x, attn_bias)
+        h = F.dropout(h, self.drop_p, training=self.training)
+        x = self.ln1(x + h)
+        h = self.fc2(F.gelu(self.fc1(x)))
+        h = F.dropout(h, self.drop_p, training=self.training)
+        return self.ln2(x + h)
+
+
+class BertPooler(nn.Layer):
+    """[CLS] token through dense+tanh (ref: BertPooler)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = ParamAttr(initializer=Normal(std=config.initializer_range))
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size,
+                               weight_attr=init)
+
+    def forward(self, x):
+        return F.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(nn.Layer):
+    """Encoder stack → (sequence_output, pooled_output)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(config)
+                                     for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.config
+        # additive attention bias from the [B, S] padding mask (1 = keep).
+        # None vs provided are different specialized graphs — to_static
+        # re-traces on the argument pattern (reference's dy2static
+        # control-flow case).
+        attn_bias = None
+        if attention_mask is not None:
+            m = attention_mask.astype("float32")
+            # [B, S] -> [B, 1, 1, S] broadcast over heads/query positions
+            attn_bias = ((1.0 - m) * -1e4).reshape(
+                [m.shape[0], 1, 1, m.shape[-1]])
+        x = self.embeddings(input_ids, token_type_ids)
+        x = sharding_constraint(x, ("dp", "sharding"), None, None)
+        for layer in self.encoder:
+            if c.use_recompute and self.training:
+                x = recompute(layer, x, attn_bias)
+            else:
+                x = layer(x, attn_bias)
+        return x, self.pooler(x)
+
+
+class BertLMPredictionHead(nn.Layer):
+    """transform (dense+gelu+LN) + decoder tied to word embeddings."""
+
+    def __init__(self, config: BertConfig, embedding_weight):
+        super().__init__()
+        c = config
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.transform = nn.Linear(c.hidden_size, c.hidden_size,
+                                   weight_attr=init)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, epsilon=1e-12)
+        self.decoder_weight = embedding_weight          # tied [V, H]
+        self.decoder_bias = self.create_parameter(
+            shape=[c.vocab_size], attr=ParamAttr(initializer=Constant(0.0)),
+            is_bias=True)
+
+    def forward(self, x):
+        x = self.layer_norm(F.gelu(self.transform(x)))
+        return paddle.matmul(x, self.decoder_weight,
+                             transpose_y=True) + self.decoder_bias
+
+
+class BertForPretraining(nn.Layer):
+    """MLM head + NSP head (ref: BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.seq_relationship = nn.Linear(
+            config.hidden_size, 2,
+            weight_attr=ParamAttr(
+                initializer=Normal(std=config.initializer_range)))
+        self.loss_fn = BertPretrainingCriterion()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.cls(seq), self.seq_relationship(pooled)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """masked-LM CE (ignore_index=-100 over unmasked positions) + NSP CE."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        B, S, V = prediction_scores.shape
+        flat_logits = prediction_scores.reshape([B * S, V])
+        flat_labels = masked_lm_labels.reshape([B * S])
+        safe = paddle.where(flat_labels == self.ignore_index,
+                            paddle.zeros_like(flat_labels), flat_labels)
+        logp = F.log_softmax(flat_logits, axis=-1)
+        nll = -paddle.take_along_axis(logp, safe.reshape([B * S, 1]),
+                                      axis=1).reshape([B * S])
+        mask = (flat_labels != self.ignore_index).astype(nll.dtype)
+        mlm_loss = (nll * mask).sum() / mask.sum().clip(min=1.0)
+        if next_sentence_labels is None:
+            return mlm_loss
+        nsp = F.cross_entropy(seq_relationship_score,
+                              next_sentence_labels.reshape([-1]))
+        return mlm_loss + nsp.mean()
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """span head: start/end logits (ref: BertForQuestionAnswering —
+    the SQuAD fine-tune model of BASELINE config 2)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.classifier = nn.Linear(
+            config.hidden_size, 2,
+            weight_attr=ParamAttr(
+                initializer=Normal(std=config.initializer_range)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(seq)                # [B, S, 2]
+        start, end = paddle.unstack(logits, axis=-1, num=2)
+        return start, end
+
+    @staticmethod
+    def loss(start_logits, end_logits, start_positions, end_positions):
+        ls = F.cross_entropy(start_logits, start_positions.reshape([-1]))
+        le = F.cross_entropy(end_logits, end_positions.reshape([-1]))
+        return (ls.mean() + le.mean()) / 2.0
+
+
+class BertForSequenceClassification(nn.Layer):
+    """pooled output → dropout → classifier (ref: same name)."""
+
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.drop_p = config.hidden_dropout_prob
+        self.classifier = nn.Linear(
+            config.hidden_size, num_classes,
+            weight_attr=ParamAttr(
+                initializer=Normal(std=config.initializer_range)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        pooled = F.dropout(pooled, self.drop_p, training=self.training)
+        return self.classifier(pooled)

@@ -38,7 +38,11 @@ def _fake_quant(x, scale, bits=8):
 
 
 class BaseObserver:
-    """ref: observers/abs_max.py base — tracks calibration statistics."""
+    """ref: observers/abs_max.py base — tracks calibration statistics.
+
+    Observers are callable (identity pass-through that records stats) so
+    they slot into the same Quanted* wrappers as quanters; ``convert``
+    then bakes with the observed scale."""
 
     def __init__(self, quant_bits=8):
         self.quant_bits = quant_bits
@@ -47,10 +51,25 @@ class BaseObserver:
     def observe(self, x: Tensor):
         raise NotImplementedError
 
+    def __call__(self, x):
+        return self.observe(x)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
     def scale(self):
         if self._scale is None:
             raise RuntimeError("observer has seen no data")
         return self._scale
+
+    def quantize_array(self, x: Tensor) -> Tensor:
+        """Fake-quantize with the calibrated scale (used by convert)."""
+        s = self.scale()
+        return call_op(lambda a: _fake_quant(a, s, self.quant_bits),
+                       [ensure_tensor(x)], op_name="quantize_bake")
 
 
 class AbsmaxObserver(BaseObserver):
@@ -91,6 +110,11 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         scale = self._scale
         return call_op(lambda a: _fake_quant(a, scale, self.quant_bits),
                        [x], op_name="fake_quantize_dequantize")
+
+    def quantize_array(self, x: Tensor) -> Tensor:
+        s = self._scale
+        return call_op(lambda a: _fake_quant(a, s, self.quant_bits),
+                       [ensure_tensor(x)], op_name="quantize_bake")
 
 
 class QuantConfig:
@@ -226,8 +250,7 @@ class QAT:
             if isinstance(child, (QuantedLinear, QuantedConv2D)):
                 inner = child.inner
                 if child.weight_quanter is not None:
-                    child.weight_quanter.eval()
-                    q = child.weight_quanter(inner.weight)
+                    q = child.weight_quanter.quantize_array(inner.weight)
                     inner.weight.set_value(q)
                 model._sub_layers[name] = inner
             elif isinstance(child, nn.Layer):

@@ -58,10 +58,14 @@ def overlap_add(x, hop_length, axis=-1, name=None):
         am = a if axis == -1 else jnp.moveaxis(a, (0, 1), (-1, -2))
         fl, nf = am.shape[-2], am.shape[-1]
         out_len = (nf - 1) * hop_length + fl
+        # ONE scatter-add over all frames (an unrolled per-frame loop
+        # would emit nf dynamic-update-slices and blow up compile time)
+        idx = (jnp.arange(nf)[:, None] * hop_length
+               + jnp.arange(fl)[None, :]).reshape(-1)      # (nf*fl,)
+        frames_flat = jnp.swapaxes(am, -2, -1).reshape(
+            am.shape[:-2] + (nf * fl,))
         out = jnp.zeros(am.shape[:-2] + (out_len,), am.dtype)
-        for i in range(nf):   # static python loop: nf is a trace constant
-            out = out.at[..., i * hop_length:i * hop_length + fl].add(
-                am[..., :, i])
+        out = out.at[..., idx].add(frames_flat)
         return out if axis == -1 else jnp.moveaxis(out, -1, 0)
 
     return call_op(impl, [x], op_name="overlap_add")
@@ -112,6 +116,10 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     win_length = win_length or n_fft
     if window is not None:
         window = ensure_tensor(window)
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False (a "
+            "onesided spectrum reconstructs a real signal by definition)")
 
     def impl(s, *rest):
         w = rest[0] if rest else jnp.ones((win_length,), jnp.float32)
@@ -120,18 +128,24 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         spec = jnp.swapaxes(s, -2, -1)        # (..., n_frames, n_freq)
         if normalized:
             spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
-                  else jnp.fft.ifft(spec, axis=-1).real)
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
         frames = frames * w                   # synthesis windowing
         nf = frames.shape[-2]
         out_len = (nf - 1) * hop_length + n_fft
+        # single scatter-add for signal and window envelope (see
+        # overlap_add: per-frame python loops don't scale in XLA)
+        idx = (jnp.arange(nf)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
         out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
-        env = jnp.zeros((out_len,), frames.dtype)
-        wsq = w * w
-        for i in range(nf):
-            sl = slice(i * hop_length, i * hop_length + n_fft)
-            out = out.at[..., sl].add(frames[..., i, :])
-            env = env.at[sl].add(wsq)
+        out = out.at[..., idx].add(frames.reshape(
+            frames.shape[:-2] + (nf * n_fft,)))
+        env = jnp.zeros((out_len,), w.dtype)
+        env = env.at[idx].add(jnp.tile(w * w, nf))
         out = out / jnp.maximum(env, 1e-11)
         if center:
             pad = n_fft // 2

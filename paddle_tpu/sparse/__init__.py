@@ -191,25 +191,56 @@ def _rewrap(bcoo, like):
     return out
 
 
+def _union_add(x, y, y_scale=1.0):
+    """Sparse-native add: concat index/value lists + sum_duplicates —
+    O(nnz), never densifies (a (100k)^2 matrix with a few thousand
+    nonzeros must not materialize 40GB)."""
+    a, b = _coo(x), _coo(y)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    data = jnp.concatenate([a.data, b.data * y_scale])
+    indices = jnp.concatenate([a.indices, b.indices], axis=0)
+    return jsparse.BCOO((data, indices),
+                        shape=a.shape).sum_duplicates()
+
+
 def add(x, y, name=None):
-    """ref: paddle.sparse.add."""
-    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
-                                          + _coo(y).todense()), x)
+    """ref: paddle.sparse.add — index-union on nnz entries."""
+    return _rewrap(_union_add(x, y), x)
 
 
 def subtract(x, y, name=None):
-    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
-                                          - _coo(y).todense()), x)
+    """ref: paddle.sparse.subtract — index-union on nnz entries."""
+    return _rewrap(_union_add(x, y, y_scale=-1.0), x)
 
 
 def multiply(x, y, name=None):
-    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
-                                          * _coo(y).todense()), x)
+    """ref: paddle.sparse.multiply — elementwise product.  The product's
+    support is the INTERSECTION of both patterns, so evaluating x's
+    values at x's own indices against y keeps it O(nnz_x * density_y)
+    without a full dense intermediate only when y is dense; sparse*sparse
+    goes through a dense round-trip (upstream requires matching patterns
+    for the CUDA kernel; this accepts any)."""
+    a = _coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return _rewrap(jsparse.BCOO.fromdense(a.todense()
+                                              * _coo(y).todense()), x)
+    yd = ensure_tensor(y)._data
+    vals = a.data * yd[tuple(a.indices[:, i]
+                             for i in range(a.indices.shape[1]))]
+    return _rewrap(jsparse.BCOO((vals, a.indices), shape=a.shape), x)
 
 
 def divide(x, y, name=None):
-    return _rewrap(jsparse.BCOO.fromdense(_coo(x).todense()
-                                          / _coo(y).todense()), x)
+    """ref: paddle.sparse.divide (see multiply for pattern semantics)."""
+    a = _coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return _rewrap(jsparse.BCOO.fromdense(a.todense()
+                                              / _coo(y).todense()), x)
+    yd = ensure_tensor(y)._data
+    vals = a.data / yd[tuple(a.indices[:, i]
+                             for i in range(a.indices.shape[1]))]
+    return _rewrap(jsparse.BCOO((vals, a.indices), shape=a.shape), x)
 
 
 def matmul(x, y, name=None):

@@ -110,8 +110,12 @@ def shape_list(shape) -> Sequence[int]:
     for s in shape:
         if isinstance(s, Tensor):
             out.append(int(s.item()))
-        else:
+        elif isinstance(s, (int, np.integer)):
             out.append(int(s))
+        else:
+            # symbolic dimension (jax.export shape polymorphism during
+            # jit.save with dynamic axes) — must flow through unchanged
+            out.append(s)
     return tuple(out)
 
 

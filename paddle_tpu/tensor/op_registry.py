@@ -898,9 +898,14 @@ def build_full_registry() -> Dict[str, OpDef]:
     if _FULL_BUILT:
         return REGISTRY
     import inspect
+    # framework-internal helpers re-exported by the surface modules are
+    # NOT ops; indexing them would inflate the advertised op count
+    _NOT_OPS = {"call_op", "ensure_tensor", "unwrap", "shape_list",
+                "axis_tuple", "canonicalize_axis", "config_callbacks",
+                "register_kl"}
     for prefix, mod in _surface_modules():
         for k in dir(mod):
-            if k.startswith("_"):
+            if k.startswith("_") or k in _NOT_OPS:
                 continue
             fn = getattr(mod, k)
             if not callable(fn) or inspect.isclass(fn):

@@ -177,11 +177,13 @@ def register_custom_op(name: str, fwd: Callable,
     else:
         op = fwd
 
-    def paddle_op(*args, name_=None):
-        tensors = [ensure_tensor(a) for a in args]
-        return call_op(op, tensors, op_name=name)
+    op_name = name
 
-    paddle_op.__name__ = name
+    def paddle_op(*args, name=None):
+        tensors = [ensure_tensor(a) for a in args]
+        return call_op(op, tensors, op_name=op_name)
+
+    paddle_op.__name__ = op_name
     setattr(ops, name, paddle_op)
     return paddle_op
 

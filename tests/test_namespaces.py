@@ -399,6 +399,40 @@ def test_qat_quantize_convert(rng):
     np.testing.assert_allclose(w / step, np.round(w / step), atol=1e-3)
 
 
+def test_ptq_observer_flow(rng):
+    """PTQ: observer calibration pass then convert (the observers must be
+    callable inside the wrapped layers)."""
+    from paddle_tpu.quantization import AbsmaxObserver, PTQ, QuantConfig
+    paddle.seed(1)
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 3))
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver))
+    qnet = ptq.quantize(net)
+    x = Tensor(rng.randn(16, 6).astype("float32"))
+    qnet(x)  # calibration pass observes activations and weights
+    final = ptq.convert(qnet)
+    w = final._sub_layers["0"].weight.numpy()
+    obs_scale = np.abs(w).max()  # after baking, absmax is on the grid
+    step = obs_scale / 127.0
+    np.testing.assert_allclose(w / step, np.round(w / step), atol=1e-2)
+    out = final(x)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_istft_return_complex(rng):
+    """two-sided complex round trip keeps the imaginary part."""
+    z = (rng.randn(1, 256) + 1j * rng.randn(1, 256)).astype("complex64")
+    spec = paddle.signal.stft(Tensor(z.real.astype("float32")), n_fft=32,
+                              hop_length=8, onesided=False)
+    back = paddle.signal.istft(spec, n_fft=32, hop_length=8,
+                               onesided=False, return_complex=True,
+                               length=256)
+    assert "complex" in str(back.numpy().dtype)
+    with pytest.raises(ValueError):
+        paddle.signal.istft(spec, n_fft=32, onesided=True,
+                            return_complex=True)
+
+
 # ---------------------------------------------------------------------------
 # utils / version / onnx
 # ---------------------------------------------------------------------------
