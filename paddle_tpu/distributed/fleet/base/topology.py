@@ -23,8 +23,8 @@ class CommunicateTopology:
     """ref: topology.py CommunicateTopology — the cartesian rank grid."""
 
     def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe",
-                 "sharding", "sep", "model"),
-                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+                 "sharding", "sep", "expert", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1, 1, 1)):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(int(d) for d in dims)
         self._world_size = int(np.prod(self._dims))
@@ -84,9 +84,11 @@ class CommunicateTopology:
         return sorted(out)
 
 
-# mesh axis name per reference parallel name
+# mesh axis name per reference parallel name.  ``expert`` (ep) sits
+# between sep and mp: inner enough that the MoE all-to-all rides short
+# ICI hops, but outside mp so tp collectives keep the innermost links.
 _AXIS_OF = {"data": "dp", "pipe": "pp", "sharding": "sharding",
-            "sep": "sep", "model": "mp"}
+            "sep": "sep", "expert": "ep", "model": "mp"}
 
 
 class HybridCommunicateGroup:
@@ -107,6 +109,8 @@ class HybridCommunicateGroup:
         self._sharding_degree = topology.get_dim("sharding")
         self._sep_degree = topology.get_dim("sep") if "sep" in \
             topology.get_hybrid_group_names() else 1
+        self._ep_degree = topology.get_dim("expert") if "expert" in \
+            topology.get_hybrid_group_names() else 1
         self._mp_degree = topology.get_dim("model")
 
         # build + install the global mesh over ALL devices (single- and
@@ -122,6 +126,7 @@ class HybridCommunicateGroup:
         self._pp_rank = coord.pipe
         self._sharding_rank = coord.sharding
         self._sep_rank = getattr(coord, "sep", 0)
+        self._ep_rank = getattr(coord, "expert", 0)
         self._mp_rank = coord.model
 
         gr = self.global_rank if self.global_rank < self.nranks else 0
@@ -138,6 +143,10 @@ class HybridCommunicateGroup:
                                           ranks=_ranks(["sharding"]))
         self._sep_group = axis_group("sep", self._mesh, name="sep",
                                      ranks=_ranks(["sep"]))
+        has_ep = "expert" in topology.get_hybrid_group_names()
+        self._ep_group = axis_group("ep", self._mesh, name="ep",
+                                    ranks=_ranks(["expert"])) \
+            if has_ep else None
         self._mp_group = axis_group("mp", self._mesh, name="mp",
                                     ranks=_ranks(["model"]))
         # check group: fused dp+sharding+pp (ref: get_check_parallel_group)
@@ -239,6 +248,16 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self) -> Group:
         return self._sep_group
+
+    # --- expert parallel (MoE) -----------------------------------------
+    def get_expert_parallel_rank(self) -> int:
+        return self._ep_rank
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self._ep_degree
+
+    def get_expert_parallel_group(self) -> Group:
+        return self._ep_group
 
     # --- fused groups ---------------------------------------------------
     def get_check_parallel_group(self, sharding: bool = False) -> Group:

@@ -41,7 +41,9 @@ class Fleet:
         n_dev = len(jax.devices())
         degrees = {"data": hc["dp_degree"], "pipe": hc["pp_degree"],
                    "sharding": hc["sharding_degree"],
-                   "sep": hc["sep_degree"], "model": hc["mp_degree"]}
+                   "sep": hc["sep_degree"],
+                   "expert": hc.get("ep_degree", 1) or 1,
+                   "model": hc["mp_degree"]}
         # -1 / auto dp degree absorbs the remainder of the device grid
         known = 1
         for k, v in degrees.items():
@@ -51,9 +53,9 @@ class Fleet:
             degrees["data"] = max(n_dev // known, 1)
             hc["dp_degree"] = degrees["data"]
         topo = CommunicateTopology(
-            ["data", "pipe", "sharding", "sep", "model"],
+            ["data", "pipe", "sharding", "sep", "expert", "model"],
             [degrees["data"], degrees["pipe"], degrees["sharding"],
-             degrees["sep"], degrees["model"]])
+             degrees["sep"], degrees["expert"], degrees["model"]])
         self._hcg = HybridCommunicateGroup(topo)
         _set_hcg(self._hcg)
         _mark_initialized()

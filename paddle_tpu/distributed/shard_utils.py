@@ -60,6 +60,57 @@ def largest_dim_spec(shape, axis: str, degree: int):
     return None
 
 
+def _is_staged(v) -> bool:
+    """True iff `v` is (or wraps, through JVP/batch tracer levels) a
+    jaxpr-staging tracer — i.e. we are inside a jit/pjit trace rather
+    than an eagerly-executing vjp/vmap over concrete arrays."""
+    try:
+        from jax._src.interpreters.partial_eval import DynamicJaxprTracer
+    except ImportError:  # jax internals moved: conservatively say staged
+        return isinstance(v, jax.core.Tracer)
+    seen = set()
+    while isinstance(v, jax.core.Tracer):
+        if isinstance(v, DynamicJaxprTracer):
+            return True
+        nxt = getattr(v, "primal", None)
+        if nxt is None:
+            nxt = getattr(v, "val", None)
+        if nxt is None or id(nxt) in seen:
+            return False
+        seen.add(id(nxt))
+        v = nxt
+    return False
+
+
+def _constrain(v, sh):
+    """Apply a sharding constraint where it has meaning.
+
+    - under a STAGING trace (jit/pjit): a hard GSPMD constraint — THE
+      mechanism that partitions compute/storage across the mesh;
+    - eagerly (including the tape's eager vjp/vmap, whose primitives
+      execute immediately over concrete arrays): identity.  Eager arrays
+      are global values — committing them to the mesh buys nothing and
+      poisons later ops, because jax refuses to mix arrays committed to
+      different device sets (e.g. the step engine pins the RNG key to
+      device 0, committing everything derived from it)."""
+    if _is_staged(v):
+        return jax.lax.with_sharding_constraint(v, sh)
+    return v
+
+
+def mesh_replicated(x: Tensor) -> Tensor:
+    """Replication constraint on the CURRENT mesh (jit-time semantics;
+    eager identity — see _constrain).  No-op without a mesh."""
+    mesh = get_mesh()
+    if mesh is None or len(mesh.devices.ravel()) == 1:
+        return x
+    if any(in_axis_scope(a) for a in mesh.axis_names):
+        return x
+    sh = NamedSharding(mesh, PartitionSpec())
+    return call_op(lambda v: _constrain(v, sh), (x,),
+                   op_name="mesh_replicated")
+
+
 def sharding_constraint(x: Tensor, *spec) -> Tensor:
     """Constrain an activation's sharding (no-op when there is no mesh, the
     named axes are trivial, or we're inside shard_map explicit SPMD)."""
@@ -72,8 +123,5 @@ def sharding_constraint(x: Tensor, *spec) -> Tensor:
     if any(in_axis_scope(a) for a in names):
         return x  # explicit-mode code owns its collectives
     sh = NamedSharding(mesh, PartitionSpec(*spec))
-
-    def fn(v):
-        return jax.lax.with_sharding_constraint(v, sh)
-
-    return call_op(fn, (x,), op_name="sharding_constraint")
+    return call_op(lambda v: _constrain(v, sh), (x,),
+                   op_name="sharding_constraint")

@@ -34,36 +34,36 @@ def _check_norm(norm):
     return norm or "backward"
 
 
-def _make_1d(name, jfn):
+def _make_1d(op_name, jfn):
     def fn(x, n=None, axis=-1, norm="backward", name=None):
         nrm = _check_norm(norm)
         x = ensure_tensor(x)
         return call_op(lambda a: jfn(a, n=n, axis=axis, norm=nrm), [x],
-                       op_name=name)
-    fn.__name__ = name
-    fn.__doc__ = f"ref: paddle.fft.{name} — lowers to jnp.fft.{name}."
+                       op_name=op_name)
+    fn.__name__ = op_name
+    fn.__doc__ = f"ref: paddle.fft.{op_name} — lowers to jnp.fft.{op_name}."
     return fn
 
 
-def _make_2d(name, jfn):
+def _make_2d(op_name, jfn):
     def fn(x, s=None, axes=(-2, -1), norm="backward", name=None):
         nrm = _check_norm(norm)
         x = ensure_tensor(x)
         return call_op(lambda a: jfn(a, s=s, axes=axes, norm=nrm), [x],
-                       op_name=name)
-    fn.__name__ = name
-    fn.__doc__ = f"ref: paddle.fft.{name} — lowers to jnp.fft.{name}."
+                       op_name=op_name)
+    fn.__name__ = op_name
+    fn.__doc__ = f"ref: paddle.fft.{op_name} — lowers to jnp.fft.{op_name}."
     return fn
 
 
-def _make_nd(name, jfn):
+def _make_nd(op_name, jfn):
     def fn(x, s=None, axes=None, norm="backward", name=None):
         nrm = _check_norm(norm)
         x = ensure_tensor(x)
         return call_op(lambda a: jfn(a, s=s, axes=axes, norm=nrm), [x],
-                       op_name=name)
-    fn.__name__ = name
-    fn.__doc__ = f"ref: paddle.fft.{name} — lowers to jnp.fft.{name}."
+                       op_name=op_name)
+    fn.__name__ = op_name
+    fn.__doc__ = f"ref: paddle.fft.{op_name} — lowers to jnp.fft.{op_name}."
     return fn
 
 

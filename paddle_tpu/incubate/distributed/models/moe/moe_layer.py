@@ -137,6 +137,47 @@ class MoELayer(Layer):
                 da = p._dist_attr or {}
                 da["expert_index"] = i
                 p._dist_attr = da
+        # expert structure is fixed at construction — decide the
+        # vectorized-vs-loop path once, not on every forward
+        self._experts_stackable = self._check_stackable()
+        # stochastic sublayers draw ONE rng key at trace level, so under
+        # vmap every expert lane would get the same dropout mask — those
+        # experts must take the loop path while training
+        self._experts_stochastic = any(
+            "Dropout" in type(l).__name__
+            for e in self.experts for l in e.sublayers(include_self=True))
+
+    def _check_stackable(self) -> bool:
+        """True iff vmapping expert[0] over stacked params computes every
+        expert correctly: identical sublayer-type chains, identical scalar
+        hyperparameters (dropout p, eps, ...), identical param shapes, and
+        NO buffers (vmapped writes into running stats would corrupt
+        expert[0]'s state)."""
+        plists = [list(e.parameters()) for e in self.experts]
+        n = len(plists[0])
+
+        def _structure(e):
+            sig = []
+            for l in e.sublayers(include_self=True):
+                attrs = tuple(sorted(
+                    (k, v) for k, v in vars(l).items()
+                    if not k.startswith("_")
+                    and isinstance(v, (int, float, bool, str))))
+                sig.append((type(l).__name__, attrs))
+            return tuple(sig)
+
+        sig0 = _structure(self.experts[0])
+        if n == 0 or any(_structure(e) != sig0 for e in self.experts):
+            return False
+        if any(len(pl) != n for pl in plists):
+            return False
+        if any(pl[i].shape != plists[0][i].shape
+               or pl[i].dtype != plists[0][i].dtype
+               for pl in plists for i in range(n)):
+            return False
+        if any(len(list(e.buffers())) > 0 for e in self.experts):
+            return False
+        return True
 
     def forward(self, x):
         orig_shape = x.shape
@@ -172,20 +213,74 @@ class MoELayer(Layer):
         disp, comb = call_op(route, (logits,), {}, multi_out=True,
                              op_name="moe_route")
 
+        # place routing tensors on the mesh (tokens replicated) so the
+        # dispatch/combine einsums mix cleanly with ep-sharded operands
+        from .....distributed.shard_utils import mesh_replicated
+        disp = mesh_replicated(disp)
+        comb = mesh_replicated(comb)
+        xf = mesh_replicated(xf)
+
         # dispatch: [T,E,C] x [T,M] -> [E,C,M]  (GSPMD lowers to a2a on ep)
         expert_in = paddle.einsum("tec,tm->ecm", disp, xf)
         expert_in = sharding_constraint(expert_in, "ep", None, None)
 
-        outs = []
-        for i, expert in enumerate(self.experts):
-            outs.append(expert(expert_in[i]))
-        expert_out = paddle.stack(outs, axis=0)       # [E, C, M]
+        expert_out = self._apply_experts(expert_in)   # [E, C, M]
         expert_out = sharding_constraint(expert_out, "ep", None, None)
 
         # combine: weighted return to token order
         yf = paddle.einsum("ecm,tec->tm", expert_out,
                            comb.astype(expert_out.dtype))
         return yf.reshape(orig_shape)
+
+    def _apply_experts(self, expert_in):
+        """Run all experts on their [C, M] rows — vectorized.
+
+        REAL expert parallelism: corresponding parameters of the E
+        experts are stacked into [E, ...] tensors constrained to the
+        ``ep`` mesh axis, and one expert's forward is vmapped over that
+        axis — GSPMD then partitions expert compute AND weights across
+        the ep group (the reference's per-rank expert placement).  The
+        per-expert python loop (which replicates every expert's compute
+        on every device) remains only as a fallback for heterogeneous
+        expert stacks."""
+        use_loop = (not self._experts_stackable
+                    or (self.training and self._experts_stochastic))
+        if use_loop:
+            if not self._experts_stackable:
+                import warnings
+                warnings.warn(
+                    "MoELayer: heterogeneous (or buffer-carrying) experts "
+                    "cannot be stacked — falling back to replicated "
+                    "per-expert loop (no ep sharding of expert compute)",
+                    RuntimeWarning)
+            # (stochastic experts in training take the loop so each
+            # expert draws its own dropout key; eval vmaps)
+            outs = [expert(expert_in[i])
+                    for i, expert in enumerate(self.experts)]
+            return paddle.stack(outs, axis=0)
+
+        plists = [list(e.parameters()) for e in self.experts]
+        n = len(plists[0])
+        stacked = [paddle.stack([pl[i] for pl in plists], axis=0)
+                   for i in range(n)]                  # each [E, ...]
+        stacked = [sharding_constraint(s, "ep") for s in stacked]
+        exp0 = self.experts[0]
+        p0 = plists[0]
+
+        def vf(x_arr, *param_arrays):
+            def one(xa, *pa):
+                saved = [p._data for p in p0]
+                for p, v in zip(p0, pa):
+                    p._data = v
+                try:
+                    return exp0(Tensor(xa))._data
+                finally:
+                    for p, v in zip(p0, saved):
+                        p._data = v
+            return jax.vmap(one)(x_arr, *param_arrays)
+
+        return call_op(vf, [expert_in] + stacked, {},
+                       op_name="moe_experts")
 
 
 class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):

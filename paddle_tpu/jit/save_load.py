@@ -53,7 +53,10 @@ def _example_arrays(input_spec):
                 expr = ",".join(dim_str(s, i) for i, s in enumerate(shape))
                 if scope is None:
                     sym = jexport.symbolic_shape(expr)
-                    scope = sym[0].scope if hasattr(sym[0], "scope") else None
+                    # harvest the scope from the first SYMBOLIC dim (a
+                    # static leading dim is a plain int with no .scope)
+                    scope = next((s.scope for s in sym
+                                  if hasattr(s, "scope")), None)
                 else:
                     sym = jexport.symbolic_shape(expr, scope=scope)
                 arrays.append(jax.ShapeDtypeStruct(

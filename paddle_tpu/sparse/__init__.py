@@ -198,7 +198,10 @@ def _union_add(x, y, y_scale=1.0):
     a, b = _coo(x), _coo(y)
     if a.shape != b.shape:
         raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
-    data = jnp.concatenate([a.data, b.data * y_scale])
+    # dtype-preserving: scaling by a python float would promote int data
+    b_data = -b.data if y_scale == -1.0 else (
+        b.data if y_scale == 1.0 else b.data * y_scale)
+    data = jnp.concatenate([a.data, b_data])
     indices = jnp.concatenate([a.indices, b.indices], axis=0)
     return jsparse.BCOO((data, indices),
                         shape=a.shape).sum_duplicates()
@@ -226,6 +229,11 @@ def multiply(x, y, name=None):
         return _rewrap(jsparse.BCOO.fromdense(a.todense()
                                               * _coo(y).todense()), x)
     yd = ensure_tensor(y)._data
+    if tuple(yd.shape) != tuple(a.shape):
+        raise ValueError(
+            f"sparse.multiply: dense operand shape {tuple(yd.shape)} must "
+            f"match the sparse tensor's {tuple(a.shape)} (jax gathers "
+            f"clamp out-of-bounds indices, which would be silently wrong)")
     vals = a.data * yd[tuple(a.indices[:, i]
                              for i in range(a.indices.shape[1]))]
     return _rewrap(jsparse.BCOO((vals, a.indices), shape=a.shape), x)
@@ -238,6 +246,10 @@ def divide(x, y, name=None):
         return _rewrap(jsparse.BCOO.fromdense(a.todense()
                                               / _coo(y).todense()), x)
     yd = ensure_tensor(y)._data
+    if tuple(yd.shape) != tuple(a.shape):
+        raise ValueError(
+            f"sparse.divide: dense operand shape {tuple(yd.shape)} must "
+            f"match the sparse tensor's {tuple(a.shape)}")
     vals = a.data / yd[tuple(a.indices[:, i]
                              for i in range(a.indices.shape[1]))]
     return _rewrap(jsparse.BCOO((vals, a.indices), shape=a.shape), x)
