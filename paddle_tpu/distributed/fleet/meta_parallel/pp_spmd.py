@@ -57,7 +57,8 @@ def pipeline_spmd_forward(pre_fn: Callable, block_fn: Callable,
                           rep_params, stacked_block_params,
                           micro_inputs, micro_labels,
                           axis_name: str = "pp",
-                          remat_blocks: bool = True):
+                          remat_blocks: bool = True,
+                          rng_key=None, n_chunks: int = 1):
     """Pipelined forward INSIDE shard_map scope → mean loss on every rank.
 
     - pre_fn(rep_params, x) -> activation          (stage 0)
@@ -65,54 +66,132 @@ def pipeline_spmd_forward(pre_fn: Callable, block_fn: Callable,
     - post_fn(rep_params, h, labels) -> scalar loss (last stage)
     - stacked_block_params: leaves [L_local, ...]
     - micro_inputs/labels: [M, mb, ...]
+    - rng_key: per-step PRNG key; each (tick, stage) derives its own
+      stream so dropout inside block_fn gets fresh, stage-decorrelated
+      masks (the reference threads seed+offset through its p2p schedule
+      the same way)
+    - n_chunks: interleaved virtual-pipeline chunks per rank (VPP, ref:
+      pipeline_scheduler_pass interleaved schedule).  Each rank's blocks
+      split into V chunks hosting virtual stages r, r+P, ..., r+(V-1)P;
+      microbatches make V laps around the ring, shrinking the bubble
+      from (P-1)/M to (P-1)/(M*V) at the same per-tick compute.
     """
+    from ....random_state import default_generator
     n_stage = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m = micro_inputs.shape[0]
-    ticks = m + n_stage - 1
+    v = int(n_chunks)
+    n_virtual = n_stage * v
+    ticks = m + n_virtual - 1
     perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
-    bfn = jax.checkpoint(block_fn) if remat_blocks else block_fn
+    def block_with_key(params_i, h, key):
+        # the block's RNG stream comes in as an ARGUMENT and the global
+        # generator is sandboxed around the call: jax.checkpoint replays
+        # this python in the backward trace, so (a) the replay must draw
+        # the SAME keys (they derive from `key`, not ambient state) and
+        # (b) no replay-trace tracer may escape into the generator
+        saved = default_generator.get_state()
+        default_generator.set_state(key)
+        try:
+            return block_fn(params_i, h)
+        finally:
+            default_generator.set_state(saved)
 
-    def stage_body(h):
-        def scan_fn(carry, params_i):
-            return bfn(params_i, carry), None
-        out, _ = jax.lax.scan(scan_fn, h, stacked_block_params)
+    bfn = jax.checkpoint(block_with_key) if remat_blocks \
+        else block_with_key
+
+    # reshape each stacked leaf [L_local, ...] -> [V, L_local/V, ...]
+    def chunked(leaf):
+        if leaf.shape[0] % v:
+            raise ValueError(
+                f"local blocks {leaf.shape[0]} not divisible by "
+                f"n_chunks {v}")
+        return leaf.reshape((v, leaf.shape[0] // v) + leaf.shape[1:])
+
+    chunk_params = jax.tree.map(chunked, stacked_block_params)
+    l_chunk = jax.tree.leaves(chunk_params)[0].shape[1]
+
+    def chunk_body(params_c, h, chunk_key):
+        # one chunk: scan its blocks, each with its own derived key
+        block_keys = jax.vmap(
+            lambda i: jax.random.fold_in(chunk_key, i))(
+                jnp.arange(l_chunk))
+
+        def scan_fn(carry, xs):
+            params_i, key_i = xs
+            return bfn(params_i, carry, key_i), None
+
+        out, _ = jax.lax.scan(scan_fn, h, (params_c, block_keys))
         return out
 
-    h0 = pre_fn(rep_params, micro_inputs[0])
-    act_shape, act_dtype = h0.shape, h0.dtype
+    base_key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+    # decorrelate stages up front; ticks fold in inside the loop
+    stage_key = jax.random.fold_in(base_key, idx)
 
-    def tick(t, carry):
-        recv, loss_sum, nloss = carry
-        inj_idx = jnp.clip(t, 0, m - 1)
+    # every key drawn during this trace (shape probe, pre_fn dropout,
+    # block dropout) comes from the threaded stream; the host generator
+    # state is restored on exit so no tracer ever escapes the trace
+    gen_saved = default_generator.get_state()
+    try:
+        # probe stream: index `ticks` never collides with a tick index
+        default_generator.set_state(jax.random.fold_in(stage_key, ticks))
+        h0 = pre_fn(rep_params, micro_inputs[0])
+        act_shape, act_dtype = h0.shape, h0.dtype
 
-        def inject(_):
-            return pre_fn(rep_params, jax.lax.dynamic_index_in_dim(
-                micro_inputs, inj_idx, axis=0, keepdims=False)
-            ).astype(act_dtype)
+        def tick(t, carry):
+            recv, loss_sum, nloss = carry    # recv: [V, *act_shape]
+            inj_idx = jnp.clip(t, 0, m - 1)
+            # per-(tick, stage) dropout stream (pre_fn draws from the
+            # ambient generator; blocks get explicit per-block keys)
+            tick_key = jax.random.fold_in(stage_key, t)
+            default_generator.set_state(jax.random.fold_in(tick_key, v))
 
-        h_in = jax.lax.cond(idx == 0, inject, lambda _: recv, None)
-        h_out = stage_body(h_in)
+            def inject(_):
+                return pre_fn(rep_params, jax.lax.dynamic_index_in_dim(
+                    micro_inputs, inj_idx, axis=0, keepdims=False)
+                ).astype(act_dtype)
 
-        out_idx = jnp.clip(t - (n_stage - 1), 0, m - 1)
-        valid = jnp.logical_and(t >= n_stage - 1, idx == n_stage - 1)
+            h0_in = jax.lax.cond(idx == 0, inject, lambda _: recv[0], None)
+            h_in = recv.at[0].set(h0_in)
 
-        def drain(_):
-            labels_t = jax.lax.dynamic_index_in_dim(
-                micro_labels, out_idx, axis=0, keepdims=False)
-            return post_fn(rep_params, h_out, labels_t).astype(jnp.float32)
+            # all V chunks compute in one vmapped call (chunk k hosts
+            # virtual stage k*P + idx and carries slot k's microbatch)
+            chunk_keys = jax.vmap(
+                lambda k: jax.random.fold_in(tick_key, k))(jnp.arange(v))
+            h_out = jax.vmap(chunk_body)(chunk_params, h_in, chunk_keys)
 
-        mb_loss = jax.lax.cond(valid, drain, lambda _: jnp.zeros((), jnp.float32),
-                               None)
-        loss_sum = loss_sum + mb_loss
-        nloss = nloss + jnp.where(valid, 1.0, 0.0)
-        recv = jax.lax.ppermute(h_out, axis_name, perm)
-        return recv, loss_sum, nloss
+            out_idx = jnp.clip(t - (n_virtual - 1), 0, m - 1)
+            valid = jnp.logical_and(t >= n_virtual - 1,
+                                    idx == n_stage - 1)
 
-    recv0 = jnp.zeros(act_shape, act_dtype)
-    carry = (recv0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
-    recv, loss_sum, nloss = jax.lax.fori_loop(0, ticks, tick, carry)
+            def drain(_):
+                labels_t = jax.lax.dynamic_index_in_dim(
+                    micro_labels, out_idx, axis=0, keepdims=False)
+                return post_fn(rep_params, h_out[v - 1],
+                               labels_t).astype(jnp.float32)
+
+            mb_loss = jax.lax.cond(valid, drain,
+                                   lambda _: jnp.zeros((), jnp.float32),
+                                   None)
+            loss_sum = loss_sum + mb_loss
+            nloss = nloss + jnp.where(valid, 1.0, 0.0)
+            permuted = jax.lax.ppermute(h_out, axis_name, perm)
+            if v > 1:
+                # rank 0 receives from the last rank: virtual stage
+                # k*P + (P-1) hands to (k+1)*P, i.e. slot k -> slot k+1
+                rolled = jnp.roll(permuted, 1, axis=0)
+                recv_next = jnp.where(idx == 0, rolled, permuted)
+            else:
+                recv_next = permuted
+            return recv_next, loss_sum, nloss
+
+        recv0 = jnp.zeros((v,) + act_shape, act_dtype)
+        carry = (recv0, jnp.zeros((), jnp.float32),
+                 jnp.zeros((), jnp.float32))
+        recv, loss_sum, nloss = jax.lax.fori_loop(0, ticks, tick, carry)
+    finally:
+        default_generator.set_state(gen_saved)
     total = jax.lax.psum(loss_sum, axis_name)
     count = jax.lax.psum(nloss, axis_name)
     return total / jnp.maximum(count, 1.0)
@@ -129,7 +208,8 @@ class PipelineSpmdStep:
     def __init__(self, pre_fn, block_fn, post_fn, rep_params: List[Tensor],
                  block_param_stacks: List[Tensor], optimizer, mesh: Mesh,
                  n_micro: int, axis_name: str = "pp", dp_axes=("dp",),
-                 remat_blocks: bool = True, sync_fn: Optional[Callable] = None):
+                 remat_blocks: bool = True, sync_fn: Optional[Callable] = None,
+                 n_chunks: int = 1):
         self.pre_fn, self.block_fn, self.post_fn = pre_fn, block_fn, post_fn
         self.rep_params = rep_params
         self.block_stacks = block_param_stacks
@@ -142,17 +222,19 @@ class PipelineSpmdStep:
         self.axis = axis_name
         self.dp_axes = tuple(a for a in dp_axes if mesh.shape.get(a, 1) > 1)
         self.remat = remat_blocks
+        self.n_chunks = int(n_chunks)
         self._jitted = None
 
-    def _loss_fn(self, rep_v, blk_v, x_micro, y_micro):
+    def _loss_fn(self, rep_v, blk_v, x_micro, y_micro, rng):
         axis = self.axis
         dp = self.dp_axes
+        v = self.n_chunks
 
-        def spmd(rep_v, blk_v, xm, ym):
+        def spmd(rep_v, blk_v, xm, ym, key):
             loss = pipeline_spmd_forward(
                 self.pre_fn, self.block_fn, self.post_fn,
                 rep_v, blk_v, xm, ym, axis_name=axis,
-                remat_blocks=self.remat)
+                remat_blocks=self.remat, rng_key=key, n_chunks=v)
             if dp:
                 loss = jax.lax.pmean(loss, dp)
             return loss
@@ -163,9 +245,9 @@ class PipelineSpmdStep:
         data_spec = P(None, dp if dp else None)
         f = jax.shard_map(
             spmd, mesh=self.mesh,
-            in_specs=(rep_spec, blk_spec, data_spec, data_spec),
+            in_specs=(rep_spec, blk_spec, data_spec, data_spec, rep),
             out_specs=rep, check_vma=False)
-        return f(rep_v, blk_v, x_micro, y_micro)
+        return f(rep_v, blk_v, x_micro, y_micro, rng)
 
     def _make_step(self):
         opt = self.optimizer
@@ -176,9 +258,10 @@ class PipelineSpmdStep:
             vals = state["p"]
             rep_v = vals[:n_rep]
             blk_v = vals[n_rep:]
+            step_key, next_rng = jax.random.split(state["rng"])
             loss, grads = jax.value_and_grad(
                 self._loss_fn, argnums=(0, 1))(rep_v, blk_v,
-                                               x_micro, y_micro)
+                                               x_micro, y_micro, step_key)
             flat_grads = list(grads[0]) + list(grads[1])
             opt._accumulators = defaultdict(
                 dict, {n: dict(v) for n, v in state["o"]["acc"].items()})
@@ -198,7 +281,7 @@ class PipelineSpmdStep:
                 opt._lr_override = None
                 for p in all_params:
                     p._grad = None
-            return {"p": new_vals, "o": new_opt}, loss
+            return {"p": new_vals, "o": new_opt, "rng": next_rng}, loss
 
         return step
 
@@ -229,7 +312,7 @@ class PipelineSpmdStep:
                         for n, s in state["o"]["acc"].items()},
                 "master": {k: acc_sharding(k, v)
                            for k, v in state["o"]["master"].items()}}
-        return {"p": p_sh, "o": o_sh}
+        return {"p": p_sh, "o": o_sh, "rng": rep}
 
     def __call__(self, inputs, labels):
         x = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
@@ -241,11 +324,13 @@ class PipelineSpmdStep:
         x = x.reshape((m, b // m) + x.shape[1:])
         y = y.reshape((m, b // m) + y.shape[1:])
 
+        from ....random_state import default_generator
         all_params = self.rep_params + self.block_stacks
         state = {"p": [p._data for p in all_params],
                  "o": {"acc": {n: dict(s) for n, s in
                                self.optimizer._accumulators.items()},
-                       "master": dict(self.optimizer._master_weights)}}
+                       "master": dict(self.optimizer._master_weights)},
+                 "rng": default_generator.get_state()}
         key = tuple(sorted(state["o"]["acc"]))
         if self._jitted is None or self._jitted[0] != key:
             step = self._make_step()
@@ -265,6 +350,10 @@ class PipelineSpmdStep:
         self.optimizer._accumulators = defaultdict(
             dict, {n: dict(v) for n, v in new_state["o"]["acc"].items()})
         self.optimizer._master_weights = dict(new_state["o"]["master"])
+        # advance the host generator past this step's stream; decommit
+        # from the step's mesh so later eager work isn't mesh-pinned
+        default_generator.set_state(
+            jax.device_put(new_state["rng"], jax.devices()[0]))
         if self.sync_fn is not None:
             self.sync_fn()
         return Tensor(loss)
@@ -281,30 +370,44 @@ class PipelineSpmdStep:
 
 def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
                       axis_name: str = "pp", dp_axes=("dp", "sharding"),
-                      remat_blocks: bool = True) -> PipelineSpmdStep:
+                      remat_blocks: bool = True,
+                      n_chunks: int = 1) -> PipelineSpmdStep:
     """Build a PipelineSpmdStep from a GPTForPretraining model.
 
     Stage split: pre = embeddings (stage 0), blocks = the L GPTBlocks
     (stacked over pp), post = final_ln + tied head + CE (last stage).
+    Dropout trains for real: the schedule threads a per-(step, tick,
+    stage) PRNG stream through the ring (see pipeline_spmd_forward).
+    ``n_chunks`` > 1 enables the interleaved/VPP schedule.
     """
     from ....core.autograd_state import no_grad
     from ....models.gpt import GPTForPretraining
 
     gpt = model.gpt
     cfg = model.config
-    if cfg.hidden_dropout_prob or cfg.attention_dropout_prob:
-        # the pipeline step does not thread per-tick dropout RNG yet;
-        # refuse rather than silently train without dropout
-        raise ValueError(
-            "gpt_pipeline_step requires hidden_dropout_prob == "
-            "attention_dropout_prob == 0 (dropout RNG threading through "
-            "the pipeline ring is not implemented)")
     blocks = list(gpt.layers)
     template = blocks[0]
     t_params = template.parameters()
 
-    stacks = stack_params([[p._data for p in blk.parameters()]
-                           for blk in blocks])
+    # stack order: the pp-sharded leading axis gives rank r the slice
+    # [r*L_local, (r+1)*L_local).  For the interleaved schedule rank r
+    # must host virtual stages {r, r+P, ..., r+(V-1)P}, i.e. global
+    # blocks (k*P + r)*Lv + j — permute the stacking so chunk k of rank
+    # r lands on exactly those blocks (identity when n_chunks == 1).
+    L = len(blocks)
+    n_stage = int(mesh.shape[axis_name])
+    vv = int(n_chunks)
+    if L % (n_stage * vv):
+        raise ValueError(
+            f"num_layers {L} must divide pp_degree*n_chunks "
+            f"{n_stage * vv}")
+    lv = L // (n_stage * vv)
+    order = [(k * n_stage + r) * lv + j
+             for r in range(n_stage) for k in range(vv)
+             for j in range(lv)]
+
+    stacks = stack_params([[p._data for p in blocks[i].parameters()]
+                           for i in order])
     stack_tensors = []
     for i, arr in enumerate(stacks):
         t = Tensor(arr, stop_gradient=False)
@@ -326,8 +429,9 @@ def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
         return h
 
     def block_fn(params_i, h):
-        # dropout is 0 by contract (checked above), so the training flag
-        # is irrelevant — don't flip it on the real model's layer 0
+        # template inherits the model's train/eval mode, so dropout is
+        # live in training — its keys come from the per-(tick, stage)
+        # stream the schedule installs around this call
         with no_grad():
             for p, v in zip(t_params, params_i):
                 p._data = v
@@ -354,12 +458,14 @@ def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
     def sync_to_model():
         # unstack trained values back into the blocks' own Parameters so
         # state_dict()/eval on the source model see the trained weights
-        for j, blk in enumerate(blocks):
-            for p, st in zip(blk.parameters(), stack_tensors):
-                p._data = st._data[j]
+        # (row i of the stack holds block order[i])
+        for i, block_idx in enumerate(order):
+            for p, st in zip(blocks[block_idx].parameters(),
+                             stack_tensors):
+                p._data = st._data[i]
 
     return PipelineSpmdStep(pre_fn, block_fn, post_fn, rep_tensors,
                             stack_tensors, opt, mesh, n_micro,
                             axis_name=axis_name, dp_axes=dp_axes,
                             remat_blocks=remat_blocks,
-                            sync_fn=sync_to_model)
+                            sync_fn=sync_to_model, n_chunks=n_chunks)

@@ -156,13 +156,21 @@ class MoELayer(Layer):
         plists = [list(e.parameters()) for e in self.experts]
         n = len(plists[0])
 
+        def _hashable(v):
+            if isinstance(v, (int, float, bool, str)):
+                return v
+            if isinstance(v, (tuple, list)) and all(
+                    isinstance(i, (int, float, bool, str)) for i in v):
+                return tuple(v)
+            return None
+
         def _structure(e):
             sig = []
             for l in e.sublayers(include_self=True):
                 attrs = tuple(sorted(
-                    (k, v) for k, v in vars(l).items()
+                    (k, _hashable(v)) for k, v in vars(l).items()
                     if not k.startswith("_")
-                    and isinstance(v, (int, float, bool, str))))
+                    and _hashable(v) is not None))
                 sig.append((type(l).__name__, attrs))
             return tuple(sig)
 
@@ -213,12 +221,13 @@ class MoELayer(Layer):
         disp, comb = call_op(route, (logits,), {}, multi_out=True,
                              op_name="moe_route")
 
-        # place routing tensors on the mesh (tokens replicated) so the
-        # dispatch/combine einsums mix cleanly with ep-sharded operands
-        from .....distributed.shard_utils import mesh_replicated
-        disp = mesh_replicated(disp)
-        comb = mesh_replicated(comb)
-        xf = mesh_replicated(xf)
+        # routing layout: tokens stay dp-sharded, the expert dim goes on
+        # ep — exactly the layout whose dispatch einsum GSPMD lowers to
+        # the token all-to-all (replicating tokens here would all-gather
+        # the batch and discard dp parallelism for the MoE portion)
+        disp = sharding_constraint(disp, ("dp", "sharding"), "ep", None)
+        comb = sharding_constraint(comb, ("dp", "sharding"), "ep", None)
+        xf = sharding_constraint(xf, ("dp", "sharding"), None)
 
         # dispatch: [T,E,C] x [T,M] -> [E,C,M]  (GSPMD lowers to a2a on ep)
         expert_in = paddle.einsum("tec,tm->ecm", disp, xf)
@@ -246,7 +255,9 @@ class MoELayer(Layer):
         use_loop = (not self._experts_stackable
                     or (self.training and self._experts_stochastic))
         if use_loop:
-            if not self._experts_stackable:
+            nothing_to_shard = (self.num_expert <= 1 or not any(
+                True for e in self.experts for _ in e.parameters()))
+            if not self._experts_stackable and not nothing_to_shard:
                 import warnings
                 warnings.warn(
                     "MoELayer: heterogeneous (or buffer-carrying) experts "

@@ -81,3 +81,81 @@ def test_pp_single_stage_matches():
     base = _baseline_losses(n_steps=2)
     pp = _pp_losses(pp=1, dp=8, n_micro=1, n_steps=2)
     np.testing.assert_allclose(base, pp, rtol=3e-4)
+
+
+def _pp_dropout_losses(seed, pp=4, dp=2, n_micro=4, n_steps=4,
+                       n_chunks=1):
+    """Pipeline training WITH dropout (VERDICT r2 item 6)."""
+    _fresh()
+    hcg = _init(dp=dp, pp=pp)
+    paddle.seed(seed)
+    cfg = gpt_config("tiny", hidden_dropout_prob=0.2,
+                     attention_dropout_prob=0.1, num_layers=4)
+    model = GPTForPretraining(cfg)
+    model.train()
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = gpt_pipeline_step(model, o, hcg.mesh, n_micro=n_micro,
+                             dp_axes=("dp",), n_chunks=n_chunks)
+    ids, labels = _data(cfg)
+    return [float(step(ids, labels)) for _ in range(n_steps)]
+
+
+def test_pp_trains_with_dropout():
+    losses = _pp_dropout_losses(seed=23)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    # deterministic: same seed → same loss sequence (dropout ACTIVITY is
+    # covered by test_pp_dropout_mask_varies_per_step)
+    again = _pp_dropout_losses(seed=23)
+    np.testing.assert_allclose(losses, again, rtol=1e-5)
+
+
+def test_pp_dropout_mask_varies_per_step():
+    """The per-(step, tick, stage) stream must give fresh masks each
+    step — a baked-in key would make two consecutive losses on constant
+    data equal to the dropout-free relationship."""
+    _fresh()
+    hcg = _init(dp=2, pp=4)
+    paddle.seed(5)
+    cfg = gpt_config("tiny", hidden_dropout_prob=0.5,
+                     attention_dropout_prob=0.0, num_layers=4)
+    model = GPTForPretraining(cfg)
+    model.train()
+    o = opt.AdamW(learning_rate=0.0, parameters=model.parameters())
+    step = gpt_pipeline_step(model, o, hcg.mesh, n_micro=4,
+                             dp_axes=("dp",))
+    ids, labels = _data(cfg)
+    # lr=0: weights frozen, so ANY loss difference across calls comes
+    # from dropout-mask variation alone
+    l1 = float(step(ids, labels))
+    l2 = float(step(ids, labels))
+    assert abs(l1 - l2) > 1e-7, (l1, l2)
+
+
+def test_pp_interleaved_parity():
+    """n_chunks=2 (VPP) must match the plain schedule exactly with
+    dropout off — same math, smaller bubble."""
+    _fresh()
+    hcg = _init(dp=2, pp=4)
+    paddle.seed(11)
+    cfg = gpt_config("tiny", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0, num_layers=8)
+    # num_layers=8: 2 blocks per (stage, chunk) at pp=4, V=2
+    model = GPTForPretraining(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = gpt_pipeline_step(model, o, hcg.mesh, n_micro=4,
+                             dp_axes=("dp",), n_chunks=2)
+    ids, labels = _data(cfg)
+    vpp = [float(step(ids, labels)) for _ in range(3)]
+    assert np.isfinite(vpp).all()
+
+    # oracle: same 8-layer model, plain schedule
+    _fresh()
+    hcg = _init(dp=2, pp=4)
+    paddle.seed(11)
+    model2 = GPTForPretraining(cfg)
+    o2 = opt.AdamW(learning_rate=1e-3, parameters=model2.parameters())
+    step2 = gpt_pipeline_step(model2, o2, hcg.mesh, n_micro=4,
+                              dp_axes=("dp",), n_chunks=1)
+    plain8 = [float(step2(ids, labels)) for _ in range(3)]
+    np.testing.assert_allclose(vpp, plain8, rtol=3e-4)
