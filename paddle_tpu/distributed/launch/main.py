@@ -44,30 +44,71 @@ def launch(script: str, script_args: Optional[List[str]] = None,
            nnodes: int = 1, rank: Optional[int] = None,
            master: Optional[str] = None, devices: Optional[str] = None,
            log_dir: str = "log", max_restart: int = 3,
-           run_mode: str = "collective") -> int:
-    """Programmatic entry (ref: launch/main.py launch)."""
+           run_mode: str = "collective",
+           elastic_timeout: Optional[float] = None) -> int:
+    """Programmatic entry (ref: launch/main.py launch).
+
+    Supervision (ref: fleet/elastic/manager.py wired into launch): the
+    worker is watched for BOTH crash (nonzero exit — e.g. SIGKILL on
+    host loss) and hang (live pid whose elastic heartbeat went stale).
+    Either triggers kill + re-exec up to ``max_restart`` times; the
+    training script resumes from its latest checkpoint.  Heartbeats are
+    opt-in from the script via fleet.elastic.worker_heartbeat(); without
+    one, supervision degrades to exit-code watching.
+    """
+    from ..fleet.elastic import (ElasticManager, ElasticStatus,
+                                 LauncherInterface)
     ns = argparse.Namespace(nnodes=nnodes, rank=rank, master=master,
                             devices=devices)
     env = _build_env(ns)
     os.makedirs(log_dir, exist_ok=True)
     cmd = [sys.executable, "-u", script] + list(script_args or [])
+    local_rank = int(env["PADDLE_TRAINER_ID"])
+    # a per-invocation job id isolates concurrent jobs' registries unless
+    # the caller provides one (multi-node jobs set PADDLE_ELASTIC_JOB_ID
+    # or a shared PADDLE_ELASTIC_REGISTRY themselves)
+    if not os.environ.get("PADDLE_ELASTIC_REGISTRY") and \
+            not os.environ.get("PADDLE_ELASTIC_JOB_ID"):
+        env["PADDLE_ELASTIC_JOB_ID"] = f"{os.getpid()}_{int(time.time())}"
+        os.environ["PADDLE_ELASTIC_JOB_ID"] = env["PADDLE_ELASTIC_JOB_ID"]
+    # this launcher supervises its OWN rank; peers run their own loop
+    manager = ElasticManager(ranks=[local_rank])
+    if elastic_timeout is not None:
+        manager.heartbeat_timeout = float(elastic_timeout)
+    env.setdefault("PADDLE_ELASTIC_REGISTRY", manager.registry)
     restarts = 0
+    code = 1
     while True:
-        log_path = os.path.join(
-            log_dir, f"workerlog.{env['PADDLE_TRAINER_ID']}")
-        with open(log_path, "ab") as logf:
-            proc = subprocess.Popen(cmd, env=env, stdout=logf,
-                                    stderr=subprocess.STDOUT)
-            code = proc.wait()
-        if code == 0:
+        manager.reset()
+        launcher = LauncherInterface()
+        manager.launcher = launcher
+        log_path = os.path.join(log_dir, f"workerlog.{local_rank}")
+        launcher.launch(cmd, env, log_path)
+        stalled = False
+        while True:
+            exit_status = launcher.watch()
+            if exit_status is not None:
+                code = launcher.procs[0].poll() if launcher.procs else 1
+                break
+            if manager.enabled() and \
+                    manager.watch() == ElasticStatus.RESTART:
+                # live pid, stale heartbeat: stalled — kill and restart
+                stalled = True
+                launcher.stop()
+                code = 1
+                break
+            time.sleep(0.2)
+        launcher.stop()
+        if code == 0 and not stalled:
             return 0
         restarts += 1
         if restarts > max_restart:
-            return code
-        # elastic restart-from-checkpoint loop (SURVEY.md §5 failure
-        # detection): the training script is expected to resume from its
-        # latest checkpoint on re-exec
-        time.sleep(min(10 * restarts, 60))
+            return code if code else 1
+        # elastic restart-from-checkpoint (SURVEY.md §5 failure
+        # detection): the script is expected to resume from its latest
+        # checkpoint on re-exec
+        time.sleep(min(float(os.environ.get(
+            "PADDLE_ELASTIC_RESTART_BACKOFF", 10)) * restarts, 60))
 
 
 def main(argv: Optional[List[str]] = None) -> int:

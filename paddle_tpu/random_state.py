@@ -16,9 +16,20 @@ import numpy as np
 
 
 class Generator:
+    """The key is created LAZILY on first use: jax.random.PRNGKey
+    initializes the jax backend, and the module-level default generator
+    must not do that at import time — `import paddle_tpu` has to succeed
+    (and stay cheap) even when the accelerator stack is broken or hung."""
+
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(int(seed))
+        self._key = None
+
+    @property
+    def _state(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int) -> "Generator":
         self._seed = int(seed)
@@ -29,18 +40,18 @@ class Generator:
         return self._seed
 
     def next_key(self):
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self._state)
         return sub
 
     def get_state(self):
-        return self._key
+        return self._state
 
     def set_state(self, state):
         self._key = state
 
     def split_off(self, n: int):
         """Derive n independent keys, advancing state once."""
-        keys = jax.random.split(self._key, n + 1)
+        keys = jax.random.split(self._state, n + 1)
         self._key = keys[0]
         return keys[1:]
 
