@@ -59,15 +59,22 @@ class _HeartbeatThread(threading.Thread):
         self.interval = interval
         self.progress = progress
         self._last_ping = time.time()
+        self._pinged = False
         self._stop = threading.Event()
 
     def ping(self):
         """Mark training progress (each completed step)."""
+        self._pinged = True
         self._last_ping = time.time()
 
     def run(self):
         while not self._stop.is_set():
-            ts = self._last_ping if self.progress else time.time()
+            # progress mode reports wall time until the FIRST ping: the
+            # first step's XLA compile / checkpoint load can take far
+            # longer than any sane stall timeout, and killing a worker
+            # mid-compile would loop forever
+            live = (not self.progress) or (not self._pinged)
+            ts = time.time() if live else self._last_ping
             tmp = self.path + f".tmp{os.getpid()}"
             try:
                 with open(tmp, "w") as f:

@@ -365,35 +365,34 @@ class PipelineSpmdStep:
 
 
 # ---------------------------------------------------------------------------
-# GPT adapter — pipeline step for the flagship model
+# Generic adapter: homogeneous-block transformer → PipelineSpmdStep
 # ---------------------------------------------------------------------------
 
-def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
-                      axis_name: str = "pp", dp_axes=("dp", "sharding"),
-                      remat_blocks: bool = True,
-                      n_chunks: int = 1) -> PipelineSpmdStep:
-    """Build a PipelineSpmdStep from a GPTForPretraining model.
+def make_transformer_pipeline_step(blocks, rep_tensors, pre_fn, post_fn,
+                                   optimizer, mesh: Mesh, n_micro: int,
+                                   axis_name: str = "pp",
+                                   dp_axes=("dp", "sharding"),
+                                   remat_blocks: bool = True,
+                                   n_chunks: int = 1,
+                                   stack_prefix: str = "pp_stack"):
+    """Shared builder for model-family pipeline adapters (GPT/LLaMA/...).
 
-    Stage split: pre = embeddings (stage 0), blocks = the L GPTBlocks
-    (stacked over pp), post = final_ln + tied head + CE (last stage).
-    Dropout trains for real: the schedule threads a per-(step, tick,
-    stage) PRNG stream through the ring (see pipeline_spmd_forward).
-    ``n_chunks`` > 1 enables the interleaved/VPP schedule.
-    """
+    Owns the parts every adapter must agree on: the interleaved (VPP)
+    stacking permutation, parameter stacking, the template-swap block_fn,
+    optimizer registration, and sync-back of trained stacks into the
+    source blocks.  ``blocks`` must be homogeneous; ``rep_tensors`` are
+    the replicated tails (embeddings/final norm/head) consumed by
+    pre_fn/post_fn."""
     from ....core.autograd_state import no_grad
-    from ....models.gpt import GPTForPretraining
 
-    gpt = model.gpt
-    cfg = model.config
-    blocks = list(gpt.layers)
+    blocks = list(blocks)
     template = blocks[0]
     t_params = template.parameters()
 
     # stack order: the pp-sharded leading axis gives rank r the slice
     # [r*L_local, (r+1)*L_local).  For the interleaved schedule rank r
     # must host virtual stages {r, r+P, ..., r+(V-1)P}, i.e. global
-    # blocks (k*P + r)*Lv + j — permute the stacking so chunk k of rank
-    # r lands on exactly those blocks (identity when n_chunks == 1).
+    # blocks (k*P + r)*Lv + j (identity permutation when n_chunks == 1).
     L = len(blocks)
     n_stage = int(mesh.shape[axis_name])
     vv = int(n_chunks)
@@ -411,32 +410,69 @@ def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
     stack_tensors = []
     for i, arr in enumerate(stacks):
         t = Tensor(arr, stop_gradient=False)
-        t.name = f"pp_block_stack_{i}"
+        t.name = f"{stack_prefix}_{i}"
         stack_tensors.append(t)
+    for i, p in enumerate(rep_tensors):
+        if not p.name:
+            p.name = f"{stack_prefix}_rep_{i}"
+
+    def block_fn(params_i, h):
+        # template inherits the model's train/eval mode; dropout keys
+        # come from the per-(tick, stage, block) stream the schedule
+        # installs around this call
+        with no_grad():
+            for p, v in zip(t_params, params_i):
+                p._data = v
+            out = template(Tensor(h))
+        return out._data
+
+    opt = getattr(optimizer, "_inner_opt", optimizer)
+    opt._append_params(list(rep_tensors) + stack_tensors)
+
+    def sync_to_model():
+        # unstack trained values back into the blocks' own Parameters so
+        # state_dict()/eval on the source model see the trained weights
+        # (row i of the stack holds block order[i])
+        for i, block_idx in enumerate(order):
+            for p, st in zip(blocks[block_idx].parameters(),
+                             stack_tensors):
+                p._data = st._data[i]
+
+    return PipelineSpmdStep(pre_fn, block_fn, post_fn, list(rep_tensors),
+                            stack_tensors, opt, mesh, n_micro,
+                            axis_name=axis_name, dp_axes=dp_axes,
+                            remat_blocks=remat_blocks,
+                            sync_fn=sync_to_model, n_chunks=n_chunks)
+
+
+# ---------------------------------------------------------------------------
+# GPT adapter — pipeline step for the flagship model
+# ---------------------------------------------------------------------------
+
+def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
+                      axis_name: str = "pp", dp_axes=("dp", "sharding"),
+                      remat_blocks: bool = True,
+                      n_chunks: int = 1) -> PipelineSpmdStep:
+    """Build a PipelineSpmdStep from a GPTForPretraining model.
+
+    Stage split: pre = embeddings (stage 0), blocks = the L GPTBlocks
+    (stacked over pp), post = final_ln + tied head + CE (last stage).
+    Dropout trains for real: the schedule threads a per-(step, tick,
+    stage) PRNG stream through the ring (see pipeline_spmd_forward).
+    ``n_chunks`` > 1 enables the interleaved/VPP schedule.
+    """
+    gpt = model.gpt
 
     emb_w = gpt.embeddings.word_embeddings.weight
     pos_w = gpt.embeddings.position_embeddings.weight
     ln_w, ln_b = gpt.final_ln.parameters()
     rep_tensors = [emb_w, pos_w, ln_w, ln_b]
-    for i, p in enumerate(rep_tensors):
-        if not p.name:
-            p.name = f"pp_rep_{i}"
 
     def pre_fn(rep_v, ids):
         emb, pos = rep_v[0], rep_v[1]
         h = jnp.take(emb, ids, axis=0)
         h = h + pos[:ids.shape[-1]][None, :, :]
         return h
-
-    def block_fn(params_i, h):
-        # template inherits the model's train/eval mode, so dropout is
-        # live in training — its keys come from the per-(tick, stage)
-        # stream the schedule installs around this call
-        with no_grad():
-            for p, v in zip(t_params, params_i):
-                p._data = v
-            out = template(Tensor(h))
-        return out._data
 
     def post_fn(rep_v, h, labels):
         emb, _, lw, lb = rep_v
@@ -452,20 +488,8 @@ def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
         loss = (lse - ll) * mask
         return loss.sum() / jnp.maximum(mask.sum(), 1.0)
 
-    opt = getattr(optimizer, "_inner_opt", optimizer)
-    opt._append_params(rep_tensors + stack_tensors)
-
-    def sync_to_model():
-        # unstack trained values back into the blocks' own Parameters so
-        # state_dict()/eval on the source model see the trained weights
-        # (row i of the stack holds block order[i])
-        for i, block_idx in enumerate(order):
-            for p, st in zip(blocks[block_idx].parameters(),
-                             stack_tensors):
-                p._data = st._data[i]
-
-    return PipelineSpmdStep(pre_fn, block_fn, post_fn, rep_tensors,
-                            stack_tensors, opt, mesh, n_micro,
-                            axis_name=axis_name, dp_axes=dp_axes,
-                            remat_blocks=remat_blocks,
-                            sync_fn=sync_to_model, n_chunks=n_chunks)
+    return make_transformer_pipeline_step(
+        gpt.layers, rep_tensors, pre_fn, post_fn, optimizer, mesh,
+        n_micro, axis_name=axis_name, dp_axes=dp_axes,
+        remat_blocks=remat_blocks, n_chunks=n_chunks,
+        stack_prefix="pp_block_stack")

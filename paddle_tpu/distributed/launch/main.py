@@ -66,13 +66,16 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     local_rank = int(env["PADDLE_TRAINER_ID"])
     # a per-invocation job id isolates concurrent jobs' registries unless
     # the caller provides one (multi-node jobs set PADDLE_ELASTIC_JOB_ID
-    # or a shared PADDLE_ELASTIC_REGISTRY themselves)
+    # or a shared PADDLE_ELASTIC_REGISTRY themselves).  Passed only via
+    # the CHILD env + the manager's job_id — never written into this
+    # process's os.environ, so a second launch() gets its own id.
+    job_id = None
     if not os.environ.get("PADDLE_ELASTIC_REGISTRY") and \
             not os.environ.get("PADDLE_ELASTIC_JOB_ID"):
-        env["PADDLE_ELASTIC_JOB_ID"] = f"{os.getpid()}_{int(time.time())}"
-        os.environ["PADDLE_ELASTIC_JOB_ID"] = env["PADDLE_ELASTIC_JOB_ID"]
+        job_id = f"{os.getpid()}_{int(time.time() * 1000)}"
+        env["PADDLE_ELASTIC_JOB_ID"] = job_id
     # this launcher supervises its OWN rank; peers run their own loop
-    manager = ElasticManager(ranks=[local_rank])
+    manager = ElasticManager(ranks=[local_rank], job_id=job_id)
     if elastic_timeout is not None:
         manager.heartbeat_timeout = float(elastic_timeout)
     env.setdefault("PADDLE_ELASTIC_REGISTRY", manager.registry)

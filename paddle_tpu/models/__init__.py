@@ -6,3 +6,9 @@ from .bert import (BertConfig, BertModel, BertForPretraining,
                    BertForQuestionAnswering,
                    BertForSequenceClassification,
                    BertPretrainingCriterion, bert_config, BERT_PRESETS)
+from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
+                    LlamaPretrainingCriterion, llama_config,
+                    llama_pipeline_step, LLAMA_PRESETS)
+from .ernie_moe import (ErnieMoEConfig, ErnieMoEModel,
+                        ErnieMoEForPretraining, ernie_moe_config,
+                        ERNIE_MOE_PRESETS)

@@ -1,0 +1,309 @@
+"""LLaMA model family — BASELINE config 4 flagship (LLaMA-2 7B/13B
+hybrid tp x pp x dp).
+
+Reference: PaddleNLP transformers/llama/modeling.py (LlamaModel with
+RMSNorm, rotary embeddings, SwiGLU MLP, GQA) trained through
+fleet.meta_parallel (mp_layers + PipelineLayer 1F1B + sequence-parallel
+utils + recompute_hybrid) — survey §2.4 config 4.
+
+TPU-native design notes:
+- built from the fleet tensor-parallel layers exactly like the GPT/BERT
+  flagships, so tp = GSPMD weight specs; pipeline via
+  llama_pipeline_step (the same compiled ppermute-ring schedule with
+  dropout-free blocks);
+- RMSNorm/rotary lower through incubate fused functional (one fused XLA
+  expression; the reference carries dedicated CUDA kernels);
+- grouped-query attention (n_kv_heads < n_heads) repeats KV heads
+  inside the traced graph — XLA fuses the broadcast into the attention
+  matmuls.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..core.dispatch import call_op
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..framework.param_attr import ParamAttr
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding)
+from ..distributed.shard_utils import sharding_constraint
+from ..distributed.fleet.recompute import recompute
+import paddle_tpu as paddle
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaPretrainingCriterion", "llama_config", "LLAMA_PRESETS"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None       # None → MHA
+    intermediate_size: Optional[int] = None  # None → SwiGLU 8/3 rule
+    max_position_embeddings: int = 4096
+    rms_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    sequence_parallel: bool = False
+    tie_word_embeddings: bool = False
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size is None:
+            # llama rule: 2/3 * 4h rounded up to a multiple of 256
+            inter = int(8 * self.hidden_size / 3)
+            self.intermediate_size = 256 * ((inter + 255) // 256)
+
+
+LLAMA_PRESETS = {
+    "llama2-7b": dict(num_layers=32, hidden_size=4096, num_heads=32,
+                      intermediate_size=11008),
+    "llama2-13b": dict(num_layers=40, hidden_size=5120, num_heads=40,
+                       intermediate_size=13824),
+    "llama2-70b": dict(num_layers=80, hidden_size=8192, num_heads=64,
+                       num_kv_heads=8, intermediate_size=28672),
+    "tiny": dict(num_layers=2, hidden_size=64, num_heads=4,
+                 num_kv_heads=2, vocab_size=256,
+                 max_position_embeddings=128),
+}
+
+
+def llama_config(name: str, **overrides) -> LlamaConfig:
+    cfg = dict(LLAMA_PRESETS[name])
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+class LlamaRMSNorm(nn.Layer):
+    """ref: modeling.LlamaRMSNorm → incubate fused_rms_norm."""
+
+    def __init__(self, hidden_size: int, epsilon: float = 1e-5):
+        super().__init__()
+        from ..nn.initializer import Constant
+        self.weight = self.create_parameter(
+            shape=[hidden_size], attr=ParamAttr(initializer=Constant(1.0)))
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        from ..incubate.nn.functional import fused_rms_norm
+        out, _ = fused_rms_norm(x, self.weight, epsilon=self.epsilon)
+        return out
+
+
+def _rope_cache(head_dim: int, max_pos: int, theta: float):
+    """Full-width [S, head_dim] cos/sin (each pair's angle duplicated),
+    the layout incubate fused_rotary_position_embedding consumes."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype="float32")
+                           / head_dim))
+    t = np.arange(max_pos, dtype="float32")
+    freqs = np.outer(t, inv)                       # [S, hd/2]
+    full = np.repeat(freqs, 2, axis=-1)            # [S, hd]
+    return np.cos(full), np.sin(full)
+
+
+class LlamaAttention(nn.Layer):
+    """Rotary GQA attention over column/row-parallel projections."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.num_kv = c.num_kv_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.hidden_size = c.hidden_size
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.q_proj = ColumnParallelLinear(
+            c.hidden_size, c.num_heads * self.head_dim, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            c.hidden_size, self.num_kv * self.head_dim, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            c.hidden_size, self.num_kv * self.head_dim, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(
+            c.num_heads * self.head_dim, c.hidden_size, weight_attr=init,
+            has_bias=False, input_is_parallel=True)
+        cos, sin = _rope_cache(self.head_dim, c.max_position_embeddings,
+                               c.rope_theta)
+        self._cos, self._sin = jnp.asarray(cos), jnp.asarray(sin)
+
+    def forward(self, x):
+        from ..incubate.nn.functional import fused_rotary_position_embedding
+        B, S, H = x.shape
+        q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([B, S, self.num_kv, self.head_dim])
+        v = self.v_proj(x).reshape([B, S, self.num_kv, self.head_dim])
+        cos = Tensor(self._cos[:S])
+        sin = Tensor(self._sin[:S])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, sin=sin, cos=cos, use_neox_rotary_style=False)
+        rep = self.num_heads // self.num_kv
+        if rep > 1:   # GQA: broadcast kv heads (XLA fuses into the dot)
+            k = call_op(lambda a: jnp.repeat(a, rep, axis=2), (k,),
+                        op_name="gqa_repeat")
+            v = call_op(lambda a: jnp.repeat(a, rep, axis=2), (v,),
+                        op_name="gqa_repeat")
+        q = sharding_constraint(q, None, None, "mp", None)
+        k = sharding_constraint(k, None, None, "mp", None)
+        v = sharding_constraint(v, None, None, "mp", None)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        out = out.reshape([B, S, self.num_heads * self.head_dim])
+        out = sharding_constraint(out, None, None, "mp")
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU (ref: modeling.LlamaMLP gate/up/down)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        self.gate_proj = ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            c.hidden_size, c.intermediate_size, weight_attr=init,
+            has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(
+            c.intermediate_size, c.hidden_size, weight_attr=init,
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            config.rms_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.embed_tokens = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size,
+            weight_attr=ParamAttr(initializer=Normal(
+                std=c.initializer_range)))
+        self.layers = nn.LayerList([LlamaDecoderLayer(c)
+                                    for _ in range(c.num_layers)])
+        self.norm = LlamaRMSNorm(c.hidden_size, c.rms_eps)
+
+    def forward(self, input_ids):
+        c = self.config
+        x = self.embed_tokens(input_ids)
+        if c.sequence_parallel:
+            x = sharding_constraint(x, ("dp", "sharding"), "mp", None)
+        else:
+            x = sharding_constraint(x, ("dp", "sharding"), None, None)
+        for layer in self.layers:
+            if c.use_recompute and self.training:
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    """ref: modeling.LlamaForCausalLM — lm_head + criterion."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head_weight = self.create_parameter(
+                shape=[config.vocab_size, config.hidden_size],
+                attr=ParamAttr(initializer=Normal(
+                    std=config.initializer_range)))
+        self.loss_fn = LlamaPretrainingCriterion()
+
+    def forward(self, input_ids):
+        h = self.llama(input_ids)
+        w = (self.llama.embed_tokens.weight
+             if self.config.tie_word_embeddings else self.lm_head_weight)
+        logits = paddle.matmul(h, w, transpose_y=True)
+        return sharding_constraint(logits, ("dp", "sharding"), None, "mp")
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Next-token CE, vocab-parallel safe (ref: same name)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = ParallelCrossEntropy(ignore_index=-100)
+
+    def forward(self, logits, labels):
+        B, S, V = logits.shape
+        flat = labels.reshape([B * S])
+        loss = self.ce(logits.reshape([B * S, V]), flat)
+        mask = (flat != self.ce.ignore_index).astype(loss.dtype)
+        return (loss * mask).sum() / mask.sum().clip(min=1.0)
+
+
+def llama_pipeline_step(model: LlamaForCausalLM, optimizer, mesh,
+                        n_micro: int, axis_name: str = "pp",
+                        dp_axes=("dp", "sharding"),
+                        remat_blocks: bool = True, n_chunks: int = 1):
+    """Pipeline schedule for LLaMA (config 4's pp leg): pre = token
+    embedding, blocks = decoder layers (stacked over pp), post =
+    final RMSNorm + lm_head + CE.  Stacking/VPP/sync mechanics come
+    from the shared make_transformer_pipeline_step builder."""
+    import jax as _jax
+    from ..distributed.fleet.meta_parallel.pp_spmd import (
+        make_transformer_pipeline_step)
+
+    llama = model.llama
+    cfg = model.config
+    emb_w = llama.embed_tokens.weight
+    norm_w = llama.norm.weight
+    rep_tensors = [emb_w, norm_w] + (
+        [] if cfg.tie_word_embeddings else [model.lm_head_weight])
+
+    def pre_fn(rep_v, ids):
+        return jnp.take(rep_v[0], ids, axis=0)
+
+    def post_fn(rep_v, h, labels):
+        nw = rep_v[1]
+        hw = rep_v[0] if cfg.tie_word_embeddings else rep_v[2]
+        var = jnp.mean(h * h, axis=-1, keepdims=True)
+        hn = h * _jax.lax.rsqrt(var + cfg.rms_eps) * nw
+        logits = jnp.einsum("bsh,vh->bsv", hn, hw).astype(jnp.float32)
+        lse = _jax.scipy.special.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0]
+        mask = (labels != -100).astype(jnp.float32)
+        return ((lse - ll) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    return make_transformer_pipeline_step(
+        llama.layers, rep_tensors, pre_fn, post_fn, optimizer, mesh,
+        n_micro, axis_name=axis_name, dp_axes=dp_axes,
+        remat_blocks=remat_blocks, n_chunks=n_chunks,
+        stack_prefix="llama_pp_stack")

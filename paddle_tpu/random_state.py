@@ -33,8 +33,8 @@ class Generator:
 
     def manual_seed(self, seed: int) -> "Generator":
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(int(seed))
-        return self
+        self._key = None   # stays lazy: paddle.seed() at script top must
+        return self        # not initialize the backend either
 
     def initial_seed(self) -> int:
         return self._seed

@@ -1,0 +1,126 @@
+"""ERNIE-MoE flagship — BASELINE config 5 shape: MoE encoder with
+expert parallelism + auto_parallel Engine fit."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.auto_parallel import (Engine, ProcessMesh,
+                                                  Shard, shard_tensor)
+from paddle_tpu.distributed.communication.group import _reset_groups
+from paddle_tpu.distributed.fleet.base.topology import _clear_hcg
+from paddle_tpu.distributed.mesh import reset_mesh
+from paddle_tpu.jit import train_step
+from paddle_tpu.models import ErnieMoEForPretraining, ernie_moe_config
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    reset_mesh(); _reset_groups(); _clear_hcg()
+    yield
+    reset_mesh(); _reset_groups(); _clear_hcg()
+
+
+def _data(cfg, b=4, s=16, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, cfg.vocab_size, (b, s)).astype(np.int64)
+    labels = ids.copy()
+    labels[rs.rand(b, s) > 0.3] = -100   # MLM-style sparse labels
+    return ids, labels
+
+
+def test_ernie_moe_forward_and_gate_loss():
+    cfg = ernie_moe_config("tiny", hidden_dropout_prob=0.0,
+                           attention_dropout_prob=0.0)
+    m = ErnieMoEForPretraining(cfg)
+    m.eval()
+    ids, labels = _data(cfg, b=2)
+    logits = m(Tensor(ids))
+    assert list(logits.shape) == [2, 16, cfg.vocab_size]
+    # every block is MoE at moe_every=1 → gate aux losses collected
+    loss = m.loss_fn(logits, Tensor(labels))
+    gls = m.ernie.gate_losses()
+    assert len(gls) == cfg.num_layers
+    assert np.isfinite(float(loss))
+
+
+def test_ernie_moe_ep_training_step():
+    """config-5 core: ep=4 x dp=2 mesh, engine-jitted training, loss
+    falls and expert grads flow."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "ep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_expert_parallel_world_size() == 4
+    paddle.seed(0)
+    cfg = ernie_moe_config("tiny", hidden_dropout_prob=0.0,
+                           attention_dropout_prob=0.0)
+    model = ErnieMoEForPretraining(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = train_step(model, model.loss_fn, o)
+    ids, labels = _data(cfg, b=8)
+    losses = [float(step(ids, labels)) for _ in range(3)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # expert weights actually trained (grads flowed through dispatch)
+    blk = model.ernie.blocks[0]
+    w0 = blk.ffn.experts[0][0].weight.numpy()
+    assert np.abs(w0).sum() > 0
+
+
+def test_ernie_moe_ep_loss_parity_vs_ep1():
+    """the multi-rank-vs-single oracle at the model level."""
+    cfg = ernie_moe_config("tiny", hidden_dropout_prob=0.0,
+                           attention_dropout_prob=0.0, num_layers=1)
+    ids, labels = _data(cfg, b=4)
+
+    def run(ep):
+        reset_mesh(); _reset_groups(); _clear_hcg()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8 // ep, "ep_degree": ep,
+                                   "mp_degree": 1, "pp_degree": 1,
+                                   "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(5)
+        m = ErnieMoEForPretraining(cfg)
+        m.eval()
+        logits = m(Tensor(ids))
+        return float(m.loss_fn(logits, Tensor(labels)))
+
+    l1 = run(1)
+    l4 = run(4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5)
+
+
+def test_ernie_moe_auto_parallel_engine_fit():
+    """config-5 semi-auto leg: shard_tensor + Engine.fit."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "ep_degree": 4,
+                               "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(1)
+    cfg = ernie_moe_config("tiny", hidden_dropout_prob=0.0,
+                           attention_dropout_prob=0.0)
+    model = ErnieMoEForPretraining(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    engine = Engine(model, loss=model.loss_fn, optimizer=o)
+    ids, labels = _data(cfg, b=8)
+
+    class DS:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return ids, labels
+
+    history = engine.fit(DS(), batch_size=None, epochs=1,
+                         steps_per_epoch=4)
+    losses = history["loss"]
+    assert len(losses) == 4 and np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
