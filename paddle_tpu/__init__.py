@@ -38,6 +38,7 @@ from .core.autograd_state import no_grad, enable_grad, is_grad_enabled, set_grad
 from .core import dispatch as _dispatch
 from .core.dispatch import grad
 
+from . import errors
 from .random_state import seed, get_rng_state, set_rng_state, Generator
 from .random_state import get_rng_state_tracker as _get_rng_state_tracker
 
@@ -85,7 +86,7 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
                 "linalg", "fft", "signal", "framework", "jit", "static",
                 "distributed", "distribution", "vision", "hapi", "incubate",
                 "utils", "profiler", "sparse", "text", "audio",
-                "quantization", "onnx", "version"]
+                "quantization", "onnx", "version", "inference"]
 
 
 def __getattr__(name):

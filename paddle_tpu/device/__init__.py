@@ -137,6 +137,33 @@ def device_count() -> int:
     return jax.device_count()
 
 
+def get_device_topology():
+    """ICI/DCN topology query (ref: phi/backends device topology — the
+    reference exposes NVLink/PCIe topology; here it's the TPU
+    coords/slice layout PJRT reports per device).
+
+    Returns a list of dicts: id, process_index, platform, device_kind,
+    coords (ICI mesh coordinates when the runtime exposes them),
+    core_on_chip, slice_index (DCN: which slice in a multi-slice job).
+    """
+    import jax
+    out = []
+    for d in jax.devices():
+        info = {
+            "id": d.id,
+            "process_index": d.process_index,
+            "platform": d.platform,
+            "device_kind": getattr(d, "device_kind", ""),
+        }
+        for attr in ("coords", "core_on_chip", "slice_index"):
+            v = getattr(d, attr, None)
+            if v is not None:
+                info[attr] = tuple(v) if isinstance(v, (list, tuple)) \
+                    else v
+        out.append(info)
+    return out
+
+
 def is_compiled_with_cuda() -> bool:
     return False
 

@@ -12,6 +12,7 @@ Layers (mirrors SURVEY.md §2.3):
 from .env import (ParallelEnv, get_rank, get_world_size, is_initialized)
 from .mesh import build_mesh, get_mesh, set_mesh, ensure_mesh, HYBRID_AXES
 from .parallel import init_parallel_env, DataParallel, spawn
+from .communication.store import Store, TCPStore
 from .communication import (Group, ReduceOp, get_group, new_group,
                             destroy_process_group, all_reduce, all_gather,
                             all_gather_object, broadcast,

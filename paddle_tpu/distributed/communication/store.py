@@ -1,0 +1,237 @@
+"""TCPStore-parity key-value rendezvous store (ref:
+paddle/phi/core/distributed/store/tcp_store.{h,cc} + pybind
+distributed_py.cc TCPStore bindings).
+
+TPU-native: jax.distributed already provides the coordination service
+for backend bring-up; this store exists for the USER-facing contract —
+scripts that rendezvous custom state through paddle.distributed.TCPStore
+(barriers, leader election, small blobs).  One process (the host rank)
+serves a tiny length-prefixed TCP protocol; peers connect as clients.
+The wire protocol is private; the API (get/set/add/wait/delete_key) is
+the reference's.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["TCPStore", "Store"]
+
+
+def _send_msg(sock, *parts: bytes):
+    payload = struct.pack("!I", len(parts))
+    for p in parts:
+        payload += struct.pack("!I", len(p)) + p
+    sock.sendall(payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+    parts = []
+    for _ in range(n):
+        (ln,) = struct.unpack("!I", _recv_exact(sock, 4))
+        parts.append(_recv_exact(sock, ln))
+    return parts
+
+
+class Store:
+    """ref: phi Store base — get/set/add/wait."""
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+
+class TCPStore(Store):
+    """ref: TCPStore(host, port, is_master, world_size, timeout)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6170,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 120.0):
+        self._host, self._port = host, int(port)
+        self._is_master = bool(is_master)
+        self._timeout = float(timeout)
+        self._data: Dict[str, bytes] = {}
+        # server-side data lock and client-side socket lock MUST be
+        # distinct: the master's own client connection round-trips
+        # through its server thread, which needs the data lock while the
+        # client is still holding its socket lock
+        self._cv = threading.Condition(threading.Lock())
+        self._sock_lock = threading.Lock()
+        self._server = None
+        self._sock = None
+        if self._is_master:
+            self._start_server()
+        self._connect()
+
+    # -- server ----------------------------------------------------------
+    def _start_server(self):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._port))
+        if self._port == 0:
+            self._port = srv.getsockname()[1]
+        srv.listen(64)
+        self._server = srv
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                parts = _recv_msg(conn)
+                op = parts[0].decode()
+                if op == "set":
+                    with self._cv:
+                        self._data[parts[1].decode()] = parts[2]
+                        self._cv.notify_all()
+                    _send_msg(conn, b"ok")
+                elif op == "get":
+                    key = parts[1].decode()
+                    # the client transmits ITS timeout so the server
+                    # always answers before the client's socket deadline
+                    # (a late reply would desynchronize the connection)
+                    deadline = time.time() + float(parts[2].decode())
+                    with self._cv:
+                        while key not in self._data:
+                            left = deadline - time.time()
+                            if left <= 0 or not self._cv.wait(left):
+                                break
+                        val = self._data.get(key)
+                    if val is None:
+                        _send_msg(conn, b"err", b"timeout")
+                    else:
+                        _send_msg(conn, b"ok", val)
+                elif op == "add":
+                    key = parts[1].decode()
+                    amt = int(parts[2].decode())
+                    with self._cv:
+                        cur = int(self._data.get(key, b"0").decode() or 0)
+                        cur += amt
+                        self._data[key] = str(cur).encode()
+                        self._cv.notify_all()
+                    _send_msg(conn, b"ok", str(cur).encode())
+                elif op == "wait":
+                    keys = [k.decode() for k in parts[2:]]
+                    deadline = time.time() + float(parts[1].decode())
+                    ok = True
+                    with self._cv:
+                        for k in keys:
+                            while k not in self._data:
+                                left = deadline - time.time()
+                                if left <= 0 or not self._cv.wait(left):
+                                    ok = False
+                                    break
+                            if not ok:
+                                break
+                    _send_msg(conn, b"ok" if ok else b"err")
+                elif op == "del":
+                    with self._cv:
+                        self._data.pop(parts[1].decode(), None)
+                    _send_msg(conn, b"ok")
+                else:
+                    _send_msg(conn, b"err", b"bad op")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # -- client ----------------------------------------------------------
+    def _connect(self):
+        deadline = time.time() + self._timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection((self._host, self._port),
+                                             timeout=self._timeout)
+                self._sock = s
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        raise ConnectionError(
+            f"cannot reach TCPStore at {self._host}:{self._port}: {last}")
+
+    def _rpc(self, *parts: bytes, timeout: Optional[float] = None):
+        with self._sock_lock:
+            if timeout is not None:
+                # give the server margin to answer with its own timeout
+                # error instead of racing the socket deadline
+                self._sock.settimeout(timeout + 5.0)
+            try:
+                _send_msg(self._sock, *parts)
+                return _recv_msg(self._sock)
+            finally:
+                if timeout is not None:
+                    self._sock.settimeout(self._timeout)
+
+    # -- API (ref signatures) --------------------------------------------
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._rpc(b"set", key.encode(), bytes(value))
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        t = float(timeout if timeout is not None else self._timeout)
+        resp = self._rpc(b"get", key.encode(), str(t).encode(), timeout=t)
+        if resp[0] != b"ok":
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        return resp[1]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        resp = self._rpc(b"add", key.encode(), str(int(amount)).encode())
+        return int(resp[1].decode())
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        t = float(timeout if timeout is not None else self._timeout)
+        resp = self._rpc(b"wait", str(t).encode(),
+                         *[k.encode() for k in keys], timeout=t)
+        if resp[0] != b"ok":
+            raise TimeoutError(f"TCPStore.wait({keys}) timed out")
+
+    def delete_key(self, key: str) -> None:
+        self._rpc(b"del", key.encode())
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def __del__(self):
+        try:
+            if self._sock is not None:
+                self._sock.close()
+            if self._server is not None:
+                self._server.close()
+        except Exception:
+            pass

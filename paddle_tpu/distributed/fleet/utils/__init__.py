@@ -1,4 +1,4 @@
-from . import sequence_parallel_utils, hybrid_parallel_util
+from . import log_util, sequence_parallel_utils, hybrid_parallel_util
 from .hybrid_parallel_util import fused_allreduce_gradients
 
 def recompute(function, *args, **kwargs):

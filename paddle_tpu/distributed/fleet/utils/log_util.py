@@ -1,0 +1,62 @@
+"""Rank-aware logging (ref: python/paddle/distributed/fleet/utils/
+log_util.py — the `logger` every fleet module imports, with
+set_log_level and rank-0-only helpers)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["logger", "set_log_level", "get_log_level_code",
+           "get_log_level_name", "layer_to_str"]
+
+
+class _RankFormatter(logging.Formatter):
+    def format(self, record):
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        record.rank = rank
+        return super().format(record)
+
+
+def _build_logger() -> logging.Logger:
+    lg = logging.getLogger("paddle.distributed.fleet")
+    if not lg.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(_RankFormatter(
+            "%(levelname)s %(asctime)s rank:%(rank)s %(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S"))
+        lg.addHandler(h)
+        lg.propagate = False
+        lg.setLevel(os.environ.get("PADDLE_LOG_LEVEL", "INFO").upper())
+    return lg
+
+
+logger = _build_logger()
+
+
+def set_log_level(level):
+    """ref: log_util.set_log_level — int code or name."""
+    if isinstance(level, int):
+        logger.setLevel(level)
+    else:
+        logger.setLevel(str(level).upper())
+
+
+def get_log_level_code() -> int:
+    return logger.getEffectiveLevel()
+
+
+def get_log_level_name() -> str:
+    return logging.getLevelName(get_log_level_code())
+
+
+def layer_to_str(base: str, *args, **kwargs) -> str:
+    """ref: log_util.layer_to_str — pretty ctor string for layer logs."""
+    name = base + "("
+    if args:
+        name += ", ".join(str(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v}" for k, v in kwargs.items())
+    return name + ")"

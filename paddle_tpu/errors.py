@@ -1,0 +1,100 @@
+"""Error taxonomy + enforce helpers (ref: paddle/common/errors.h error
+codes + paddle/phi/core/enforce.h PADDLE_ENFORCE macros).
+
+The reference carries a C++ error-code enum (InvalidArgument, NotFound,
+OutOfRange, AlreadyExists, ResourceExhausted, PreconditionNotMet,
+PermissionDenied, ExecutionTimeout, Unimplemented, Unavailable, Fatal,
+External) whose messages surface as typed python exceptions.  Here the
+taxonomy IS python exception classes, each mapping onto the closest
+builtin so `except ValueError` style handling keeps working.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_not_none",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """ref: platform::EnforceNotMet — base of all enforce failures."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, LookupError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, message: str = "",
+            exc: type = PreconditionNotMetError):
+    """ref: PADDLE_ENFORCE(cond, ...)."""
+    if not cond:
+        raise exc(message or "enforce failed")
+
+
+def enforce_eq(a, b, message: str = ""):
+    """ref: PADDLE_ENFORCE_EQ."""
+    if a != b:
+        raise InvalidArgumentError(
+            message or f"enforce_eq failed: {a!r} != {b!r}")
+
+
+def enforce_gt(a, b, message: str = ""):
+    """ref: PADDLE_ENFORCE_GT."""
+    if not a > b:
+        raise InvalidArgumentError(
+            message or f"enforce_gt failed: {a!r} <= {b!r}")
+
+
+def enforce_not_none(v, message: str = ""):
+    """ref: PADDLE_ENFORCE_NOT_NULL."""
+    if v is None:
+        raise NotFoundError(message or "unexpected None")
+    return v

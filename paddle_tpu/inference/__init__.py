@@ -1,0 +1,237 @@
+"""paddle.inference — deployment predictor API (ref:
+paddle/fluid/inference/api/ AnalysisPredictor + paddle_infer python
+bindings: Config, create_predictor, Tensor handles, ZeroCopyRun).
+
+TPU-native: the deployment artifact is the StableHLO export written by
+``paddle.jit.save`` (the ``__model__``/PIR role); the predictor loads it
+through jax.export and executes via PJRT — the reference's IR pass
+pipeline + TensorRT subgraph engine are XLA's job at export time.  The
+handle-based API (get_input_handle → copy_from_cpu → run →
+copy_to_cpu) is preserved so serving code written against the reference
+ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           "convert_to_mixed_precision", "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+    TPU = "tpu"
+
+
+class Config:
+    """ref: paddle_infer.Config — model path + execution knobs."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either the path prefix (our jit.save contract) or the
+        # reference's (model_file, params_file) pair
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file: Optional[str] = (
+            params_file if params_file else None)
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._enable_memory_optim = True
+        self._cpu_math_threads = 1
+        self._switch_ir_optim = True
+
+    # -- model location ----------------------------------------------------
+    def set_prog_file(self, path: str):
+        if path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        self._prefix = path
+
+    def prog_file(self) -> str:
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self) -> str:
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    def set_model(self, prog_file: str, params_file: str = ""):
+        self.set_prog_file(prog_file)
+        self._params_file = params_file or None
+
+    def model_dir(self) -> str:
+        import os
+        return os.path.dirname(self._prefix or "")
+
+    # -- device / precision ------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0, precision=None):
+        # GPU requests map onto the attached accelerator
+        self._device = "tpu"
+        self._device_id = device_id
+        if precision is not None:
+            self._precision = precision
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def enable_custom_device(self, device_type: str, device_id: int = 0):
+        self._device = device_type
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device != "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = int(n)
+
+    # -- optimization knobs (XLA owns these; recorded for introspection) --
+    def switch_ir_optim(self, on: bool = True):
+        self._switch_ir_optim = bool(on)
+
+    def ir_optim(self) -> bool:
+        return self._switch_ir_optim
+
+    def enable_memory_optim(self, on: bool = True):
+        self._enable_memory_optim = bool(on)
+
+    def enable_tensorrt_engine(self, *a, **k):
+        # TRT subgraphs ≅ XLA compilation — already always on
+        return None
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class PredictorTensor:
+    """ref: paddle_infer.Tensor — a named zero-copy input/output handle."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError(f"{self.name} is an output handle")
+        self._owner._feed[self.name] = np.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError(f"{self.name} is an input handle")
+        return np.asarray(self._owner._fetch[self.name])
+
+    def shape(self):
+        if self._is_input:
+            arr = self._owner._feed.get(self.name)
+        else:
+            arr = self._owner._fetch.get(self.name)
+        return list(arr.shape) if arr is not None else None
+
+    # reference aliases
+    def copy_from_cpu_bind(self, data):
+        self.copy_from_cpu(data)
+
+
+class Predictor:
+    """ref: AnalysisPredictor via paddle_infer.create_predictor."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        if not config._prefix:
+            raise ValueError("Config has no model path")
+        self.config = config
+        self._layer = jit_load(config._prefix,
+                               params_path=config._params_file)
+        # in_avals flattens (param_tuple, *inputs): user inputs are the
+        # trailing avals after the parameter leaves
+        n_total = len(self._layer._exported.in_avals)
+        n_params = len(self._layer._param_arrays)
+        self._input_names = [f"input_{i}"
+                             for i in range(n_total - n_params)]
+        self._feed: Dict[str, np.ndarray] = {}
+        self._fetch: Dict[str, np.ndarray] = {}
+        self._output_names: List[str] = []
+
+    # -- handle API --------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        if name not in self._input_names:
+            raise KeyError(f"unknown input {name!r}; have "
+                           f"{self._input_names}")
+        return PredictorTensor(name, self, is_input=True)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["output_0"]
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(name, self, is_input=False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun: feed handles (or positional arrays) → outputs."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._feed[n] = np.asarray(a)
+        missing = [n for n in self._input_names if n not in self._feed]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        args = [self._feed[n] for n in self._input_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._fetch = {n: o.numpy() for n, o in
+                       zip(self._output_names, outs)}
+        if inputs is not None:
+            return [self._fetch[n] for n in self._output_names]
+        return True
+
+    def clear_intermediate_tensor(self):
+        self._feed.clear()
+        self._fetch.clear()
+
+    def try_shrink_memory(self):
+        return None
+
+
+def create_predictor(config: Config) -> Predictor:
+    """ref: paddle_infer.create_predictor."""
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=None, keep_io_types: bool = True,
+                               black_list=None, **kw):
+    """ref: paddle.inference.convert_to_mixed_precision — re-export the
+    artifact with params cast to the mixed dtype."""
+    import jax.numpy as jnp
+    from ..framework.io import load as pload, save as psave
+    import shutil
+    meta = pload(src_prefix + ".pdiparams")
+    dt = {"float16": np.float16, "bfloat16": jnp.bfloat16,
+          PrecisionType.Half: np.float16,
+          PrecisionType.Bfloat16: jnp.bfloat16}[mixed_precision]
+    params = [np.asarray(a) for a in meta["params"]]
+    meta["params"] = [
+        np.asarray(jnp.asarray(a).astype(dt))
+        if np.issubdtype(a.dtype, np.floating) else a
+        for a in params]
+    psave(meta, dst_prefix + ".pdiparams")
+    shutil.copyfile(src_prefix + ".pdmodel", dst_prefix + ".pdmodel")

@@ -142,12 +142,24 @@ class TranslatedLayer(Layer):
         return tensors[0]
 
 
-def load(path: str, **configs) -> TranslatedLayer:
-    """ref: paddle.jit.load."""
+def load(path: str, params_path: Optional[str] = None,
+         **configs) -> TranslatedLayer:
+    """ref: paddle.jit.load.  ``params_path`` overrides the default
+    ``<path>.pdiparams`` (the inference Config.set_model contract)."""
     from jax import export as jexport
     from ..framework.io import load as pload
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
-    meta = pload(path + ".pdiparams")
+    meta = pload(params_path or (path + ".pdiparams"))
     params = [jnp.asarray(a) for a in meta["params"]]
+    # params stored in a narrower dtype (inference
+    # convert_to_mixed_precision) are widened back to the exported
+    # computation's expected dtypes.  in_avals is FLAT over
+    # (param_tuple, *inputs): the leading len(params) avals are params.
+    try:
+        param_avals = exported.in_avals[:len(params)]
+        params = [p.astype(a.dtype) if p.dtype != a.dtype else p
+                  for p, a in zip(params, param_avals)]
+    except Exception:
+        pass
     return TranslatedLayer(exported, params, bool(meta.get("multi")))

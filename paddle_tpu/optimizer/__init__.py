@@ -3,4 +3,4 @@ from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Adadelta, Lamb,
-    ASGD, NAdam, RAdam, Rprop)
+    ASGD, NAdam, RAdam, Rprop, LBFGS)

@@ -343,3 +343,161 @@ class Rprop(Optimizer):
         self._set_accumulator("prev_grad", p, idx, gv_eff)
         self._set_accumulator("lrs", p, idx, lrs)
         return pv - lrs * jnp.sign(gv_eff)
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with optional strong-Wolfe line search (ref:
+    python/paddle/optimizer/lbfgs.py).
+
+    Closure-based full-batch optimizer: ``step(closure)`` re-evaluates
+    the loss (the closure must zero grads, run forward+backward and
+    return the loss).  State: last ``history_size`` (s, y) pairs driving
+    the two-loop recursion.  Runs eagerly (host-driven line search, like
+    the reference's python implementation) — jit the closure's forward
+    instead if step time matters.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if grad_clip is not None:
+            raise ValueError(
+                "LBFGS does not support grad_clip: clipping the gradient "
+                "would corrupt the curvature pairs the two-loop recursion "
+                "builds (the reference rejects it the same way)")
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+        self._max_iter = int(max_iter)
+        self._max_eval = (int(max_eval) if max_eval is not None
+                          else self._max_iter * 5 // 4)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or "
+                             "'strong_wolfe'")
+        self._line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._prev_flat_grad = None
+
+    # -- flat views ------------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _gather_flat_grad(self):
+        outs = []
+        wd = (self._regularization.coeff
+              if self._regularization is not None else 0.0)
+        for p in self._params():
+            g = p.grad._data if p.grad is not None else \
+                jnp.zeros_like(p._data)
+            if wd:   # L2 weight decay folds into the gradient
+                g = g + wd * p._data
+            outs.append(jnp.ravel(g).astype(jnp.float32))
+        return jnp.concatenate(outs)
+
+    def _set_flat_params(self, flat):
+        # the flat vector is float32 working precision; each param gets
+        # its own dtype back (mixed bf16/f32 models stay mixed)
+        off = 0
+        for p in self._params():
+            n = int(p._data.size)
+            p._data = flat[off:off + n].reshape(
+                p._data.shape).astype(p._data.dtype)
+            off += n
+
+    def _gather_flat_params(self):
+        return jnp.concatenate([jnp.ravel(p._data).astype(jnp.float32)
+                                for p in self._params()])
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion over the (s, y) history."""
+        q = -flat_grad
+        if not self._s_hist:
+            return q
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        s_l, y_l = self._s_hist[-1], self._y_hist[-1]
+        gamma = jnp.vdot(s_l, y_l) / jnp.maximum(jnp.vdot(y_l, y_l),
+                                                 1e-10)
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, r)
+            r = r + s * (a - b)
+        return r
+
+    def _eval(self, closure, flat_x):
+        self._set_flat_params(flat_x)
+        loss = closure()
+        return float(loss), self._gather_flat_grad()
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        lr = float(self.get_lr())
+        loss, flat_grad = float(closure()), self._gather_flat_grad()
+        n_eval = 1
+        for _ in range(self._max_iter):
+            if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+                break
+            d = self._direction(flat_grad)
+            x0 = self._gather_flat_params()
+            g0_d = float(jnp.vdot(flat_grad, d))
+            if g0_d > -1e-15:     # not a descent direction: reset
+                self._s_hist.clear()
+                self._y_hist.clear()
+                d = -flat_grad
+                g0_d = float(jnp.vdot(flat_grad, d))
+            t = lr
+            if self._line_search_fn == "strong_wolfe":
+                c1, c2 = 1e-4, 0.9
+                f0 = loss
+                t = lr
+                best = None
+                for _ls in range(10):
+                    f_t, g_t = self._eval(closure, x0 + t * d)
+                    n_eval += 1
+                    if f_t > f0 + c1 * t * g0_d:
+                        t *= 0.5
+                        continue
+                    if abs(float(jnp.vdot(g_t, d))) > -c2 * g0_d:
+                        best = (f_t, g_t, t)
+                        t *= 2.0
+                        continue
+                    best = (f_t, g_t, t)
+                    break
+                if best is None:
+                    f_t, g_t = self._eval(closure, x0 + t * d)
+                    n_eval += 1
+                    best = (f_t, g_t, t)
+                new_loss, new_grad, t = best
+                x_new = x0 + t * d
+                self._set_flat_params(x_new)
+            else:
+                x_new = x0 + t * d
+                new_loss, new_grad = self._eval(closure, x_new)
+                n_eval += 1
+            s = x_new - x0
+            y = new_grad - flat_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self._history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if float(jnp.abs(s).max()) <= self._tol_change or \
+                    abs(new_loss - loss) <= self._tol_change:
+                loss, flat_grad = new_loss, new_grad
+                break
+            loss, flat_grad = new_loss, new_grad
+            if n_eval >= self._max_eval:
+                break
+        self.clear_grad()
+        from ..core.tensor import Tensor as _T
+        return _T(jnp.asarray(loss, jnp.float32))

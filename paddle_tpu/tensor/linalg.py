@@ -305,6 +305,37 @@ def householder_product(x, tau, name=None):
     return call_op(f, (x, tau), {}, op_name="householder_product")
 
 
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """ref: paddle.linalg.svd_lowrank — randomized low-rank SVD
+    (Halko et al. subspace iteration on a Gaussian sketch)."""
+    from .. import random_state
+    x = ensure_tensor(x)
+    n = x.shape[-1]
+    q = min(int(q), x.shape[-2], n)
+    key = random_state.next_key()
+    if M is not None:
+        M = ensure_tensor(M)
+
+    def f(a, *rest):
+        av = a - rest[0] if rest else a
+        import jax as _jax
+        omega = _jax.random.normal(key, a.shape[:-2] + (n, q),
+                                   dtype=av.dtype)
+        y = av @ omega
+        for _ in range(int(niter)):
+            y = av @ (jnp.swapaxes(av, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ av
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        u = qmat @ u_b
+        return u[..., :, :q], s[..., :q], \
+            jnp.swapaxes(vh, -1, -2)[..., :, :q]
+
+    args = (x,) + ((M,) if M is not None else ())
+    outs = call_op(f, args, {}, multi_out=True, op_name="svd_lowrank")
+    return outs[0], outs[1], outs[2]
+
+
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     x = ensure_tensor(x)
     m, n = x.shape[-2], x.shape[-1]

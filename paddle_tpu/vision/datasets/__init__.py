@@ -3,6 +3,7 @@ from .cifar import Cifar10, Cifar100
 from .flowers import Flowers
 from .folder import DatasetFolder, ImageFolder
 from .mnist import MNIST, FashionMNIST
+from .voc2012 import VOC2012
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
-           "DatasetFolder", "ImageFolder"]
+           "DatasetFolder", "ImageFolder", "VOC2012"]

@@ -1,0 +1,76 @@
+"""VOC2012 segmentation dataset (ref: python/paddle/vision/datasets/
+voc2012.py).
+
+Offline contract: the reference downloads the VOCtrainval archive into
+DATA_HOME; this environment has no egress, so ``data_file`` must point
+at a local copy of the tar (same layout: VOCdevkit/VOC2012/{JPEGImages,
+SegmentationClass,ImageSets/Segmentation}).
+"""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["VOC2012"]
+
+# the reference's MODE_FLAG_MAP: train iterates trainval, test the
+# train list, valid the val list (voc2012.py upstream)
+_SETS = {"train": "trainval", "valid": "val", "test": "train"}
+
+
+class VOC2012(Dataset):
+    """ref: VOC2012(mode='train'|'valid'|'test', transform=...) yielding
+    (image, label-mask) pairs."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="numpy"):
+        if mode not in _SETS:
+            raise ValueError(f"mode must be one of {sorted(_SETS)}")
+        if backend not in ("numpy", "pil", "cv2"):
+            raise ValueError(f"unsupported backend {backend!r}")
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "paddle.vision.datasets.VOC2012: no network egress — pass "
+                "data_file= pointing at a local VOCtrainval tar (the "
+                "reference caches the same archive in DATA_HOME)")
+        self.data_file = data_file
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        self._tar = tarfile.open(data_file)
+        names = {m.name for m in self._tar.getmembers()}
+        root = "VOCdevkit/VOC2012"
+        split = f"{root}/ImageSets/Segmentation/{_SETS[mode]}.txt"
+        if split not in names:
+            raise ValueError(f"archive has no {split}")
+        ids = self._tar.extractfile(split).read().decode().split()
+        self._items = [
+            (f"{root}/JPEGImages/{i}.jpg",
+             f"{root}/SegmentationClass/{i}.png")
+            for i in ids
+            if f"{root}/JPEGImages/{i}.jpg" in names
+            and f"{root}/SegmentationClass/{i}.png" in names]
+
+    def _load(self, name):
+        from PIL import Image
+        data = self._tar.extractfile(name).read()
+        return Image.open(io.BytesIO(data))
+
+    def __getitem__(self, idx):
+        img_name, mask_name = self._items[idx]
+        img = self._load(img_name).convert("RGB")
+        mask = self._load(mask_name)
+        if self.backend in ("numpy", "cv2"):
+            img = np.asarray(img)
+        mask = np.asarray(mask)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._items)

@@ -1,0 +1,249 @@
+"""Breadth parity batch: inference predictor (L8), device topology (L0),
+error taxonomy, LBFGS, TCPStore, rank-aware log_util, VOC dataset,
+svd_lowrank."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import InputSpec
+
+
+# ---------------------------------------------------------------------------
+# inference predictor
+# ---------------------------------------------------------------------------
+
+def test_inference_predictor_roundtrip(tmp_path, rng):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    net.eval()
+    x = rng.randn(2, 4).astype("float32")
+    want = net(Tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 4], "float32", "x")])
+
+    from paddle_tpu import inference
+    cfg = inference.Config(prefix)
+    assert cfg.prog_file().endswith(".pdmodel")
+    pred = inference.create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    # dynamic batch honored
+    x8 = rng.randn(8, 4).astype("float32")
+    outs = pred.run([x8])
+    assert outs[0].shape == (8, 3)
+
+
+def test_inference_mixed_precision_convert(tmp_path, rng):
+    paddle.seed(1)
+    net = nn.Linear(4, 4)
+    net.eval()
+    prefix = str(tmp_path / "m32")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 4], "float32", "x")])
+    from paddle_tpu import inference
+    dst = str(tmp_path / "m16")
+    inference.convert_to_mixed_precision(
+        prefix, dst, mixed_precision=inference.PrecisionType.Bfloat16)
+    pred = inference.create_predictor(inference.Config(dst))
+    x = rng.randn(2, 4).astype("float32")
+    out = pred.run([x])[0]
+    want = net(Tensor(x)).numpy()
+    np.testing.assert_allclose(out, want, rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# device topology / errors
+# ---------------------------------------------------------------------------
+
+def test_device_topology_query():
+    topo = paddle.device.get_device_topology()
+    assert len(topo) == 8
+    assert all(t["platform"] == "cpu" for t in topo)
+    assert sorted(t["id"] for t in topo) == list(range(8))
+
+
+def test_error_taxonomy():
+    E = paddle.errors
+    with pytest.raises(E.InvalidArgumentError):
+        E.enforce_eq(1, 2)
+    # typed errors stay catchable as builtins
+    with pytest.raises(ValueError):
+        E.enforce_eq(1, 2)
+    with pytest.raises(E.EnforceNotMet):
+        E.enforce(False, "nope")
+    with pytest.raises(E.NotFoundError):
+        E.enforce_not_none(None)
+    assert E.enforce_not_none(5) == 5
+    assert issubclass(E.UnimplementedError, NotImplementedError)
+    assert issubclass(E.OutOfRangeError, IndexError)
+
+
+# ---------------------------------------------------------------------------
+# LBFGS
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("line_search", [None, "strong_wolfe"])
+def test_lbfgs_converges_rosenbrock_quadratic(line_search):
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([3.0, -2.0], "float32"))
+    w.stop_gradient = False
+    target = np.array([1.0, 2.0], "float32")
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=20,
+                                 history_size=10,
+                                 line_search_fn=line_search,
+                                 parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = ((w - Tensor(target)) ** 2).sum() \
+            + 0.5 * ((w[0] * w[1]) ** 2)
+        loss.backward()
+        return loss
+
+    l0 = float(closure())
+    for _ in range(5):
+        loss = opt.step(closure)
+    # the coupling term makes the true optimum nonzero: assert
+    # convergence to a STATIONARY point with a big loss drop
+    assert float(loss) < l0 * 0.05, (l0, float(loss))
+    closure()
+    assert float(np.abs(w.grad.numpy()).max()) < 1e-2
+
+
+def test_lbfgs_beats_sgd_on_quadratic():
+    """curvature exploitation: LBFGS reaches the optimum of an
+    ill-conditioned quadratic far faster than first-order steps."""
+    rs = np.random.RandomState(0)
+    A = rs.randn(6, 6).astype("float32")
+    H = A @ A.T + 0.1 * np.eye(6, dtype="float32")
+    b = rs.randn(6).astype("float32")
+    w = paddle.to_tensor(np.zeros(6, "float32"))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=25,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = 0.5 * (w.reshape([1, 6]) @ Tensor(H)
+                      @ w.reshape([6, 1])).sum() - (Tensor(b) * w).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    w_star = np.linalg.solve(H, b)
+    np.testing.assert_allclose(w.numpy(), w_star, rtol=1e-2, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+
+def test_tcpstore_kv_and_wait():
+    import threading
+    from paddle_tpu.distributed import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                      timeout=5.0)
+    client = TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=2, timeout=5.0)
+    master.set("k", b"v1")
+    assert client.get("k") == b"v1"
+    assert client.add("ctr", 2) == 2
+    assert master.add("ctr", 3) == 5
+
+    hits = []
+
+    def waiter():
+        client.wait(["late"])
+        hits.append(client.get("late"))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.2)
+    master.set("late", "now")
+    t.join(timeout=5)
+    assert hits == [b"now"]
+    master.delete_key("k")
+    with pytest.raises(TimeoutError):
+        short = TCPStore("127.0.0.1", master.port, timeout=0.5)
+        short.get("k")
+
+
+# ---------------------------------------------------------------------------
+# log_util
+# ---------------------------------------------------------------------------
+
+def test_log_util_rank_aware(capsys, monkeypatch):
+    from paddle_tpu.distributed.fleet.utils import log_util
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    log_util.set_log_level("DEBUG")
+    assert log_util.get_log_level_name() == "DEBUG"
+    log_util.logger.info("hello fleet")
+    err = capsys.readouterr().err
+    assert "rank:3" in err and "hello fleet" in err
+    assert log_util.layer_to_str("Linear", 4, 8, bias=True) == \
+        "Linear(4, 8, bias=True)"
+    log_util.set_log_level("INFO")
+
+
+# ---------------------------------------------------------------------------
+# VOC2012 + svd_lowrank
+# ---------------------------------------------------------------------------
+
+def _fake_voc_tar(path):
+    from PIL import Image
+    root = "VOCdevkit/VOC2012"
+    with tarfile.open(path, "w") as tf:
+        ids = ["0001", "0002"]
+        split = "\n".join(ids).encode()
+        # mode='train' reads trainval.txt (the reference's MODE_FLAG_MAP)
+        info = tarfile.TarInfo(
+            f"{root}/ImageSets/Segmentation/trainval.txt")
+        info.size = len(split)
+        tf.addfile(info, io.BytesIO(split))
+        for i in ids:
+            for sub, mode in (("JPEGImages", "RGB"),
+                              ("SegmentationClass", "P")):
+                ext = "jpg" if sub == "JPEGImages" else "png"
+                img = Image.new(mode, (12, 10))
+                buf = io.BytesIO()
+                img.save(buf, "JPEG" if ext == "jpg" else "PNG")
+                data = buf.getvalue()
+                ti = tarfile.TarInfo(f"{root}/{sub}/{i}.{ext}")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+
+
+def test_voc2012_local_archive(tmp_path):
+    tar = str(tmp_path / "voc.tar")
+    _fake_voc_tar(tar)
+    ds = paddle.vision.datasets.VOC2012(data_file=tar, mode="train")
+    assert len(ds) == 2
+    img, mask = ds[0]
+    assert img.shape == (10, 12, 3) and mask.shape == (10, 12)
+    with pytest.raises(FileNotFoundError):
+        paddle.vision.datasets.VOC2012(data_file=None)
+
+
+def test_svd_lowrank(rng):
+    # a genuinely low-rank matrix is recovered to high accuracy
+    u = rng.randn(20, 3).astype("float32")
+    v = rng.randn(3, 15).astype("float32")
+    a = u @ v
+    U, S, V = paddle.linalg.svd_lowrank(Tensor(a), q=5, niter=3)
+    approx = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+    np.testing.assert_allclose(approx, a, rtol=1e-3, atol=1e-3)
+    assert S.shape == [5]
