@@ -18,10 +18,27 @@ class _RankFormatter(logging.Formatter):
         return super().format(record)
 
 
+class _StderrHandler(logging.StreamHandler):
+    """Resolves sys.stderr at EMIT time — a handler bound at import time
+    would keep writing to the original stream after a redirect (pytest
+    capsys, launcher log files)."""
+
+    def __init__(self):
+        super().__init__(sys.stderr)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # base __init__/setStream assign it; ignore
+        pass
+
+
 def _build_logger() -> logging.Logger:
     lg = logging.getLogger("paddle.distributed.fleet")
     if not lg.handlers:
-        h = logging.StreamHandler(sys.stderr)
+        h = _StderrHandler()
         h.setFormatter(_RankFormatter(
             "%(levelname)s %(asctime)s rank:%(rank)s %(message)s",
             datefmt="%Y-%m-%d %H:%M:%S"))

@@ -30,6 +30,13 @@ def _build_env(args) -> dict:
     if args.devices:
         env["FLAGS_selected_tpus"] = args.devices
         env["FLAGS_selected_gpus"] = args.devices
+    # make the framework importable in the worker even when it isn't
+    # pip-installed (torchrun-style sys.path propagation)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    pp = env.get("PYTHONPATH", "")
+    if pkg_root not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + pp) if pp else pkg_root
     eps = env.get("PADDLE_TRAINER_ENDPOINTS")
     if not eps and args.master:
         host, _, port = args.master.partition(":")

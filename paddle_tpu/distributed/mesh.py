@@ -74,6 +74,14 @@ def ensure_mesh(axes: Optional[Dict[str, int]] = None) -> Mesh:
 def reset_mesh():
     global _global_mesh
     _global_mesh = None
+    # the auto_parallel ProcessMesh global mirrors this one (its
+    # set_mesh writes both) — clearing only one leaves a stale mesh for
+    # Engine/get_mesh() callers
+    try:
+        from .auto_parallel import api as _ap_api
+        _ap_api._auto_mesh = None
+    except ImportError:  # auto_parallel not imported yet
+        pass
 
 
 def in_axis_scope(axis_name) -> bool:

@@ -71,7 +71,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse rides in a (bh, sq, 1) buffer: Mosaic requires the last two
+    # block dims to be (8k, 128k) or equal to the array dims, which a
+    # (1, block_q) block over (bh, sq) can never satisfy
+    lse_ref[0] = m + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
@@ -81,7 +84,19 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     grid = (bh, pl.cdiv(sq, block_q))
-    out, lse = pl.pallas_call(
+    # trace under x64-off: the framework enables global x64 (paddle's
+    # int64 default), which makes index-map literals trace as i64 —
+    # Mosaic only legalizes i32, and everything in these kernels is
+    # explicitly typed anyway
+    with jax.enable_x64(False):
+        out, lse = _fwd_call(q, k, v, scale, causal, block_q, block_k,
+                             interpret, bh, sq, sk, d, grid)
+    return out, lse[..., 0]
+
+
+def _fwd_call(q, k, v, scale, causal, block_q, block_k, interpret,
+              bh, sq, sk, d, grid):
+    return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_k=sk),
         grid=grid,
@@ -92,15 +107,14 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -113,8 +127,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]                                   # [BQ, 1]
+    delta = delta_ref[0]                               # [BQ, 1]
     bq, d = q.shape
 
     hi = (jnp.int32(seq_k) if not causal
@@ -161,8 +175,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.float32) * scale
             do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
                 jnp.float32)
-            lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-            delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+            lse = lse_ref[0, pl.ds(i * block_q, block_q), :]    # [BQ, 1]
+            delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
             s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
             if causal:
                 q_idx = i * block_q + jax.lax.broadcasted_iota(
@@ -198,7 +212,16 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool,
     block_k = min(block_k, sk)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)                           # [BH, SQ]
+    # 3-D (bh, sq, 1) buffers for the same Mosaic tiling reason as fwd
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+    with jax.enable_x64(False):   # see _flash_fwd
+        return _bwd_calls(q, k, v, do, lse3, delta3, scale, causal,
+                          block_q, block_k, interpret, bh, sq, sk, d)
 
+
+def _bwd_calls(q, k, v, do, lse3, delta3, scale, causal, block_q, block_k,
+               interpret, bh, sq, sk, d):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_k=sk),
@@ -208,13 +231,13 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool,
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -225,8 +248,8 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -237,7 +260,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool,
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
     return dq, dk, dv
 
 
