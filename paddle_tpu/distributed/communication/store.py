@@ -109,61 +109,53 @@ class TCPStore(Store):
         try:
             while True:
                 parts = _recv_msg(conn)
-                op = parts[0].decode()
-                if op == "set":
-                    with self._cv:
-                        self._data[parts[1].decode()] = parts[2]
-                        self._cv.notify_all()
-                    _send_msg(conn, b"ok")
-                elif op == "get":
-                    key = parts[1].decode()
-                    # the client transmits ITS timeout so the server
-                    # always answers before the client's socket deadline
-                    # (a late reply would desynchronize the connection)
-                    deadline = time.time() + float(parts[2].decode())
-                    with self._cv:
-                        while key not in self._data:
-                            left = deadline - time.time()
-                            if left <= 0 or not self._cv.wait(left):
-                                break
-                        val = self._data.get(key)
-                    if val is None:
-                        _send_msg(conn, b"err", b"timeout")
-                    else:
-                        _send_msg(conn, b"ok", val)
-                elif op == "add":
-                    key = parts[1].decode()
-                    amt = int(parts[2].decode())
-                    with self._cv:
-                        cur = int(self._data.get(key, b"0").decode() or 0)
-                        cur += amt
-                        self._data[key] = str(cur).encode()
-                        self._cv.notify_all()
-                    _send_msg(conn, b"ok", str(cur).encode())
-                elif op == "wait":
-                    keys = [k.decode() for k in parts[2:]]
-                    deadline = time.time() + float(parts[1].decode())
-                    ok = True
-                    with self._cv:
-                        for k in keys:
-                            while k not in self._data:
-                                left = deadline - time.time()
-                                if left <= 0 or not self._cv.wait(left):
-                                    ok = False
-                                    break
-                            if not ok:
-                                break
-                    _send_msg(conn, b"ok" if ok else b"err")
-                elif op == "del":
-                    with self._cv:
-                        self._data.pop(parts[1].decode(), None)
-                    _send_msg(conn, b"ok")
-                else:
-                    _send_msg(conn, b"err", b"bad op")
-        except (ConnectionError, OSError):
+                # per-request fault isolation: a malformed request (bad
+                # int, missing field) must answer an error and keep the
+                # connection alive, not kill the handler thread and
+                # poison every later op on this client
+                try:
+                    reply = self._dispatch(parts)
+                except Exception as e:
+                    reply = (b"exc", repr(e).encode())
+                _send_msg(conn, *reply)
+        except (ConnectionError, OSError, struct.error):
             pass
         finally:
             conn.close()
+
+    def _dispatch(self, parts):
+        """One request → reply tuple.  All ops answer IMMEDIATELY —
+        blocking semantics (get-until-set, wait) live in the CLIENT as
+        poll loops, so one thread's wait can never hold the socket while
+        another thread's set would satisfy it."""
+        op = parts[0].decode()
+        if op == "set":
+            with self._cv:
+                self._data[parts[1].decode()] = parts[2]
+                self._cv.notify_all()
+            return (b"ok",)
+        if op == "get":
+            with self._cv:
+                val = self._data.get(parts[1].decode())
+            return (b"ok", val) if val is not None else (b"miss",)
+        if op == "add":
+            key = parts[1].decode()
+            amt = int(parts[2].decode())
+            with self._cv:
+                cur = int(self._data.get(key, b"0").decode() or 0)
+                cur += amt
+                self._data[key] = str(cur).encode()
+                self._cv.notify_all()
+            return (b"ok", str(cur).encode())
+        if op == "check":
+            with self._cv:
+                ok = all(k.decode() in self._data for k in parts[1:])
+            return (b"ok",) if ok else (b"miss",)
+        if op == "del":
+            with self._cv:
+                self._data.pop(parts[1].decode(), None)
+            return (b"ok",)
+        return (b"exc", f"bad op {op!r}".encode())
 
     # -- client ----------------------------------------------------------
     def _connect(self):
@@ -181,18 +173,16 @@ class TCPStore(Store):
         raise ConnectionError(
             f"cannot reach TCPStore at {self._host}:{self._port}: {last}")
 
-    def _rpc(self, *parts: bytes, timeout: Optional[float] = None):
+    _POLL_S = 0.05
+
+    def _rpc(self, *parts: bytes):
         with self._sock_lock:
-            if timeout is not None:
-                # give the server margin to answer with its own timeout
-                # error instead of racing the socket deadline
-                self._sock.settimeout(timeout + 5.0)
-            try:
-                _send_msg(self._sock, *parts)
-                return _recv_msg(self._sock)
-            finally:
-                if timeout is not None:
-                    self._sock.settimeout(self._timeout)
+            _send_msg(self._sock, *parts)
+            resp = _recv_msg(self._sock)
+        if resp and resp[0] == b"exc":
+            raise RuntimeError(
+                f"TCPStore server error: {resp[1].decode(errors='replace')}")
+        return resp
 
     # -- API (ref signatures) --------------------------------------------
     def set(self, key: str, value) -> None:
@@ -202,10 +192,14 @@ class TCPStore(Store):
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         t = float(timeout if timeout is not None else self._timeout)
-        resp = self._rpc(b"get", key.encode(), str(t).encode(), timeout=t)
-        if resp[0] != b"ok":
-            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
-        return resp[1]
+        deadline = time.time() + t
+        while True:
+            resp = self._rpc(b"get", key.encode())
+            if resp[0] == b"ok":
+                return resp[1]
+            if time.time() >= deadline:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            time.sleep(self._POLL_S)
 
     def add(self, key: str, amount: int = 1) -> int:
         resp = self._rpc(b"add", key.encode(), str(int(amount)).encode())
@@ -215,10 +209,15 @@ class TCPStore(Store):
         if isinstance(keys, str):
             keys = [keys]
         t = float(timeout if timeout is not None else self._timeout)
-        resp = self._rpc(b"wait", str(t).encode(),
-                         *[k.encode() for k in keys], timeout=t)
-        if resp[0] != b"ok":
-            raise TimeoutError(f"TCPStore.wait({keys}) timed out")
+        deadline = time.time() + t
+        enc = [k.encode() for k in keys]
+        while True:
+            resp = self._rpc(b"check", *enc)
+            if resp[0] == b"ok":
+                return
+            if time.time() >= deadline:
+                raise TimeoutError(f"TCPStore.wait({keys}) timed out")
+            time.sleep(self._POLL_S)
 
     def delete_key(self, key: str) -> None:
         self._rpc(b"del", key.encode())

@@ -187,6 +187,10 @@ class Predictor:
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """ZeroCopyRun: feed handles (or positional arrays) → outputs."""
         if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs, model expects "
+                    f"{len(self._input_names)} ({self._input_names})")
             for n, a in zip(self._input_names, inputs):
                 self._feed[n] = np.asarray(a)
         missing = [n for n in self._input_names if n not in self._feed]
@@ -225,9 +229,14 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
     from ..framework.io import load as pload, save as psave
     import shutil
     meta = pload(src_prefix + ".pdiparams")
-    dt = {"float16": np.float16, "bfloat16": jnp.bfloat16,
-          PrecisionType.Half: np.float16,
-          PrecisionType.Bfloat16: jnp.bfloat16}[mixed_precision]
+    table = {"float16": np.float16, "bfloat16": jnp.bfloat16,
+             PrecisionType.Half: np.float16,
+             PrecisionType.Bfloat16: jnp.bfloat16}
+    if mixed_precision not in table:
+        raise ValueError(
+            f"unsupported mixed_precision {mixed_precision!r}: only "
+            f"float16/bfloat16 make sense as mixed inference dtypes")
+    dt = table[mixed_precision]
     params = [np.asarray(a) for a in meta["params"]]
     meta["params"] = [
         np.asarray(jnp.asarray(a).astype(dt))

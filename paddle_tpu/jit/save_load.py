@@ -156,10 +156,12 @@ def load(path: str, params_path: Optional[str] = None,
     # convert_to_mixed_precision) are widened back to the exported
     # computation's expected dtypes.  in_avals is FLAT over
     # (param_tuple, *inputs): the leading len(params) avals are params.
-    try:
-        param_avals = exported.in_avals[:len(params)]
-        params = [p.astype(a.dtype) if p.dtype != a.dtype else p
-                  for p, a in zip(params, param_avals)]
-    except Exception:
-        pass
+    if len(params) > len(exported.in_avals):
+        raise ValueError(
+            f"params file carries {len(params)} arrays but the exported "
+            f"computation only takes {len(exported.in_avals)} — model and "
+            f"params files do not belong together")
+    param_avals = exported.in_avals[:len(params)]
+    params = [p.astype(a.dtype) if p.dtype != a.dtype else p
+              for p, a in zip(params, param_avals)]
     return TranslatedLayer(exported, params, bool(meta.get("multi")))

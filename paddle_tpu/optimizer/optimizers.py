@@ -388,13 +388,12 @@ class LBFGS(Optimizer):
 
     def _gather_flat_grad(self):
         outs = []
-        wd = (self._regularization.coeff
-              if self._regularization is not None else 0.0)
         for p in self._params():
             g = p.grad._data if p.grad is not None else \
                 jnp.zeros_like(p._data)
-            if wd:   # L2 weight decay folds into the gradient
-                g = g + wd * p._data
+            if self._regularization is not None:
+                # same L1/L2 semantics as the base optimizer path
+                g = self._apply_regularization(p, g, {})
             outs.append(jnp.ravel(g).astype(jnp.float32))
         return jnp.concatenate(outs)
 
@@ -464,14 +463,19 @@ class LBFGS(Optimizer):
                     f_t, g_t = self._eval(closure, x0 + t * d)
                     n_eval += 1
                     if f_t > f0 + c1 * t * g0_d:
-                        t *= 0.5
+                        t *= 0.5       # Armijo fails: too far
                         continue
-                    if abs(float(jnp.vdot(g_t, d))) > -c2 * g0_d:
+                    gt_d = float(jnp.vdot(g_t, d))
+                    if abs(gt_d) <= -c2 * g0_d:
                         best = (f_t, g_t, t)
-                        t *= 2.0
-                        continue
-                    best = (f_t, g_t, t)
-                    break
+                        break          # strong Wolfe satisfied
+                    # Armijo holds, curvature violated: keep the best
+                    # Armijo point and move toward the minimum — a
+                    # positive slope means we OVERSHOT it, so shrink
+                    # (doubling there would walk further away)
+                    if best is None or f_t < best[0]:
+                        best = (f_t, g_t, t)
+                    t = t * 0.5 if gt_d > 0 else t * 2.0
                 if best is None:
                     f_t, g_t = self._eval(closure, x0 + t * d)
                     n_eval += 1

@@ -182,6 +182,41 @@ def test_tcpstore_kv_and_wait():
         short.get("k")
 
 
+def test_tcpstore_wait_and_set_same_instance():
+    """A blocking wait() must not starve a concurrent set() on the SAME
+    store instance (the reference's barrier pattern)."""
+    import threading
+    from paddle_tpu.distributed import TCPStore
+    store = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    done = []
+
+    def waiter():
+        store.wait(["self_k"], timeout=5.0)
+        done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.2)
+    store.set("self_k", b"x")     # same instance, same socket
+    t.join(timeout=5)
+    assert done == [True]
+
+
+def test_tcpstore_survives_malformed_request():
+    """A bad request (non-integer counter) answers an error and leaves
+    the connection usable — it must not kill the handler thread."""
+    from paddle_tpu.distributed import TCPStore
+    store = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    store.set("ctr", b"abc")
+    with pytest.raises(RuntimeError, match="server error"):
+        store.add("ctr", 1)
+    # connection still alive and consistent
+    store.set("ctr", b"3")
+    assert store.add("ctr", 1) == 4
+    assert store.get("ctr") == b"4"
+
+
 # ---------------------------------------------------------------------------
 # log_util
 # ---------------------------------------------------------------------------
