@@ -180,6 +180,9 @@ def run_bench():
         "preset": preset,
         "n_params": n_params,
         "batch": batch, "seq": seq, "steps": steps,
+        "pallas_attention": bool(
+            __import__("paddle_tpu.flags", fromlist=["get_flag"])
+            .get_flag("use_pallas_attention")),
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
@@ -251,10 +254,13 @@ def main():
             print(json.dumps(out))
             return
         errors["tpu"] = err
-        # retry smaller + cache off: a skewed persistent/compile cache or
-        # a slow tunnel must not zero the round
+        # retry smaller + cache off + NO custom Pallas kernels: a skewed
+        # persistent/compile cache, a slow tunnel, or a Mosaic lowering
+        # failure in the flash kernel must not zero the round — the XLA
+        # attention path always compiles
         retry_env = {"BENCH_PRESET": "gpt3-350M", "BENCH_STEPS": "3",
                      "BENCH_SEQ": "1024",
+                     "FLAGS_use_pallas_attention": "0",
                      "JAX_ENABLE_COMPILATION_CACHE": "false"}
         line, err = _run_child(retry_env, min(t_tpu, 240))
         if line:

@@ -9,9 +9,16 @@ scripts that rendezvous custom state through paddle.distributed.TCPStore
 serves a tiny length-prefixed TCP protocol; peers connect as clients.
 The wire protocol is private; the API (get/set/add/wait/delete_key) is
 the reference's.
+
+Like the reference, the store itself is NATIVE: server and client come
+from the C++ runtime layer (paddle_tpu/native/csrc/store.cc) when the
+toolchain is available, with this module's pure-Python implementation
+as the fallback.  Both speak the same wire protocol, so a C++ server
+serves Python clients and vice versa (covered by tests).
 """
 from __future__ import annotations
 
+import ctypes
 import socket
 import struct
 import threading
@@ -79,11 +86,30 @@ class TCPStore(Store):
         # client is still holding its socket lock
         self._cv = threading.Condition(threading.Lock())
         self._sock_lock = threading.Lock()
+        self._nlock = threading.Lock()  # atomicity of two-phase native get
         self._server = None
         self._sock = None
+        self._nlib = None     # native C++ backend (see module docstring)
+        self._nsrv = None
+        self._ncli = None
+        from ...native import lib as _native_lib
+        self._nlib = _native_lib()
         if self._is_master:
-            self._start_server()
-        self._connect()
+            started = False
+            if self._nlib is not None:
+                port = ctypes.c_int(0)
+                h = self._nlib.pd_store_server_start(
+                    self._host.encode(), self._port, ctypes.byref(port))
+                if h:
+                    self._nsrv = h
+                    self._port = port.value
+                    started = True
+            if not started:
+                self._start_server()
+        if self._nlib is not None:
+            self._connect_native()
+        if self._ncli is None:
+            self._connect()
 
     # -- server ----------------------------------------------------------
     def _start_server(self):
@@ -158,6 +184,18 @@ class TCPStore(Store):
         return (b"exc", f"bad op {op!r}".encode())
 
     # -- client ----------------------------------------------------------
+    def _connect_native(self):
+        deadline = time.time() + self._timeout
+        while time.time() < deadline:
+            h = self._nlib.pd_store_client_connect(
+                self._host.encode(), self._port,
+                ctypes.c_double(self._timeout))
+            if h:
+                self._ncli = h
+                return
+            time.sleep(0.1)
+        # fall through to the python client's own retry/raise
+
     def _connect(self):
         deadline = time.time() + self._timeout
         last = None
@@ -184,43 +222,104 @@ class TCPStore(Store):
                 f"TCPStore server error: {resp[1].decode(errors='replace')}")
         return resp
 
+    @staticmethod
+    def _ncheck(rc: int, what: str):
+        if rc == -1:
+            raise ConnectionError(f"TCPStore.{what}: connection lost")
+        if rc == -2:
+            raise RuntimeError(f"TCPStore server error in {what}")
+
+    # -- single-shot primitives (native or python, identical semantics) --
+    def _prim_set(self, key: str, value: bytes):
+        if self._ncli is not None:
+            buf = (ctypes.c_uint8 * max(len(value), 1))(*value)
+            self._ncheck(self._nlib.pd_store_set(
+                self._ncli, key.encode(), buf, len(value)), "set")
+            return
+        self._rpc(b"set", key.encode(), value)
+
+    def _prim_get(self, key: str) -> Optional[bytes]:
+        if self._ncli is not None:
+            # the rpc + copy pair must be atomic: a concurrent get on
+            # this store would overwrite the client's stashed value
+            with self._nlock:
+                ln = self._nlib.pd_store_get(self._ncli, key.encode())
+                if ln == -3:
+                    return None
+                self._ncheck(ln, "get")
+                buf = (ctypes.c_uint8 * max(int(ln), 1))()
+                got = self._nlib.pd_store_copy_value(self._ncli, buf, ln)
+            if got != ln:
+                raise RuntimeError(
+                    f"TCPStore.get({key!r}): value copy-out returned "
+                    f"{got}, expected {ln}")
+            return bytes(buf[:int(ln)])
+        resp = self._rpc(b"get", key.encode())
+        return resp[1] if resp[0] == b"ok" else None
+
+    def _prim_add(self, key: str, amount: int) -> int:
+        if self._ncli is not None:
+            rc = ctypes.c_int(0)
+            out = self._nlib.pd_store_add(self._ncli, key.encode(),
+                                          int(amount), ctypes.byref(rc))
+            self._ncheck(rc.value, "add")
+            return int(out)
+        resp = self._rpc(b"add", key.encode(), str(int(amount)).encode())
+        return int(resp[1].decode())
+
+    def _prim_check(self, keys) -> bool:
+        if self._ncli is not None:
+            arr = (ctypes.c_char_p * len(keys))(
+                *[k.encode() for k in keys])
+            rc = self._nlib.pd_store_check(self._ncli, arr, len(keys))
+            self._ncheck(rc, "wait")
+            return rc == 1
+        resp = self._rpc(b"check", *[k.encode() for k in keys])
+        return resp[0] == b"ok"
+
     # -- API (ref signatures) --------------------------------------------
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
-        self._rpc(b"set", key.encode(), bytes(value))
+        self._prim_set(key, bytes(value))
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         t = float(timeout if timeout is not None else self._timeout)
         deadline = time.time() + t
         while True:
-            resp = self._rpc(b"get", key.encode())
-            if resp[0] == b"ok":
-                return resp[1]
+            val = self._prim_get(key)
+            if val is not None:
+                return val
             if time.time() >= deadline:
                 raise TimeoutError(f"TCPStore.get({key!r}) timed out")
             time.sleep(self._POLL_S)
 
     def add(self, key: str, amount: int = 1) -> int:
-        resp = self._rpc(b"add", key.encode(), str(int(amount)).encode())
-        return int(resp[1].decode())
+        return self._prim_add(key, amount)
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         if isinstance(keys, str):
             keys = [keys]
         t = float(timeout if timeout is not None else self._timeout)
         deadline = time.time() + t
-        enc = [k.encode() for k in keys]
         while True:
-            resp = self._rpc(b"check", *enc)
-            if resp[0] == b"ok":
+            if self._prim_check(keys):
                 return
             if time.time() >= deadline:
                 raise TimeoutError(f"TCPStore.wait({keys}) timed out")
             time.sleep(self._POLL_S)
 
     def delete_key(self, key: str) -> None:
+        if self._ncli is not None:
+            self._ncheck(self._nlib.pd_store_del(self._ncli, key.encode()),
+                         "delete_key")
+            return
         self._rpc(b"del", key.encode())
+
+    @property
+    def is_native(self) -> bool:
+        """True when the C++ runtime backs this store's client."""
+        return self._ncli is not None
 
     @property
     def port(self) -> int:
@@ -228,6 +327,10 @@ class TCPStore(Store):
 
     def __del__(self):
         try:
+            if self._ncli is not None:
+                self._nlib.pd_store_client_close(self._ncli)
+            if self._nsrv is not None:
+                self._nlib.pd_store_server_stop(self._nsrv)
             if self._sock is not None:
                 self._sock.close()
             if self._server is not None:

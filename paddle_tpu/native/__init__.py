@@ -1,0 +1,123 @@
+"""Native runtime layer — C++ host-side components.
+
+The reference implements its runtime services in C++ (TCPStore:
+paddle/phi/core/distributed/store/tcp_store.cc; NMS:
+paddle/phi/kernels/gpu/nms_kernel.cu; tokenization:
+paddle/fluid/operators/string/faster_tokenizer_op.cc).  The TPU compute
+path is JAX/XLA/Pallas, but these HOST-side services stay native here
+too: ``csrc/`` is compiled on demand with the same g++ JIT path as
+``paddle.utils.cpp_extension`` and loaded via ctypes.
+
+Every consumer keeps a pure-Python fallback (same observable behavior —
+the store even shares its wire protocol), so a missing toolchain
+degrades gracefully: ``lib()`` returns None and callers fall back.
+``PADDLE_DISABLE_NATIVE=1`` forces the fallback (used by tests to cover
+both paths).
+"""
+from __future__ import annotations
+
+import ctypes
+import glob
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+__all__ = ["lib", "available", "build"]
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build(verbose: bool = False) -> str:
+    """Compile csrc/*.cc into one shared library; returns its path.
+    Content-hashed cache: a source edit produces a new .so."""
+    srcs = sorted(glob.glob(os.path.join(_CSRC, "*.cc")))
+    if not srcs:
+        raise FileNotFoundError(f"no native sources under {_CSRC}")
+    tag = hashlib.sha1(
+        b"|".join(open(s, "rb").read() for s in srcs)).hexdigest()[:12]
+    path = os.path.join(_build_dir(), f"paddle_native-{tag}.so")
+    if os.path.exists(path):
+        return path
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+           + srcs + ["-o", path])
+    if verbose:
+        print("building native lib:", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    return path
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.pd_store_server_start.restype = c.c_void_p
+    lib.pd_store_server_start.argtypes = [c.c_char_p, c.c_int,
+                                          c.POINTER(c.c_int)]
+    lib.pd_store_server_stop.argtypes = [c.c_void_p]
+    lib.pd_store_client_connect.restype = c.c_void_p
+    lib.pd_store_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_double]
+    lib.pd_store_client_close.argtypes = [c.c_void_p]
+    lib.pd_store_set.restype = c.c_int
+    lib.pd_store_set.argtypes = [c.c_void_p, c.c_char_p,
+                                 c.POINTER(c.c_uint8), c.c_int64]
+    lib.pd_store_get.restype = c.c_int64
+    lib.pd_store_get.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pd_store_copy_value.restype = c.c_int64
+    lib.pd_store_copy_value.argtypes = [c.c_void_p, c.POINTER(c.c_uint8),
+                                        c.c_int64]
+    lib.pd_store_add.restype = c.c_longlong
+    lib.pd_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_longlong,
+                                 c.POINTER(c.c_int)]
+    lib.pd_store_check.restype = c.c_int
+    lib.pd_store_check.argtypes = [c.c_void_p,
+                                   c.POINTER(c.c_char_p), c.c_int]
+    lib.pd_store_del.restype = c.c_int
+    lib.pd_store_del.argtypes = [c.c_void_p, c.c_char_p]
+
+    lib.pd_nms.restype = c.c_int64
+    lib.pd_nms.argtypes = [c.POINTER(c.c_float), c.POINTER(c.c_float),
+                           c.c_int64, c.c_float, c.POINTER(c.c_int64)]
+
+    lib.pd_wp_new.restype = c.c_void_p
+    lib.pd_wp_new.argtypes = [c.POINTER(c.c_char_p), c.c_int64, c.c_char_p,
+                              c.c_int, c.c_int]
+    lib.pd_wp_free.argtypes = [c.c_void_p]
+    lib.pd_wp_tokenize.restype = c.c_int64
+    lib.pd_wp_tokenize.argtypes = [c.c_void_p, c.c_char_p,
+                                   c.POINTER(c.c_int64), c.c_int64]
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None when disabled
+    or the toolchain is unavailable (callers must fall back)."""
+    global _lib, _tried
+    if os.environ.get("PADDLE_DISABLE_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            _lib = _configure(ctypes.CDLL(build()))
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None

@@ -1,0 +1,63 @@
+// Native NMS — host-side greedy non-maximum suppression.
+//
+// The reference implements NMS as a native kernel
+// (ref: paddle/phi/kernels/gpu/nms_kernel.cu + cpu sibling).  On TPU
+// the data-dependent output size makes it a host op (see
+// vision/ops — the Python fallback documents why); this C++ version
+// removes the Python-loop cost for large detection batches.
+
+#include <algorithm>
+#include <cstdint>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+inline float iou(const float* a, const float* b) {
+  // boxes are [x1, y1, x2, y2]
+  const float ix1 = std::max(a[0], b[0]);
+  const float iy1 = std::max(a[1], b[1]);
+  const float ix2 = std::min(a[2], b[2]);
+  const float iy2 = std::min(a[3], b[3]);
+  const float iw = std::max(0.0f, ix2 - ix1);
+  const float ih = std::max(0.0f, iy2 - iy1);
+  const float inter = iw * ih;
+  const float area_a = std::max(0.0f, a[2] - a[0]) *
+                       std::max(0.0f, a[3] - a[1]);
+  const float area_b = std::max(0.0f, b[2] - b[0]) *
+                       std::max(0.0f, b[3] - b[1]);
+  const float uni = area_a + area_b - inter;
+  return uni > 0.0f ? inter / uni : 0.0f;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of kept boxes written to `keep` (indices into the
+// input, highest-score first).
+int64_t pd_nms(const float* boxes, const float* scores, int64_t n,
+               float iou_threshold, int64_t* keep) {
+  std::vector<int64_t> order(static_cast<size_t>(n));
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [scores](int64_t i, int64_t j) {
+                     return scores[i] > scores[j];
+                   });
+  std::vector<char> suppressed(static_cast<size_t>(n), 0);
+  int64_t nkeep = 0;
+  for (size_t oi = 0; oi < order.size(); ++oi) {
+    const int64_t i = order[oi];
+    if (suppressed[i]) continue;
+    keep[nkeep++] = i;
+    const float* bi = boxes + 4 * i;
+    for (size_t oj = oi + 1; oj < order.size(); ++oj) {
+      const int64_t j = order[oj];
+      if (suppressed[j]) continue;
+      if (iou(bi, boxes + 4 * j) > iou_threshold) suppressed[j] = 1;
+    }
+  }
+  return nkeep;
+}
+
+}  // extern "C"

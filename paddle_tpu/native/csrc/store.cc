@@ -1,0 +1,384 @@
+// Native TCPStore — C++ key-value rendezvous server + client.
+//
+// TPU-native counterpart of the reference's C++ TCPStore
+// (ref: paddle/phi/core/distributed/store/tcp_store.cc): the store is a
+// host-side runtime service, so it belongs in native code — the Python
+// implementation in distributed/communication/store.py is the fallback
+// and speaks the SAME wire protocol, so C++ servers serve Python
+// clients and vice versa.
+//
+// Wire protocol (shared with the Python impl — keep in sync):
+//   message  := u32be npart { u32be len, bytes }*
+//   request  := op, args...          (ops: set/get/add/check/del)
+//   reply    := "ok"[, payload] | "miss" | "exc", reason
+// All ops answer immediately; blocking wait/get are client-side poll
+// loops (one thread's wait must never starve another's set).
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// framing
+// ---------------------------------------------------------------------------
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool send_msg(int fd, const std::vector<std::string>& parts) {
+  std::string payload;
+  uint32_t n = htonl(static_cast<uint32_t>(parts.size()));
+  payload.append(reinterpret_cast<const char*>(&n), 4);
+  for (const auto& p : parts) {
+    uint32_t ln = htonl(static_cast<uint32_t>(p.size()));
+    payload.append(reinterpret_cast<const char*>(&ln), 4);
+    payload.append(p);
+  }
+  return send_all(fd, payload.data(), payload.size());
+}
+
+bool recv_msg(int fd, std::vector<std::string>* parts) {
+  uint32_t n = 0;
+  if (!recv_all(fd, &n, 4)) return false;
+  n = ntohl(n);
+  if (n > 1u << 16) return false;  // sanity: bounded part count
+  parts->clear();
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t ln = 0;
+    if (!recv_all(fd, &ln, 4)) return false;
+    ln = ntohl(ln);
+    if (ln > 1u << 30) return false;  // sanity: 1 GiB part cap
+    std::string part(ln, '\0');
+    if (ln && !recv_all(fd, part.data(), ln)) return false;
+    parts->push_back(std::move(part));
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// server
+// ---------------------------------------------------------------------------
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::mutex mu;
+  std::map<std::string, std::string> data;
+  std::mutex conn_mu;
+  std::vector<std::thread> handlers;
+  std::vector<int> conn_fds;
+
+  void handle(int fd) {
+    std::vector<std::string> parts;
+    while (!stopping.load() && recv_msg(fd, &parts)) {
+      std::vector<std::string> reply;
+      // per-request fault isolation: malformed input answers "exc" and
+      // keeps the connection alive (mirrors the Python server)
+      if (parts.empty()) {
+        reply = {"exc", "empty request"};
+      } else if (parts[0] == "set" && parts.size() == 3) {
+        {
+          std::lock_guard<std::mutex> g(mu);
+          data[parts[1]] = parts[2];
+        }
+        reply = {"ok"};
+      } else if (parts[0] == "get" && parts.size() == 2) {
+        std::lock_guard<std::mutex> g(mu);
+        auto it = data.find(parts[1]);
+        if (it == data.end())
+          reply = {"miss"};
+        else
+          reply = {"ok", it->second};
+      } else if (parts[0] == "add" && parts.size() == 3) {
+        long long amt = 0;
+        try {
+          amt = std::stoll(parts[2]);
+          std::lock_guard<std::mutex> g(mu);
+          long long cur = 0;
+          auto it = data.find(parts[1]);
+          if (it != data.end() && !it->second.empty())
+            cur = std::stoll(it->second);
+          cur += amt;
+          data[parts[1]] = std::to_string(cur);
+          reply = {"ok", std::to_string(cur)};
+        } catch (const std::exception& e) {
+          reply = {"exc", std::string("add: ") + e.what()};
+        }
+      } else if (parts[0] == "check") {
+        std::lock_guard<std::mutex> g(mu);
+        bool all = true;
+        for (size_t i = 1; i < parts.size(); ++i)
+          if (data.find(parts[i]) == data.end()) {
+            all = false;
+            break;
+          }
+        reply = {all ? "ok" : "miss"};
+      } else if (parts[0] == "del" && parts.size() == 2) {
+        {
+          std::lock_guard<std::mutex> g(mu);
+          data.erase(parts[1]);
+        }
+        reply = {"ok"};
+      } else {
+        reply = {"exc", "bad op '" + parts[0] + "'"};
+      }
+      if (!send_msg(fd, reply)) break;
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    while (!stopping.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        break;  // listen socket closed
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.push_back(fd);
+      handlers.emplace_back(&Server::handle, this, fd);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// client
+// ---------------------------------------------------------------------------
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one in-flight rpc per client connection
+  std::string last_value;  // stash for two-phase get (size, then copy)
+};
+
+int rpc(Client* c, const std::vector<std::string>& req,
+        std::vector<std::string>* resp) {
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_msg(c->fd, req)) return -1;
+  if (!recv_msg(c->fd, resp)) return -1;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- server ------------------------------------------------------------
+
+void* pd_store_server_start(const char* host, int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = srv->port;
+  srv->accept_thread = std::thread(&Server::accept_loop, srv);
+  return srv;
+}
+
+void pd_store_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  if (!srv) return;
+  srv->stopping.store(true);
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    // wake handlers blocked in recv on live client connections, then
+    // JOIN them — detaching would let them touch the freed Server
+    std::lock_guard<std::mutex> g(srv->conn_mu);
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : srv->handlers)
+    if (t.joinable()) t.join();
+  delete srv;
+}
+
+// -- client ------------------------------------------------------------
+
+void* pd_store_client_connect(const char* host, int port,
+                              double timeout_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  // non-blocking connect bounded by the CALLER's timeout — a plain
+  // ::connect would sit in the kernel's ~2min SYN timeout and blow way
+  // past it (the Python fallback honors the timeout; so must we)
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0) {
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return nullptr;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    int timeout_ms = static_cast<int>(timeout_s * 1000);
+    if (::poll(&pfd, 1, timeout_ms) <= 0) {
+      ::close(fd);
+      return nullptr;  // timed out (or poll error)
+    }
+    int err = 0;
+    socklen_t elen = sizeof(err);
+    ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+    if (err != 0) {
+      ::close(fd);
+      return nullptr;
+    }
+  }
+  ::fcntl(fd, F_SETFL, flags);  // back to blocking for send/recv
+  timeval tv{};
+  tv.tv_sec = static_cast<long>(timeout_s);
+  tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void pd_store_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c) return;
+  ::close(c->fd);
+  delete c;
+}
+
+// rc: 0 ok, -1 connection error, -2 server exc
+int pd_store_set(void* handle, const char* key, const uint8_t* val,
+                 int64_t n) {
+  auto* c = static_cast<Client*>(handle);
+  std::vector<std::string> resp;
+  if (rpc(c, {"set", key,
+              std::string(reinterpret_cast<const char*>(val),
+                          static_cast<size_t>(n))}, &resp) != 0)
+    return -1;
+  return (!resp.empty() && resp[0] == "ok") ? 0 : -2;
+}
+
+// Two-phase get: pd_store_get performs the rpc and returns the value
+// length (stashed on the client), -1 connection error, -2 server exc,
+// -3 missing; pd_store_copy_value copies the stash out.
+int64_t pd_store_get(void* handle, const char* key) {
+  auto* c = static_cast<Client*>(handle);
+  std::vector<std::string> resp;
+  if (rpc(c, {"get", key}, &resp) != 0) return -1;
+  if (resp.empty() || resp[0] == "exc") return -2;
+  if (resp[0] != "ok" || resp.size() < 2) return -3;
+  std::lock_guard<std::mutex> g(c->mu);
+  c->last_value = resp[1];
+  return static_cast<int64_t>(resp[1].size());
+}
+
+int64_t pd_store_copy_value(void* handle, uint8_t* buf, int64_t cap) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t n = static_cast<int64_t>(c->last_value.size());
+  if (n > cap) return -1;
+  if (n) std::memcpy(buf, c->last_value.data(), c->last_value.size());
+  return n;
+}
+
+long long pd_store_add(void* handle, const char* key, long long amount,
+                       int* rc) {
+  auto* c = static_cast<Client*>(handle);
+  std::vector<std::string> resp;
+  if (rpc(c, {"add", key, std::to_string(amount)}, &resp) != 0) {
+    if (rc) *rc = -1;
+    return 0;
+  }
+  if (resp.empty() || resp[0] != "ok" || resp.size() < 2) {
+    if (rc) *rc = -2;
+    return 0;
+  }
+  if (rc) *rc = 0;
+  return std::stoll(resp[1]);
+}
+
+// rc: 1 all present, 0 missing, -1 connection error, -2 server exc
+int pd_store_check(void* handle, const char** keys, int nkeys) {
+  auto* c = static_cast<Client*>(handle);
+  std::vector<std::string> req = {"check"};
+  for (int i = 0; i < nkeys; ++i) req.emplace_back(keys[i]);
+  std::vector<std::string> resp;
+  if (rpc(c, req, &resp) != 0) return -1;
+  if (resp.empty() || resp[0] == "exc") return -2;
+  return resp[0] == "ok" ? 1 : 0;
+}
+
+int pd_store_del(void* handle, const char* key) {
+  auto* c = static_cast<Client*>(handle);
+  std::vector<std::string> resp;
+  if (rpc(c, {"del", key}, &resp) != 0) return -1;
+  return (!resp.empty() && resp[0] == "ok") ? 0 : -2;
+}
+
+}  // extern "C"

@@ -1,0 +1,122 @@
+// Native WordPiece tokenizer — host-side text preprocessing.
+//
+// The reference ships tokenization as a native op
+// (ref: paddle/fluid/operators/string/faster_tokenizer_op.cc — the
+// "FasterTokenizer" BERT wordpiece path).  Tokenization runs on the
+// host while the TPU trains, so it is exactly the kind of runtime
+// component that should be native: basic tokenization (whitespace +
+// punctuation split, optional lowercasing) followed by greedy
+// longest-match WordPiece with "##" continuation pieces.
+//
+// C API contract: vocab is installed once per handle; tokenize writes
+// ids and returns the count (or the required capacity if larger).
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct WordPiece {
+  std::unordered_map<std::string, int64_t> vocab;
+  int64_t unk_id = 0;
+  int max_input_chars_per_word = 100;
+  bool lowercase = true;
+};
+
+inline bool is_punct(unsigned char c) {
+  return std::ispunct(c) != 0;
+}
+
+// split into basic tokens: whitespace-separated, punctuation isolated
+std::vector<std::string> basic_tokenize(const char* text, bool lower) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (const char* p = text; *p; ++p) {
+    unsigned char c = static_cast<unsigned char>(*p);
+    if (std::isspace(c)) {
+      if (!cur.empty()) out.push_back(std::move(cur)), cur.clear();
+    } else if (is_punct(c)) {
+      if (!cur.empty()) out.push_back(std::move(cur)), cur.clear();
+      out.emplace_back(1, static_cast<char>(c));
+    } else {
+      cur.push_back(lower ? static_cast<char>(std::tolower(c))
+                          : static_cast<char>(c));
+    }
+  }
+  if (!cur.empty()) out.push_back(std::move(cur));
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_wp_new(const char* const* tokens, int64_t n, const char* unk,
+                int max_chars, int lowercase) {
+  auto* wp = new WordPiece();
+  wp->vocab.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) wp->vocab.emplace(tokens[i], i);
+  auto it = wp->vocab.find(unk);
+  wp->unk_id = it == wp->vocab.end() ? 0 : it->second;
+  wp->max_input_chars_per_word = max_chars;
+  wp->lowercase = lowercase != 0;
+  return wp;
+}
+
+void pd_wp_free(void* handle) {
+  delete static_cast<WordPiece*>(handle);
+}
+
+// Greedy longest-match WordPiece over basic tokens.  Writes up to `cap`
+// ids; returns the total id count (callers re-call with a larger buffer
+// if the return exceeds cap).
+int64_t pd_wp_tokenize(void* handle, const char* text, int64_t* out_ids,
+                       int64_t cap) {
+  auto* wp = static_cast<WordPiece*>(handle);
+  int64_t count = 0;
+  auto emit = [&](int64_t id) {
+    if (count < cap) out_ids[count] = id;
+    ++count;
+  };
+  for (const auto& word : basic_tokenize(text, wp->lowercase)) {
+    if (static_cast<int>(word.size()) > wp->max_input_chars_per_word) {
+      emit(wp->unk_id);
+      continue;
+    }
+    size_t start = 0;
+    std::vector<int64_t> pieces;
+    bool bad = false;
+    while (start < word.size()) {
+      size_t end = word.size();
+      int64_t cur_id = -1;
+      while (start < end) {
+        std::string sub = word.substr(start, end - start);
+        if (start > 0) sub = "##" + sub;
+        auto it = wp->vocab.find(sub);
+        if (it != wp->vocab.end()) {
+          cur_id = it->second;
+          break;
+        }
+        --end;
+      }
+      if (cur_id < 0) {
+        bad = true;
+        break;
+      }
+      pieces.push_back(cur_id);
+      start = end;
+    }
+    if (bad) {
+      emit(wp->unk_id);
+    } else {
+      for (int64_t id : pieces) emit(id);
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -391,9 +391,9 @@ class LBFGS(Optimizer):
         for p in self._params():
             g = p.grad._data if p.grad is not None else \
                 jnp.zeros_like(p._data)
-            if self._regularization is not None:
-                # same L1/L2 semantics as the base optimizer path
-                g = self._apply_regularization(p, g, {})
+            # unconditional, like the base step path: it resolves the
+            # global regularizer AND per-param ParamAttr.regularizer
+            g = self._apply_regularization(p, g, {})
             outs.append(jnp.ravel(g).astype(jnp.float32))
         return jnp.concatenate(outs)
 

@@ -24,7 +24,153 @@ from ..io import Dataset
 from ..tensor._helpers import ensure_tensor
 
 __all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "Imikolov",
-           "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]
+           "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16",
+           "FasterTokenizer"]
+
+
+class FasterTokenizer:
+    """BERT-style WordPiece tokenizer (ref: the reference's native
+    faster_tokenizer op, paddle/fluid/operators/string/
+    faster_tokenizer_op.cc).
+
+    Tokenization is host-side preprocessing that runs while the TPU
+    trains, so it lives in the native runtime layer
+    (paddle_tpu/native/csrc/tokenizer.cc) with a pure-Python fallback of
+    identical behavior.  The spec BOTH paths implement is byte-oriented:
+    basic tokenization splits on ASCII whitespace/punctuation and
+    (optionally) lowercases ASCII letters only — non-ASCII UTF-8 bytes
+    pass through as word characters — then greedy longest-match
+    WordPiece with "##" continuation pieces.
+
+    ``vocab``: dict token->id (ids need not be contiguous) or ordered
+    list of tokens.  ``__call__(text)`` -> list of vocab ids;
+    ``batch(texts, max_len)`` -> (input_ids, attention_mask) numpy
+    arrays ready for a BERT model.
+    """
+
+    def __init__(self, vocab, do_lower_case: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]",
+                 max_input_chars_per_word: int = 100):
+        import ctypes
+        if isinstance(vocab, dict):
+            items = sorted(vocab.items(), key=lambda kv: kv[1])
+            self._tokens = [t for t, _ in items]
+            # position -> real id (dict ids need not be contiguous; the
+            # native tokenizer works in positions, so translate back)
+            self._ids = [i for _, i in items]
+            self._vocab = {t: i for t, i in vocab.items()}
+        else:
+            self._tokens = list(vocab)
+            self._ids = list(range(len(self._tokens)))
+            self._vocab = {t: i for i, t in enumerate(self._tokens)}
+        self._id_to_token = {i: t for t, i in self._vocab.items()}
+        self._bvocab = {t.encode(): i for t, i in self._vocab.items()}
+        self._lower = bool(do_lower_case)
+        self._unk = unk_token
+        self._max_chars = int(max_input_chars_per_word)
+        self.cls_id = self._vocab.get(cls_token)
+        self.sep_id = self._vocab.get(sep_token)
+        self.pad_id = self._vocab.get(pad_token, 0)
+        self._h = None
+        from ..native import lib as _native_lib
+        self._nlib = _native_lib()
+        if self._nlib is not None:
+            arr = (ctypes.c_char_p * len(self._tokens))(
+                *[t.encode() for t in self._tokens])
+            self._h = self._nlib.pd_wp_new(
+                arr, len(self._tokens), unk_token.encode(),
+                self._max_chars, 1 if self._lower else 0)
+
+    # -- python fallback: byte-for-byte the csrc/tokenizer.cc algorithm -
+    _PUNCT = frozenset(b"!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+    _SPACE = frozenset(b" \t\n\r\v\f")
+
+    def _basic(self, data: bytes):
+        out, cur = [], bytearray()
+        for b in data:
+            if b in self._SPACE:
+                if cur:
+                    out.append(bytes(cur))
+                    cur = bytearray()
+            elif b in self._PUNCT:
+                if cur:
+                    out.append(bytes(cur))
+                    cur = bytearray()
+                out.append(bytes([b]))
+            else:
+                if self._lower and 0x41 <= b <= 0x5A:  # ASCII A-Z only
+                    b += 0x20
+                cur.append(b)
+        if cur:
+            out.append(bytes(cur))
+        return out
+
+    def _wordpiece(self, word: bytes):
+        unk = self._vocab.get(self._unk, 0)
+        if len(word) > self._max_chars:
+            return [unk]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = b"##" + sub
+                if sub in self._bvocab:
+                    cur = self._bvocab[sub]
+                    break
+                end -= 1
+            if cur is None:
+                return [unk]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+    def __call__(self, text: str):
+        import ctypes
+        if self._h is not None:
+            cap = max(16, 2 * len(text) + 8)
+            buf = (ctypes.c_int64 * cap)()
+            n = self._nlib.pd_wp_tokenize(self._h, text.encode(), buf, cap)
+            if n > cap:
+                buf = (ctypes.c_int64 * n)()
+                n = self._nlib.pd_wp_tokenize(self._h, text.encode(),
+                                              buf, n)
+            return [self._ids[p] for p in buf[:n]]
+        ids = []
+        for w in self._basic(text.encode()):
+            ids.extend(self._wordpiece(w))
+        return ids
+
+    def tokenize(self, text: str):
+        """Token strings (id lookup back through the vocab)."""
+        return [self._id_to_token[i] for i in self(text)]
+
+    def batch(self, texts, max_len: int = 128, add_special_tokens=True):
+        """Encode a batch → (input_ids, attention_mask) int64 arrays."""
+        rows, masks = [], []
+        for t in texts:
+            ids = self(t)
+            if add_special_tokens and self.cls_id is not None \
+                    and self.sep_id is not None:
+                ids = [self.cls_id] + ids[:max_len - 2] + [self.sep_id]
+            else:
+                ids = ids[:max_len]
+            mask = [1] * len(ids) + [0] * (max_len - len(ids))
+            ids = ids + [self.pad_id] * (max_len - len(ids))
+            rows.append(ids)
+            masks.append(mask)
+        return (np.asarray(rows, dtype=np.int64),
+                np.asarray(masks, dtype=np.int64))
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                self._nlib.pd_wp_free(self._h)
+        except Exception:
+            pass
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

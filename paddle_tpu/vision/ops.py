@@ -53,6 +53,33 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
             kept_all = kept_all[:top_k]
         return Tensor(jnp.asarray(kept_all))
 
+    kept = _nms_flat(boxes_np, None if scores is None else s, order, n,
+                     iou_threshold)
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept))
+
+
+def _nms_flat(boxes_np, scores_np, order, n, iou_threshold):
+    """Single-class greedy NMS; native C++ fast path (ref: the
+    reference's native nms kernel), numpy fallback."""
+    from ..native import lib as _native_lib
+    import ctypes
+    nlib = _native_lib()
+    if nlib is not None:
+        b = np.ascontiguousarray(boxes_np, dtype=np.float32)
+        # pd_nms sorts by score internally; without scores, rank by
+        # position so the given order is preserved
+        s = (np.ascontiguousarray(scores_np, dtype=np.float32)
+             if scores_np is not None
+             else np.arange(n, 0, -1, dtype=np.float32))
+        keep = np.zeros(n, dtype=np.int64)
+        nkeep = nlib.pd_nms(
+            b.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            s.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, ctypes.c_float(float(iou_threshold)),
+            keep.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return keep[:nkeep].copy()
     x1, y1, x2, y2 = (boxes_np[:, 0], boxes_np[:, 1], boxes_np[:, 2],
                       boxes_np[:, 3])
     areas = (x2 - x1) * (y2 - y1)
@@ -70,10 +97,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         iou = inter / (areas[i] + areas - inter + 1e-10)
         suppressed |= iou > iou_threshold
         suppressed[i] = True
-    kept = np.asarray(keep, dtype="int64")
-    if top_k is not None:
-        kept = kept[:top_k]
-    return Tensor(jnp.asarray(kept))
+    return np.asarray(keep, dtype="int64")
 
 
 def _roi_align_impl(x, boxes, boxes_num, output_size, spatial_scale,

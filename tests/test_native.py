@@ -1,0 +1,118 @@
+"""Native C++ runtime layer — build, correctness, and native-vs-Python
+parity (the fallback must be behaviorally identical).
+
+Ref targets: tcp_store.cc (store), nms kernels (nms),
+faster_tokenizer_op.cc (tokenizer) — see paddle_tpu/native/csrc/.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_native_builds_and_caches():
+    p1 = native.build()
+    p2 = native.build()
+    assert p1 == p2 and p1.endswith(".so")
+
+
+def test_nms_native_matches_python(monkeypatch):
+    from paddle_tpu.vision import ops as vops
+    rs = np.random.RandomState(0)
+    boxes = rs.rand(64, 4).astype(np.float32) * 50
+    boxes[:, 2:] = boxes[:, :2] + 1 + boxes[:, 2:]  # x2>x1, y2>y1
+    scores = rs.rand(64).astype(np.float32)
+
+    kept_native = vops.nms(boxes, 0.4, scores=scores).numpy()
+    monkeypatch.setenv("PADDLE_DISABLE_NATIVE", "1")
+    kept_py = vops.nms(boxes, 0.4, scores=scores).numpy()
+    np.testing.assert_array_equal(kept_native, kept_py)
+    # kept indices are score-descending
+    assert (np.diff(scores[kept_native]) <= 0).all()
+
+
+def test_tokenizer_native_matches_python(monkeypatch):
+    from paddle_tpu.text import FasterTokenizer
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "un",
+             "##friend", "##ly", "!", ",", "the", "quick", "brown",
+             "fox", "##es"]
+    texts = ["Hello unfriendly world!",
+             "The quick brown foxes, hello!",
+             "zzz unknown-token hello"]
+    tk_native = FasterTokenizer(vocab)
+    assert tk_native._h is not None
+    native_ids = [tk_native(t) for t in texts]
+
+    monkeypatch.setenv("PADDLE_DISABLE_NATIVE", "1")
+    tk_py = FasterTokenizer(vocab)
+    assert tk_py._h is None
+    py_ids = [tk_py(t) for t in texts]
+    assert native_ids == py_ids
+    # spot-check the greedy wordpiece: un ##friend ##ly
+    assert tk_native.tokenize("unfriendly") == ["un", "##friend", "##ly"]
+
+
+def test_tokenizer_dict_vocab_non_contiguous_ids(monkeypatch):
+    """dict vocabs with arbitrary ids return the REAL ids on both
+    paths (the native path works in positions internally)."""
+    from paddle_tpu.text import FasterTokenizer
+    vocab = {"[UNK]": 7, "hello": 100, "world": 42, "##s": 3}
+    tk = FasterTokenizer(vocab)
+    assert tk(" hello worlds ") == [100, 42, 3]
+    assert tk("zzz") == [7]
+    assert tk.tokenize("hello") == ["hello"]
+    monkeypatch.setenv("PADDLE_DISABLE_NATIVE", "1")
+    tk_py = FasterTokenizer(vocab)
+    assert tk_py(" hello worlds ") == [100, 42, 3]
+    assert tk_py.tokenize("hello") == ["hello"]
+
+
+def test_tokenizer_non_ascii_parity(monkeypatch):
+    """non-ASCII text follows the byte-oriented spec identically on
+    both paths (ASCII-only lowercase/space/punct; UTF-8 bytes pass
+    through as word chars)."""
+    from paddle_tpu.text import FasterTokenizer
+    vocab = ["[UNK]", "café", "naïve", "hello", "é"]
+    texts = ["CAFÉ café", "naïve hello", "héllo", "a b"]
+    tk_n = FasterTokenizer(vocab)
+    ids_n = [tk_n(t) for t in texts]
+    monkeypatch.setenv("PADDLE_DISABLE_NATIVE", "1")
+    tk_p = FasterTokenizer(vocab)
+    ids_p = [tk_p(t) for t in texts]
+    assert ids_n == ids_p
+
+
+def test_tokenizer_batch_encoding():
+    from paddle_tpu.text import FasterTokenizer
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world"]
+    tk = FasterTokenizer(vocab)
+    ids, mask = tk.batch(["hello world", "hello"], max_len=6)
+    assert ids.shape == (2, 6) and mask.shape == (2, 6)
+    assert ids[0].tolist() == [2, 4, 5, 3, 0, 0]   # CLS hello world SEP PAD PAD
+    assert mask[0].tolist() == [1, 1, 1, 1, 0, 0]
+    assert ids[1].tolist() == [2, 4, 3, 0, 0, 0]
+
+
+def test_tokenizer_long_text_two_phase():
+    from paddle_tpu.text import FasterTokenizer
+    vocab = ["[UNK]", "a"]
+    tk = FasterTokenizer(vocab)
+    text = " ".join(["a"] * 500)
+    ids = tk(text)
+    assert ids == [1] * 500
+
+
+def test_store_native_backend_used():
+    from paddle_tpu.distributed import TCPStore
+    s = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    assert s.is_native
+    s.set("k", b"v" * 70000)          # >64k payload through the framing
+    assert s.get("k") == b"v" * 70000
+    assert s.add("c", 7) == 7
+    s.delete_key("k")
+    with pytest.raises(TimeoutError):
+        s.wait(["k"], timeout=0.3)

@@ -203,6 +203,38 @@ def test_tcpstore_wait_and_set_same_instance():
     assert done == [True]
 
 
+def test_tcpstore_native_python_interop(monkeypatch):
+    """C++ server ⇄ Python client and Python server ⇄ C++ client speak
+    the same wire protocol."""
+    from paddle_tpu import native
+    from paddle_tpu.distributed import TCPStore
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+    # native master, python client
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    assert master.is_native
+    monkeypatch.setenv("PADDLE_DISABLE_NATIVE", "1")
+    py_client = TCPStore("127.0.0.1", master.port, timeout=5.0)
+    assert not py_client.is_native
+    master.set("a", b"from-native")
+    assert py_client.get("a") == b"from-native"
+    py_client.set("b", b"from-python")
+    assert master.get("b") == b"from-python"
+    assert py_client.add("n", 2) == 2 and master.add("n", 3) == 5
+
+    # python master, native client
+    py_master = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+    assert not py_master.is_native
+    monkeypatch.delenv("PADDLE_DISABLE_NATIVE")
+    n_client = TCPStore("127.0.0.1", py_master.port, timeout=5.0)
+    assert n_client.is_native
+    py_master.set("x", b"1")
+    assert n_client.get("x") == b"1"
+    n_client.set("y", b"2")
+    assert py_master.get("y") == b"2"
+
+
 def test_tcpstore_survives_malformed_request():
     """A bad request (non-integer counter) answers an error and leaves
     the connection usable — it must not kill the handler thread."""
