@@ -232,7 +232,10 @@ class TCPStore(Store):
     # -- single-shot primitives (native or python, identical semantics) --
     def _prim_set(self, key: str, value: bytes):
         if self._ncli is not None:
-            buf = (ctypes.c_uint8 * max(len(value), 1))(*value)
+            # from_buffer_copy = one memcpy; splatting bytes as python
+            # ints would be O(n) interpreter work on the hot path
+            buf = ((ctypes.c_uint8 * len(value)).from_buffer_copy(value)
+                   if value else (ctypes.c_uint8 * 1)())
             self._ncheck(self._nlib.pd_store_set(
                 self._ncli, key.encode(), buf, len(value)), "set")
             return
@@ -247,13 +250,15 @@ class TCPStore(Store):
                 if ln == -3:
                     return None
                 self._ncheck(ln, "get")
-                buf = (ctypes.c_uint8 * max(int(ln), 1))()
-                got = self._nlib.pd_store_copy_value(self._ncli, buf, ln)
+                buf = ctypes.create_string_buffer(max(int(ln), 1))
+                got = self._nlib.pd_store_copy_value(
+                    self._ncli,
+                    ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), ln)
             if got != ln:
                 raise RuntimeError(
                     f"TCPStore.get({key!r}): value copy-out returned "
                     f"{got}, expected {ln}")
-            return bytes(buf[:int(ln)])
+            return buf.raw[:int(ln)]
         resp = self._rpc(b"get", key.encode())
         return resp[1] if resp[0] == b"ok" else None
 

@@ -1,0 +1,227 @@
+"""paddle.distributed.rpc — RPC framework (ref:
+python/paddle/distributed/rpc/rpc.py: init_rpc, rpc_sync, rpc_async,
+shutdown, get_worker_info, get_all_worker_infos — brpc-backed upstream).
+
+TPU-native: the reference's brpc transport becomes the same TCP framing
+the TCPStore speaks (native C++ when available), with TCPStore itself as
+the rendezvous — workers register ``name -> host:port`` under the
+master store and discover each other from it.  Calls pickle
+``(fn, args, kwargs)``; each worker runs a daemon server thread
+executing requests on a small thread pool, exactly the role of the
+reference's worker service.
+
+An ``_Agent`` carries all state; module-level functions drive the
+process-wide agent (the reference's model: one worker per process).
+Tests build several agents in one process to exercise the full path
+without a cluster (SURVEY.md §4: multi-rank-on-localhost oracle).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+import time
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from ..communication.store import TCPStore, _recv_msg, _send_msg
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    """ref: rpc.WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name = name
+        self.rank = int(rank)
+        self.ip = ip
+        self.port = int(port)
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name!r}, rank={self.rank}, "
+                f"ip={self.ip!r}, port={self.port})")
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 master_endpoint: str, is_master: Optional[bool] = None):
+        self.name = name
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        host, _, port = master_endpoint.partition(":")
+        is_master = (self.rank == 0) if is_master is None else is_master
+        self._store = TCPStore(host, int(port or 8090),
+                               is_master=is_master,
+                               world_size=world_size, timeout=60.0)
+        # serve on an ephemeral port; all interfaces, advertise 127.0.0.1
+        # on single-host (multi-host advertises POD_IP per the launch env)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", 0))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self.ip = os.environ.get("POD_IP", "127.0.0.1")
+        # DISTINCT pools: handlers on the caller's pool would deadlock —
+        # 8 outstanding rpc_async calls fill it with threads blocked on
+        # replies that the queued handlers can never produce
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"rpc-client-{name}")
+        self._serve_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"rpc-server-{name}")
+        self._is_store_master = is_master
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._serve,
+                                               daemon=True)
+        self._accept_thread.start()
+        # registry + barrier: every worker writes its info, then waits
+        # for all peers (ref: the master gathering worker endpoints)
+        self._store.set(f"rpc/worker/{self.rank}",
+                        pickle.dumps((name, self.rank, self.ip, self.port)))
+        self._store.add("rpc/joined", 1)
+        self._store.wait([f"rpc/worker/{r}"
+                          for r in range(self.world_size)])
+        self._peers: Dict[str, WorkerInfo] = {}
+        for r in range(self.world_size):
+            n, rk, ip, pt = pickle.loads(
+                self._store.get(f"rpc/worker/{r}"))
+            self._peers[n] = WorkerInfo(n, rk, ip, pt)
+
+    # -- server side -----------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self._serve_pool.submit(self._handle, conn)
+
+    def _handle(self, conn):
+        try:
+            while True:
+                parts = _recv_msg(conn)
+                try:
+                    fn, args, kwargs = pickle.loads(parts[0])
+                    result = fn(*args, **(kwargs or {}))
+                    payload = pickle.dumps(("ok", result))
+                except Exception:
+                    payload = pickle.dumps(("exc", traceback.format_exc()))
+                _send_msg(conn, payload)
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    # -- client side -----------------------------------------------------
+    def _call(self, to: str, fn, args, kwargs, timeout: float):
+        info = self._peers.get(to)
+        if info is None:
+            raise ValueError(f"unknown worker {to!r}; have "
+                             f"{sorted(self._peers)}")
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout) as s:
+            if timeout and timeout > 0:
+                s.settimeout(timeout)
+            _send_msg(s, pickle.dumps((fn, args, kwargs)))
+            status, payload = pickle.loads(_recv_msg(s)[0])
+        if status != "ok":
+            raise RuntimeError(f"rpc to {to!r} failed:\n{payload}")
+        return payload
+
+    def rpc_sync(self, to, fn, args=(), kwargs=None, timeout=180.0):
+        return self._call(to, fn, tuple(args), kwargs, timeout)
+
+    def rpc_async(self, to, fn, args=(), kwargs=None,
+                  timeout=180.0) -> Future:
+        return self._pool.submit(self._call, to, fn, tuple(args), kwargs,
+                                 timeout)
+
+    def shutdown(self, graceful: bool = True):
+        if graceful:
+            # two-phase barrier: (1) everyone announces leaving and
+            # waits for the full count; (2) everyone acks having SEEN
+            # it, and the store master lingers until all acks land —
+            # otherwise the master's teardown races peers still polling
+            # the store (their "graceful" shutdown would raise).
+            try:
+                self._store.add("rpc/leaving", 1)
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    if self._store.add("rpc/leaving", 0) >= \
+                            self.world_size:
+                        break
+                    time.sleep(0.05)
+                self._store.add("rpc/left", 1)
+                if self._is_store_master:
+                    while time.time() < deadline:
+                        if self._store.add("rpc/left", 0) >= \
+                                self.world_size:
+                            break
+                        time.sleep(0.05)
+            except (ConnectionError, RuntimeError, TimeoutError, OSError):
+                pass   # a vanished peer/store must not fail shutdown
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+        self._serve_pool.shutdown(wait=False)
+
+    def infos(self) -> List[WorkerInfo]:
+        return sorted(self._peers.values(), key=lambda w: w.rank)
+
+
+_agent: Optional[_Agent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """ref: rpc.init_rpc — env-var defaults match the launch contract."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("RPC already initialized; call shutdown() first")
+    rank = int(rank if rank is not None
+               else os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = int(world_size if world_size is not None
+                     else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:8090")
+    _agent = _Agent(name, rank, world_size, master_endpoint)
+    return _agent
+
+
+def _require() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc() first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout=180.0):
+    return _require().rpc_sync(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout=180.0) -> Future:
+    return _require().rpc_async(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    a = _require()
+    if name is None:
+        return a._peers[a.name]
+    return a._peers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return _require().infos()
+
+
+def shutdown(graceful: bool = True):
+    global _agent
+    if _agent is not None:
+        _agent.shutdown(graceful)
+        _agent = None

@@ -1,5 +1,6 @@
 """paddle.incubate (ref: python/paddle/incubate/)."""
-from . import distributed, nn
+from . import asp, distributed, nn, optimizer
+from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -1,0 +1,136 @@
+"""paddle.incubate.asp — automatic structured pruning, n:m sparsity
+(ref: python/paddle/incubate/asp/: supported_layer_list.py, utils.py
+get_mask_1d/get_mask_2d_greedy, asp.py prune_model/decorate).
+
+TPU-native semantics: TPUs have no sparse-tensor-core fast path, so n:m
+sparsity here is a STRUCTURED PRUNING contract — ``prune_model``
+computes per-group top-|w| masks, ``decorate`` re-applies them after
+every optimizer step so pruned weights stay zero through training
+(functionally identical training dynamics to the reference; the 2:4
+inference speedup is hardware-specific and does not transfer).  Masks
+live on device and the re-mask is one fused elementwise multiply.
+
+Groups of ``m`` run along the REDUCTION dimension (axis 0 of a Linear's
+[in, out] weight), the dimension the reference's sparse kernels
+contract over.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers", "get_mask_1d",
+           "check_mask_1d"]
+
+# masks live ON the param (in its _dist_attr dict): lifetime-correct by
+# construction — a module dict keyed by id(param) would leak device
+# arrays and could hand a recycled id a stale mask
+_excluded: Dict[int, List[str]] = {}      # id(model) -> layer names
+
+
+def calculate_density(x) -> float:
+    """ref: asp.calculate_density — fraction of nonzeros."""
+    a = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask over groups of m along axis 0 (reduction dim): keep the
+    n largest magnitudes per group.  2-D input [in, out]."""
+    k, out = mat.shape
+    if k % m:
+        # ragged tail stays dense (the reference skips unsupported
+        # shapes the same way)
+        head = get_mask_1d(mat[:k - k % m], n, m)
+        return np.concatenate([head, np.ones((k % m, out), mat.dtype)])
+    g = np.abs(mat.reshape(k // m, m, out))
+    order = np.argsort(-g, axis=1)            # descending |w| per group
+    mask = np.zeros_like(g)
+    np.put_along_axis(mask, order[:, :n, :], 1.0, axis=1)
+    return mask.reshape(k, out).astype(mat.dtype)
+
+
+def check_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    """ref: utils.check_mask_1d — every m-group has <= n nonzeros."""
+    k, out = np.asarray(mat).shape
+    k_main = k - k % m
+    g = np.asarray(mat)[:k_main].reshape(k_main // m, m, out)
+    return bool((np.count_nonzero(g, axis=1) <= n).all())
+
+
+def set_excluded_layers(model, layer_names: List[str]):
+    """ref: asp.set_excluded_layers — skip these sublayers in
+    prune_model."""
+    _excluded[id(model)] = list(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _excluded.clear()
+    else:
+        _excluded.pop(id(model), None)
+
+
+def _prunable(model):
+    """(name, layer) pairs with a 2-D+ weight — Linear and Conv family
+    (ref: supported_layer_list)."""
+    excluded = set(_excluded.get(id(model), ()))
+    for name, layer in model.named_sublayers():
+        if name in excluded:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is not None and not w.stop_gradient and len(w.shape) >= 2:
+            yield name, layer
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """ref: asp.prune_model — compute masks, zero the pruned weights,
+    and (with_mask) register them for decorate() to re-apply."""
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    pruned = {}
+    for name, layer in _prunable(model):
+        w = layer.weight
+        a = np.asarray(w.numpy())
+        if a.ndim == 2:
+            # Linear [in, out]: axis 0 IS the reduction dim
+            mask = get_mask_1d(a, n, m)
+        else:
+            # Conv [out, in, kh, kw]: the reduction dims are in*kh*kw —
+            # transpose them onto axis 0 so groups run along the
+            # contraction, per the module contract
+            flat = a.reshape(a.shape[0], -1).T      # [in*kh*kw, out]
+            mask = get_mask_1d(flat, n, m).T.reshape(a.shape)
+        mj = jnp.asarray(mask, dtype=w._data.dtype)
+        w._data = w._data * mj
+        if with_mask:
+            da = w._dist_attr or {}
+            da["asp_mask"] = mj
+            w._dist_attr = da
+        pruned[name] = calculate_density(w)
+    return pruned
+
+
+def decorate(optimizer):
+    """ref: asp.decorate — wrap ``step`` so masks re-apply after every
+    update (pruned weights stay exactly zero through training)."""
+    inner_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = inner_step(*args, **kwargs)
+        for p in optimizer._parameter_list:
+            mask = (p._dist_attr or {}).get("asp_mask")
+            if mask is not None:
+                p._data = p._data * mask
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

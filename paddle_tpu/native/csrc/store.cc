@@ -167,6 +167,16 @@ struct Server {
       }
       if (!send_msg(fd, reply)) break;
     }
+    {
+      // deregister BEFORE closing: server_stop must never shutdown() a
+      // number the process has since reused for an unrelated socket
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it)
+        if (*it == fd) {
+          conn_fds.erase(it);
+          break;
+        }
+    }
     ::close(fd);
   }
 

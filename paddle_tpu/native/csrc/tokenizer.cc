@@ -27,8 +27,23 @@ struct WordPiece {
   bool lowercase = true;
 };
 
-inline bool is_punct(unsigned char c) {
-  return std::ispunct(c) != 0;
+// Explicit ASCII classification — std::ispunct/isspace/tolower are
+// LOCALE-dependent for bytes >= 0x80, which would break the documented
+// byte spec (and parity with the Python fallback) under non-UTF-8
+// LC_CTYPE.  Non-ASCII bytes always pass through as word characters.
+inline bool is_ascii_space(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+
+inline bool is_ascii_punct(unsigned char c) {
+  return (c >= 0x21 && c <= 0x2F) || (c >= 0x3A && c <= 0x40) ||
+         (c >= 0x5B && c <= 0x60) || (c >= 0x7B && c <= 0x7E);
+}
+
+inline char ascii_lower(unsigned char c) {
+  return (c >= 'A' && c <= 'Z') ? static_cast<char>(c + 0x20)
+                                : static_cast<char>(c);
 }
 
 // split into basic tokens: whitespace-separated, punctuation isolated
@@ -37,14 +52,13 @@ std::vector<std::string> basic_tokenize(const char* text, bool lower) {
   std::string cur;
   for (const char* p = text; *p; ++p) {
     unsigned char c = static_cast<unsigned char>(*p);
-    if (std::isspace(c)) {
+    if (is_ascii_space(c)) {
       if (!cur.empty()) out.push_back(std::move(cur)), cur.clear();
-    } else if (is_punct(c)) {
+    } else if (is_ascii_punct(c)) {
       if (!cur.empty()) out.push_back(std::move(cur)), cur.clear();
       out.emplace_back(1, static_cast<char>(c));
     } else {
-      cur.push_back(lower ? static_cast<char>(std::tolower(c))
-                          : static_cast<char>(c));
+      cur.push_back(lower ? ascii_lower(c) : static_cast<char>(c));
     }
   }
   if (!cur.empty()) out.push_back(std::move(cur));

@@ -141,15 +141,17 @@ class Optimizer:
         return out
 
     def _apply_regularization(self, p: Tensor, g, group: dict):
-        reg = group.get("weight_decay", self._regularization)
+        # per-param regularizer attr wins (ParamAttr.regularizer) — and
+        # must be honored even when no GLOBAL regularization is set
+        attrs = getattr(p, "_paddle_attrs", None)
+        if attrs is not None and attrs.regularizer is not None:
+            reg = attrs.regularizer
+        else:
+            reg = group.get("weight_decay", self._regularization)
         if reg is None:
             return g
         if not isinstance(reg, (L1Decay, L2Decay)):
             reg = L2Decay(float(reg))
-        # per-param regularizer attr wins (ParamAttr.regularizer)
-        attrs = getattr(p, "_paddle_attrs", None)
-        if attrs is not None and attrs.regularizer is not None:
-            reg = attrs.regularizer
         if isinstance(reg, L2Decay) and reg.coeff:
             return g + reg.coeff * p._data.astype(g.dtype)
         if isinstance(reg, L1Decay) and reg.coeff:

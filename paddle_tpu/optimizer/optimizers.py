@@ -391,8 +391,8 @@ class LBFGS(Optimizer):
         for p in self._params():
             g = p.grad._data if p.grad is not None else \
                 jnp.zeros_like(p._data)
-            # unconditional, like the base step path: it resolves the
-            # global regularizer AND per-param ParamAttr.regularizer
+            # unconditional: the helper resolves per-param
+            # ParamAttr.regularizer first, then the global one
             g = self._apply_regularization(p, g, {})
             outs.append(jnp.ravel(g).astype(jnp.float32))
         return jnp.concatenate(outs)

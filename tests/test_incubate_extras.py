@@ -1,0 +1,111 @@
+"""incubate: ASP n:m sparsity, DistributedFusedLamb, LookAhead,
+ModelAverage (ref: test/asp/*, incubate optimizer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+
+
+def _mlp():
+    paddle.seed(0)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, 4))
+
+
+def test_get_mask_1d_pattern():
+    rs = np.random.RandomState(0)
+    w = rs.randn(16, 8).astype("float32")
+    mask = asp.get_mask_1d(w, 2, 4)
+    assert asp.check_mask_1d(mask, 2, 4)
+    # exactly 2 of every 4 kept, and they are the 2 largest magnitudes
+    g = np.abs(w).reshape(4, 4, 8)
+    kept = mask.reshape(4, 4, 8)
+    assert (kept.sum(axis=1) == 2).all()
+    top2 = np.argsort(-g, axis=1)[:, :2, :]
+    taken = np.take_along_axis(kept, top2, axis=1)
+    assert (taken == 1).all()
+
+
+def test_prune_model_and_density():
+    m = _mlp()
+    dens = asp.prune_model(m, n=2, m=4)
+    assert dens, "no layers pruned"
+    for name, d in dens.items():
+        assert abs(d - 0.5) < 1e-6, (name, d)
+    assert asp.check_mask_1d(m[0].weight.numpy(), 2, 4)
+
+
+def test_decorate_keeps_masks_through_training():
+    m = _mlp()
+    asp.prune_model(m, n=2, m=4)
+    zero_before = np.asarray(m[0].weight.numpy()) == 0
+    opt = asp.decorate(paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m.parameters()))
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    w = np.asarray(m[0].weight.numpy())
+    assert (w[zero_before] == 0).all(), "pruned weights drifted"
+    assert asp.check_mask_1d(w, 2, 4)
+
+
+def test_excluded_layers():
+    m = _mlp()
+    names = [n for n, _ in m.named_sublayers() if "0" in n]
+    asp.set_excluded_layers(m, names)
+    dens = asp.prune_model(m)
+    asp.reset_excluded_layers(m)
+    assert all("0" not in n for n in dens)
+
+
+def test_distributed_fused_lamb_trains():
+    from paddle_tpu.incubate import DistributedFusedLamb
+    m = _mlp()
+    opt = DistributedFusedLamb(learning_rate=1e-2,
+                               parameters=m.parameters())
+    assert opt._shard_state_axis == "sharding"
+    rs = np.random.RandomState(2)
+    x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_lookahead_and_modelaverage():
+    from paddle_tpu.incubate import LookAhead, ModelAverage
+    m = _mlp()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    ma = ModelAverage(0.15, parameters=list(m.parameters()))
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randn(8, 4).astype("float32"))
+    for _ in range(4):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        ma.step()
+    w_live = np.asarray(m[0].weight.numpy()).copy()
+    ma.apply()
+    w_avg = np.asarray(m[0].weight.numpy())
+    assert not np.allclose(w_live, w_avg)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(m[0].weight.numpy()), w_live)

@@ -1,0 +1,141 @@
+"""paddle.distributed.rpc — multi-worker-on-localhost oracle
+(ref: test/legacy_test/test_rpc*.py run N local workers the same way)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.rpc import _Agent, WorkerInfo
+
+
+def _add(a, b):
+    return a + b
+
+
+def _matmul_sum(n):
+    import paddle_tpu as paddle
+    x = paddle.ones([n, n])
+    return float(paddle.matmul(x, x).sum())
+
+
+def _boom():
+    raise ValueError("intentional")
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _make_pair():
+    # init_rpc blocks until ALL workers join (the reference's barrier),
+    # so the two agents must be constructed concurrently — exactly how
+    # two processes would race through init_rpc
+    import threading
+    ep = f"127.0.0.1:{_free_port()}"
+    out = {}
+
+    def make(name, rank, is_master):
+        out[rank] = _Agent(name, rank, 2, ep, is_master=is_master)
+
+    t0 = threading.Thread(target=make, args=("worker0", 0, True))
+    t1 = threading.Thread(target=make, args=("worker1", 1, False))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    assert 0 in out and 1 in out, "agent init deadlocked"
+    return out[0], out[1]
+
+
+def test_rpc_sync_async_and_infos():
+    a, b = _make_pair()
+    try:
+        assert a.rpc_sync("worker1", _add, (2, 3)) == 5
+        assert b.rpc_sync("worker0", _add, ("x", "y")) == "xy"
+        fut = a.rpc_async("worker1", _add, (np.arange(3), 10))
+        np.testing.assert_array_equal(fut.result(timeout=30),
+                                      np.array([10, 11, 12]))
+        infos = a.infos()
+        assert [w.name for w in infos] == ["worker0", "worker1"]
+        assert all(isinstance(w, WorkerInfo) for w in infos)
+        # self-call works too (the reference allows it)
+        assert a.rpc_sync("worker0", _add, (1, 1)) == 2
+    finally:
+        a.shutdown(graceful=False)
+        b.shutdown(graceful=False)
+
+
+def test_rpc_async_saturation_no_deadlock():
+    """8+ outstanding async calls must not deadlock: request handlers
+    run on a pool distinct from the async-caller pool."""
+    a, b = _make_pair()
+    try:
+        futs = [a.rpc_async("worker1", _add, (i, 1)) for i in range(12)]
+        futs += [a.rpc_async("worker0", _add, (i, 2)) for i in range(12)]
+        outs = [f.result(timeout=30) for f in futs]
+        assert outs == [i + 1 for i in range(12)] + \
+            [i + 2 for i in range(12)]
+    finally:
+        a.shutdown(graceful=False)
+        b.shutdown(graceful=False)
+
+
+def test_rpc_graceful_shutdown_both_sides():
+    """graceful shutdown must return cleanly on every rank despite the
+    master's store going away at the end."""
+    import threading
+    a, b = _make_pair()
+    errs = []
+
+    def stop(agent):
+        try:
+            agent.shutdown(graceful=True)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    t0 = threading.Thread(target=stop, args=(a,))
+    t1 = threading.Thread(target=stop, args=(b,))
+    t0.start(); t1.start(); t0.join(70); t1.join(70)
+    assert not t0.is_alive() and not t1.is_alive(), "shutdown hung"
+    assert not errs, errs
+
+
+def test_rpc_executes_framework_code_remotely():
+    a, b = _make_pair()
+    try:
+        out = a.rpc_sync("worker1", _matmul_sum, (8,))
+        assert out == 8 * 8 * 8
+    finally:
+        a.shutdown(graceful=False)
+        b.shutdown(graceful=False)
+
+
+def test_rpc_exception_propagates():
+    a, b = _make_pair()
+    try:
+        with pytest.raises(RuntimeError, match="intentional"):
+            a.rpc_sync("worker1", _boom)
+        # agent still serves after a failed call
+        assert a.rpc_sync("worker1", _add, (1, 2)) == 3
+        with pytest.raises(ValueError, match="unknown worker"):
+            a.rpc_sync("nobody", _add, (1, 2))
+    finally:
+        a.shutdown(graceful=False)
+        b.shutdown(graceful=False)
+
+
+def test_rpc_module_level_api():
+    import paddle_tpu.distributed.rpc as rpc
+    master = _Agent("peer", 0, 1, "127.0.0.1:0", is_master=True)
+    master.shutdown(graceful=False)
+    rpc._agent = None
+    ag = rpc.init_rpc("solo", rank=0, world_size=1,
+                      master_endpoint="127.0.0.1:0")
+    try:
+        assert rpc.rpc_sync("solo", _add, (4, 5)) == 9
+        assert rpc.get_worker_info().name == "solo"
+        assert len(rpc.get_all_worker_infos()) == 1
+        with pytest.raises(RuntimeError, match="already initialized"):
+            rpc.init_rpc("solo2", rank=0, world_size=1)
+    finally:
+        rpc.shutdown(graceful=False)
+    with pytest.raises(RuntimeError, match="init_rpc"):
+        rpc.rpc_sync("solo", _add, (1, 2))
