@@ -73,6 +73,8 @@ class _Agent:
         self._serve_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"rpc-server-{name}")
         self._is_store_master = is_master
+        self._conns: Dict[str, List] = {}
+        self._conn_lock = threading.Lock()
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(target=self._serve,
                                                daemon=True)
@@ -116,17 +118,39 @@ class _Agent:
             conn.close()
 
     # -- client side -----------------------------------------------------
+    def _checkout_conn(self, to: str, info: WorkerInfo, timeout: float):
+        """Pooled keep-alive connection per peer (the server's handler
+        loop serves many requests per connection; opening a fresh socket
+        per call would waste a connect/accept round-trip every rpc)."""
+        with self._conn_lock:
+            pool = self._conns.setdefault(to, [])
+            if pool:
+                return pool.pop()
+        return socket.create_connection((info.ip, info.port),
+                                        timeout=timeout)
+
+    def _checkin_conn(self, to: str, sock):
+        with self._conn_lock:
+            self._conns.setdefault(to, []).append(sock)
+
     def _call(self, to: str, fn, args, kwargs, timeout: float):
         info = self._peers.get(to)
         if info is None:
             raise ValueError(f"unknown worker {to!r}; have "
                              f"{sorted(self._peers)}")
-        with socket.create_connection((info.ip, info.port),
-                                      timeout=timeout) as s:
+        s = self._checkout_conn(to, info, timeout)
+        try:
             if timeout and timeout > 0:
                 s.settimeout(timeout)
             _send_msg(s, pickle.dumps((fn, args, kwargs)))
             status, payload = pickle.loads(_recv_msg(s)[0])
+        except BaseException:
+            try:
+                s.close()   # possibly desynchronized: do not reuse
+            except OSError:
+                pass
+            raise
+        self._checkin_conn(to, s)
         if status != "ok":
             raise RuntimeError(f"rpc to {to!r} failed:\n{payload}")
         return payload
@@ -168,6 +192,14 @@ class _Agent:
             self._srv.close()
         except OSError:
             pass
+        with self._conn_lock:
+            for pool in self._conns.values():
+                for s in pool:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._conns.clear()
         self._pool.shutdown(wait=False)
         self._serve_pool.shutdown(wait=False)
 

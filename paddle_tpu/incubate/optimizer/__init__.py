@@ -61,7 +61,11 @@ class LookAhead(object):
         self.alpha = float(alpha)
         self.k = int(k)
         self._step_count = 0
-        self._slow = {}
+        # slow weights snapshot the INITIAL params (ref: lookahead.py) —
+        # capturing them lazily at the first sync would make that sync a
+        # no-op (slow == fast there)
+        self._slow = {id(p): p._data
+                      for p in inner_optimizer._parameter_list}
 
     @property
     def _parameter_list(self):
@@ -74,7 +78,7 @@ class LookAhead(object):
             return
         for p in self.inner_optimizer._parameter_list:
             slow = self._slow.get(id(p))
-            if slow is None:
+            if slow is None:         # param added after construction
                 slow = p._data
             slow = slow + self.alpha * (p._data - slow)
             p._data = slow
@@ -99,8 +103,16 @@ class LookAhead(object):
 
 
 class ModelAverage(object):
-    """ref: incubate/optimizer/modelaverage.py — running average of
-    params applied at eval time (apply/restore)."""
+    """ref: incubate/optimizer/modelaverage.py — windowed average of
+    params applied at eval time (apply/restore).
+
+    Window semantics follow the reference's sum-folding scheme: the
+    current sum restarts every ``max(min_average_window,
+    average_window_rate * num_updates)`` capped at
+    ``max_average_window`` accumulations, with the previous window kept
+    — so apply() averages the last 1–2 windows, never the whole run
+    (an unbounded cumulative mean would weight early junk params
+    forever)."""
 
     def __init__(self, average_window_rate, parameters=None,
                  min_average_window=10000, max_average_window=10000,
@@ -109,23 +121,39 @@ class ModelAverage(object):
         self._rate = float(average_window_rate)
         self._min_w = int(min_average_window)
         self._max_w = int(max_average_window)
-        self._sums = {id(p): jnp.zeros_like(p._data) for p in self._params}
-        self._counts = {id(p): 0 for p in self._params}
+        z = lambda p: jnp.zeros_like(p._data)
+        self._sum_cur = {id(p): z(p) for p in self._params}
+        self._sum_old = {id(p): z(p) for p in self._params}
+        self._n_cur = 0
+        self._n_old = 0
+        self._n_updates = 0
         self._backup = {}
 
+    def _window(self) -> int:
+        return int(min(self._max_w,
+                       max(self._min_w, self._rate * self._n_updates)))
+
     def step(self):
+        self._n_updates += 1
+        self._n_cur += 1
         for p in self._params:
-            self._sums[id(p)] = self._sums[id(p)] + p._data
-            self._counts[id(p)] += 1
+            self._sum_cur[id(p)] = self._sum_cur[id(p)] + p._data
+        if self._n_cur >= self._window():
+            # fold: current window becomes the old one, restart
+            self._sum_old, self._n_old = self._sum_cur, self._n_cur
+            self._sum_cur = {id(p): jnp.zeros_like(p._data)
+                             for p in self._params}
+            self._n_cur = 0
 
     def apply(self, executor=None, need_restore=True):
+        total = self._n_cur + self._n_old
+        if total == 0:
+            return
         for p in self._params:
-            c = self._counts[id(p)]
-            if c == 0:
-                continue
             if need_restore:
                 self._backup[id(p)] = p._data
-            p._data = (self._sums[id(p)] / c).astype(p._data.dtype)
+            avg = (self._sum_cur[id(p)] + self._sum_old[id(p)]) / total
+            p._data = avg.astype(p._data.dtype)
 
     def restore(self, executor=None):
         for p in self._params:

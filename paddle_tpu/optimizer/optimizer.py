@@ -26,6 +26,50 @@ def _param_key(p: Tensor, idx: int) -> str:
     return p.name if p.name else f"param_{idx}"
 
 
+class _AccShim:
+    """Rebinds an optimizer's accumulator get/set to a local dict for
+    ONE ``_update_param`` call — the static minimize path uses it to
+    turn state reads/writes into explicit op inputs/outputs (discovery
+    pass on zeros, then per-replay binding), keeping the update rule
+    itself untouched and pure."""
+
+    def __init__(self, p: Tensor, preset=None):
+        self.p = p
+        self.names: list = []
+        self.inits: dict = {}
+        self.values: dict = dict(preset or {})
+
+    def bound(self, opt: "Optimizer"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            orig_get, orig_set = opt._get_accumulator, opt._set_accumulator
+
+            def get(name, p, idx, fill=0.0, dtype=None, shape=None):
+                if name not in self.values:
+                    dt = dtype or p._data.dtype
+                    shp = tuple(shape) if shape is not None \
+                        else p._data.shape
+                    init = jnp.full(shp, fill, dtype=dt)
+                    self.names.append(name)
+                    self.inits[name] = init
+                    self.values[name] = init
+                return self.values[name]
+
+            def set_(name, p, idx, value):
+                self.values[name] = value
+
+            opt._get_accumulator, opt._set_accumulator = get, set_
+            try:
+                yield self
+            finally:
+                opt._get_accumulator, opt._set_accumulator = \
+                    orig_get, orig_set
+
+        return cm()
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=False,
@@ -140,9 +184,11 @@ class Optimizer:
                 idx += 1
         return out
 
-    def _apply_regularization(self, p: Tensor, g, group: dict):
+    def _apply_regularization(self, p: Tensor, g, group: dict, pv=None):
         # per-param regularizer attr wins (ParamAttr.regularizer) — and
-        # must be honored even when no GLOBAL regularization is set
+        # must be honored even when no GLOBAL regularization is set.
+        # ``pv`` overrides the param value (the static step passes the
+        # traced array; p._data there would bake a stale constant).
         attrs = getattr(p, "_paddle_attrs", None)
         if attrs is not None and attrs.regularizer is not None:
             reg = attrs.regularizer
@@ -152,10 +198,11 @@ class Optimizer:
             return g
         if not isinstance(reg, (L1Decay, L2Decay)):
             reg = L2Decay(float(reg))
+        val = p._data if pv is None else pv
         if isinstance(reg, L2Decay) and reg.coeff:
-            return g + reg.coeff * p._data.astype(g.dtype)
+            return g + reg.coeff * val.astype(g.dtype)
         if isinstance(reg, L1Decay) and reg.coeff:
-            return g + reg.coeff * jnp.sign(p._data).astype(g.dtype)
+            return g + reg.coeff * jnp.sign(val).astype(g.dtype)
         return g
 
     # subclasses with decoupled decay (AdamW/Lamb) skip grad-coupled reg
@@ -195,9 +242,96 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.capture import in_static_capture
+        if in_static_capture():
+            return self._static_minimize(loss, parameters, no_grad_set)
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]
+
+    def _static_minimize(self, loss, parameters=None, no_grad_set=None):
+        """Static-graph training (ref: Optimizer.minimize appending
+        backward + optimizer ops to the Program; base/backward.py +
+        the per-optimizer _append_optimize_op).
+
+        TPU-native: append_backward records the grad op, then ONE
+        update op applies this optimizer's ``_update_param`` rule to
+        every (param, grad) — accumulator reads/writes are rebound to
+        op inputs/outputs through a shim, so the op stays pure and the
+        Executor write-backs commit new params/state after each run.
+        The lr is baked at build time (re-build the program to change
+        it); master weights don't apply (static params are fp32).
+        """
+        from ..static import append_backward
+        from ..static.capture import current_program
+
+        prog = current_program()
+        # default to THIS optimizer's parameters (multi-optimizer setups
+        # must not cross-train each other's subsets); fall back to every
+        # program param only when the optimizer was built without any
+        params_arg = parameters if parameters is not None else \
+            (self._parameter_list or None)
+        pg = append_backward(loss, parameter_list=params_arg,
+                             no_grad_set=no_grad_set)
+        if not pg:
+            return [], []
+        params = [p for p, _ in pg]
+        grad_ts = [g for _, g in pg]
+        lr = float(self.get_lr())
+
+        # discover each param's state (names, inits) with a shimmed dry
+        # run on zeros — nothing touches the real accumulators
+        metas = []
+        state_tensors = []
+        for j, p in enumerate(params):
+            shim = _AccShim(p)
+            with shim.bound(self):
+                self._update_param(p, jnp.zeros_like(p._data),
+                                   jnp.zeros_like(p._data), lr, {}, j)
+            metas.append(shim.names)
+            for name in shim.names:
+                t = Tensor(shim.inits[name])
+                t.name = f"{p.name or 'p%d' % j}_{name}"
+                state_tensors.append(t)
+
+        n = len(params)
+        opt = self
+
+        def step_fn(*arrays):
+            pvs = list(arrays[:n])
+            gvs = list(arrays[n:2 * n])
+            svs = list(arrays[2 * n:])
+            if opt._grad_clip is not None:
+                # clip classes are pure jnp over g._data — trace-safe
+                pg_t = [(p, Tensor(g)) for p, g in zip(params, gvs)]
+                gvs = [t._data for _, t in opt._grad_clip(pg_t)]
+            new_ps, new_ss = [], []
+            si = 0
+            for j, (p, names) in enumerate(zip(params, metas)):
+                gv = gvs[j].astype(pvs[j].dtype)
+                if not opt._decoupled_decay:
+                    gv = opt._apply_regularization(p, gv, {}, pv=pvs[j])
+                shim = _AccShim(p, preset=dict(
+                    zip(names, svs[si:si + len(names)])))
+                with shim.bound(opt):
+                    new_p = opt._update_param(p, pvs[j], gv, lr, {}, j)
+                new_ps.append(new_p.astype(arrays[j].dtype))
+                new_ss.extend(shim.values[nm] for nm in names)
+                si += len(names)
+            return tuple(new_ps) + tuple(new_ss)
+
+        out_ps = [Tensor(jnp.zeros_like(p._data),
+                         name=f"{p.name or 'p%d' % i}@NEW")
+                  for i, p in enumerate(params)]
+        out_ss = [Tensor(jnp.zeros_like(t._data), name=f"{t.name}@NEW")
+                  for t in state_tensors]
+        prog._record(step_fn, {},
+                     list(params) + grad_ts + state_tensors,
+                     out_ps + out_ss, multi_out=True,
+                     name=f"{type(self).__name__.lower()}_step")
+        prog.writebacks.extend(zip(params, out_ps))
+        prog.writebacks.extend(zip(state_tensors, out_ss))
+        return [], pg
 
     @no_grad()
     def clear_grad(self, set_to_zero: bool = True):

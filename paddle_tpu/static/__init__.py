@@ -207,19 +207,29 @@ class Executor:
             v = feed[n]
             feed_arrays.append(v._data if isinstance(v, Tensor)
                                else jnp.asarray(np.asarray(v)))
-        key = (id(program), len(program.ops), tuple(feed_names),
+        key = (id(program), len(program.ops), len(program.writebacks),
+               tuple(feed_names),
                tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
                tuple(id(t) for t in fetch_tensors))
         entry = self._cache.get(key)
         if entry is None:
-            pure, externals = program.build_replay(feed_names,
-                                                   fetch_tensors)
+            # write-back sources ride along as extra fetches: the pure
+            # replay stays functional, and the executor commits the new
+            # param/accumulator values after the step (the reference's
+            # in-place optimizer ops, made explicit)
+            wb_sources = [src for _, src in program.writebacks]
+            pure, externals = program.build_replay(
+                feed_names, fetch_tensors + wb_sources)
             fn = jax.jit(lambda f, e: pure(f, e))
             entry = (fn, externals)
             self._cache[key] = entry
         fn, externals = entry
         ext_arrays = [t._data for t in externals]
         outs = fn(tuple(feed_arrays), tuple(ext_arrays))
+        n_fetch = len(fetch_tensors)
+        for (target, _), val in zip(program.writebacks, outs[n_fetch:]):
+            target._data = val
+        outs = outs[:n_fetch]
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
@@ -312,16 +322,94 @@ def set_program_state(program, state):
             params[k]._data = jnp.asarray(np.asarray(v))
 
 
-def append_backward(loss, parameter_list=None, no_grad_set=None, **kw):
-    raise NotImplementedError(
-        "static-graph append_backward: use dygraph training with "
-        "@paddle.jit.to_static (the PIR-era recommended path); the "
-        "Executor serves inference programs")
-
-
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    raise NotImplementedError(
-        "static.gradients: use paddle.grad in dygraph mode")
+    """ref: base/backward.py gradients — static autodiff.
+
+    Records ONE grad op into the current program whose fn differentiates
+    the captured forward (via jax.grad over the program's pure replay)
+    and returns grad tensors aligned with ``inputs``.  The replayed
+    forward inside the grad op is CSE'd with the program's own forward
+    by XLA under the Executor's jit.
+    """
+    from .capture import current_program
+    prog = current_program() or _default_main
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    tg = (list(target_gradients)
+          if isinstance(target_gradients, (list, tuple))
+          else ([target_gradients] if target_gradients is not None
+                else [None] * len(targets)))
+    feed_names = sorted(prog.placeholders)
+    pure, externals = prog.build_replay(feed_names, targets)
+    ext_index = {id(t): i for i, t in enumerate(externals)}
+    feed_index = {id(prog.placeholders[n]): i
+                  for i, n in enumerate(feed_names)}
+    positions = []
+    for t in inputs:
+        if id(t) in ext_index:
+            positions.append(("ext", ext_index[id(t)]))
+        elif id(t) in feed_index:
+            positions.append(("feed", feed_index[id(t)]))
+        else:
+            positions.append(None)   # not consumed: grads are zero
+    feed_tensors = [prog.placeholders[n] for n in feed_names]
+    op_inputs = feed_tensors + list(externals)
+    nf = len(feed_tensors)
+
+    def grad_fn(*arrays):
+        feed_arrays, ext_arrays = arrays[:nf], arrays[nf:]
+
+        def total_loss(diff_vals):
+            fa, ea = list(feed_arrays), list(ext_arrays)
+            for pos, v in zip(positions, diff_vals):
+                if pos is None:
+                    continue
+                kind, i = pos
+                (fa if kind == "feed" else ea)[i] = v
+            outs = pure(tuple(fa), tuple(ea))
+            total = jnp.float32(0)
+            for o, g in zip(outs, tg):
+                o32 = o.astype(jnp.float32)
+                total = total + (jnp.sum(o32) if g is None else
+                                 jnp.sum(o32 * jnp.asarray(
+                                     g._data if isinstance(g, Tensor)
+                                     else g, jnp.float32)))
+            return total
+
+        diff_vals = tuple(
+            (feed_arrays[pos[1]] if pos[0] == "feed"
+             else ext_arrays[pos[1]])
+            if pos is not None else jnp.zeros_like(t._data)
+            for pos, t in zip(positions, inputs))
+        return jax.grad(total_loss)(diff_vals)
+
+    grad_tensors = [
+        Tensor(jnp.zeros_like(t._data),
+               name=f"{t.name or 'x%d' % i}@GRAD")
+        for i, t in enumerate(inputs)]
+    prog._record(grad_fn, {}, op_inputs, grad_tensors, multi_out=True,
+                 name="grad")
+    return grad_tensors
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, **kw):
+    """ref: base/backward.py append_backward — grads for every trainable
+    parameter of the current program; returns [(param, grad_var)]."""
+    from .capture import current_program
+    prog = current_program() or _default_main
+    params = list(parameter_list) if parameter_list is not None \
+        else prog.all_parameters()
+    if no_grad_set:
+        banned_names = {x for x in no_grad_set if isinstance(x, str)}
+        banned_ids = {id(x) for x in no_grad_set if isinstance(x, Tensor)}
+        params = [p for p in params
+                  if p.name not in banned_names and id(p) not in banned_ids]
+    params = [p for p in params if not p.stop_gradient]
+    grads = gradients([loss], params)
+    return list(zip(params, grads))
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):

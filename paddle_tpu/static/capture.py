@@ -43,6 +43,12 @@ class Program:
         self.ops: List[_OpRecord] = []
         self.placeholders: Dict[str, Tensor] = {}
         self.random_seed = 0
+        # state the Executor writes back after each run: (target tensor,
+        # source tensor) — how optimizer update ops (appended by
+        # minimize in static mode) mutate params/accumulators through a
+        # pure jitted replay (ref: the in-program sgd/adam ops the
+        # StandaloneExecutor runs in place)
+        self.writebacks: List = []
 
     # -- capture ---------------------------------------------------------
     def _record(self, fn, kwargs, in_tensors, out_tensors, multi_out, name):
@@ -85,6 +91,8 @@ class Program:
         p = Program()
         p.ops = list(self.ops)
         p.placeholders = dict(self.placeholders)
+        # a test clone serves inference: drop the training write-backs
+        p.writebacks = [] if for_test else list(self.writebacks)
         return p
 
     def __repr__(self):
@@ -98,12 +106,17 @@ class Program:
         external_arrays) -> fetch arrays.  External tensors are inputs
         produced outside the program (parameters, constants) — passed at
         run time so parameter updates are visible without retracing."""
+        # snapshot NOW: ops recorded later (e.g. a grad op whose fn
+        # closes over this replay) must not appear in it — iterating
+        # self.ops live would make such an op replay itself, recursing
+        # forever
+        ops = list(self.ops)
         produced = set()
         feed_ids = {id(self.placeholders[n]) for n in feed_names
                     if n in self.placeholders}
         externals: List[Tensor] = []
         ext_ids = {}
-        for op in self.ops:
+        for op in ops:
             for t in op.inputs:
                 if id(t) not in produced and id(t) not in feed_ids and \
                         id(t) not in ext_ids:
@@ -123,7 +136,7 @@ class Program:
             for tid, i in ext_ids.items():
                 env[tid] = ext_arrays[i]
 
-            for op in self.ops:
+            for op in ops:
                 ins = [env.get(id(t), t._data) for t in op.inputs]
                 outs = op.fn(*ins, **op.kwargs)
                 if op.multi_out:

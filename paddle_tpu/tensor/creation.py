@@ -209,11 +209,22 @@ def as_tensor(data, dtype=None, place=None):
 
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
-    """ref: paddle.create_parameter — used by custom layers."""
-    from ..nn.initializer import _apply_initializer
+    """ref: paddle.create_parameter — used by custom layers.
+    Default init matches the reference: zeros for biases,
+    Xavier-uniform for weights (ParamAttr.initializer wins)."""
+    from ..framework.param_attr import ParamAttr
+    from ..nn import initializer as I
     shape = shape_list(shape)
-    p = Parameter(jnp.zeros(shape, dtype=dtypes.to_jax(dtype)), name=name)
-    _apply_initializer(p, default_initializer, is_bias=is_bias, attr=attr)
+    attr = ParamAttr._to_attr(attr)   # str / Initializer / None all valid
+    if attr is None:
+        raise ValueError("create_parameter got attr=False — a parameter "
+                         "cannot be disabled here")
+    init = default_initializer or attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    p = Parameter(jnp.asarray(init(shape, dtype)),
+                  name=name or attr.name)
+    p._paddle_attrs = attr
     return p
 
 

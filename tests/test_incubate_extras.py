@@ -87,6 +87,38 @@ def test_distributed_fused_lamb_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_lookahead_first_sync_pulls_toward_init():
+    """slow weights snapshot the INITIAL params, so the first sync at
+    step k moves fast weights back toward p0 (not a no-op)."""
+    from paddle_tpu.incubate import LookAhead
+    paddle.seed(4)
+    w = paddle.to_tensor(np.array([[1.0]], "float32"), stop_gradient=False)
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.array([[1.0]], "float32"))
+    for _ in range(2):
+        loss = (w * x).sum()       # grad = 1 each step
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    # fast after 2 sgd steps: 1 - 2 = -1; slow0 = 1; sync: 1 + 0.5*(-2)=0
+    np.testing.assert_allclose(np.asarray(w.numpy()), [[0.0]], atol=1e-6)
+
+
+def test_modelaverage_window_bounded():
+    from paddle_tpu.incubate import ModelAverage
+    w = paddle.to_tensor(np.array([0.0], "float32"))
+    ma = ModelAverage(1.0, parameters=[w], min_average_window=2,
+                      max_average_window=2)
+    for v in [1.0, 2.0, 100.0, 200.0]:
+        w.set_value(paddle.to_tensor(np.array([v], "float32")))
+        ma.step()
+    ma.apply(need_restore=False)
+    # window folds every 2 steps: average covers the last 1-2 windows
+    # ([100,200] here), never the whole history
+    assert float(w.numpy()[0]) == pytest.approx(150.0)
+
+
 def test_lookahead_and_modelaverage():
     from paddle_tpu.incubate import LookAhead, ModelAverage
     m = _mlp()

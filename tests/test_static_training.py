@@ -1,0 +1,164 @@
+"""Static-graph autodiff + in-program optimizer training
+(ref: test/legacy_test static training tests: build program under
+program_guard, append_backward / optimizer.minimize, exe.run loop)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _build_linear_program(lr_opt=None, clip=None):
+    """y = x @ w + b; loss = mean((y - t)^2), with optional minimize."""
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        t = static.data("t", [4, 1], "float32")
+        w = paddle.create_parameter([3, 1], "float32", name="w")
+        b = paddle.create_parameter([1], "float32", name="b")
+        y = paddle.matmul(x, w) + b
+        loss = ((y - t) ** 2).mean()
+        extras = {}
+        if lr_opt is not None:
+            opt = lr_opt(clip)
+            opt_ops, pg = opt.minimize(loss)
+            extras["pg"] = pg
+    paddle.disable_static()
+    return main, loss, (w, b), extras
+
+
+def _data(seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(4, 3).astype("float32")
+    w_true = np.array([[1.5], [-2.0], [0.5]], "float32")
+    t = x @ w_true + 0.25
+    return x, t, w_true
+
+
+def test_gradients_match_analytic():
+    main, loss, (w, b), _ = _build_linear_program()
+    x, t, _ = _data()
+    paddle.enable_static()
+    with static.program_guard(main):
+        gw, gb = static.gradients([loss], [w, b])
+    paddle.disable_static()
+    exe = static.Executor()
+    gw_v, gb_v = exe.run(main, feed={"x": x, "t": t},
+                         fetch_list=[gw, gb])
+    # analytic: d/dw mean((xw+b-t)^2) = 2/N x^T (xw + b - t)
+    r = x @ np.asarray(w.numpy()) + np.asarray(b.numpy()) - t
+    np.testing.assert_allclose(gw_v, 2 / 4 * x.T @ r, rtol=1e-5)
+    np.testing.assert_allclose(gb_v, 2 / 4 * r.sum(0), rtol=1e-5)
+
+
+def test_append_backward_param_grad_pairs():
+    main, loss, (w, b), _ = _build_linear_program()
+    x, t, _ = _data()
+    paddle.enable_static()
+    with static.program_guard(main):
+        pg = static.append_backward(loss)
+    paddle.disable_static()
+    assert [p.name for p, _ in pg] == ["w", "b"]
+    exe = static.Executor()
+    outs = exe.run(main, feed={"x": x, "t": t},
+                   fetch_list=[g for _, g in pg])
+    assert all(np.isfinite(o).all() for o in outs)
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda clip: paddle.optimizer.SGD(learning_rate=0.1, grad_clip=clip),
+    lambda clip: paddle.optimizer.Momentum(learning_rate=0.1,
+                                           momentum=0.9, grad_clip=clip),
+    lambda clip: paddle.optimizer.Adam(learning_rate=0.1, grad_clip=clip),
+    lambda clip: paddle.optimizer.AdamW(learning_rate=0.1,
+                                        weight_decay=0.0, grad_clip=clip),
+], ids=["sgd", "momentum", "adam", "adamw"])
+def test_static_minimize_trains(make_opt):
+    main, loss, (w, b), ex = _build_linear_program(lr_opt=make_opt)
+    x, t, w_true = _data()
+    exe = static.Executor()
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": x, "t": t}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+    # params actually moved toward the generating model
+    assert np.abs(np.asarray(w.numpy()) - w_true).mean() < \
+        np.abs(w_true).mean()
+
+
+def test_static_minimize_parity_with_eager():
+    """The in-program Adam must match eager Adam step-for-step."""
+    x, t, _ = _data(3)
+
+    main, loss, (w, b), _ = _build_linear_program(
+        lr_opt=lambda clip: paddle.optimizer.Adam(learning_rate=0.05))
+    w0 = np.asarray(w.numpy()).copy()
+    b0 = np.asarray(b.numpy()).copy()
+    exe = static.Executor()
+    st_losses = [float(exe.run(main, feed={"x": x, "t": t},
+                               fetch_list=[loss])[0]) for _ in range(5)]
+
+    we = paddle.to_tensor(w0, stop_gradient=False)
+    be = paddle.to_tensor(b0, stop_gradient=False)
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[we, be])
+    xe, te = paddle.to_tensor(x), paddle.to_tensor(t)
+    eager_losses = []
+    for _ in range(5):
+        l = ((paddle.matmul(xe, we) + be - te) ** 2).mean()
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+        eager_losses.append(float(l))
+    np.testing.assert_allclose(st_losses, eager_losses, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(w.numpy()),
+                               np.asarray(we.numpy()), rtol=1e-4)
+
+
+def test_static_minimize_with_global_norm_clip():
+    main, loss, (w, b), _ = _build_linear_program(
+        lr_opt=lambda clip: paddle.optimizer.SGD(learning_rate=0.05,
+                                                 grad_clip=clip),
+        clip=paddle.nn.ClipGradByGlobalNorm(0.1))
+    x, t, _ = _data(1)
+    exe = static.Executor()
+    losses = [float(exe.run(main, feed={"x": x, "t": t},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_static_minimize_respects_optimizer_param_subset():
+    """an optimizer built over a subset must not train other params."""
+    paddle.enable_static()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 2], "float32")
+        t = static.data("t", [4, 1], "float32")
+        w1 = paddle.create_parameter([2, 2], "float32", name="w1")
+        w2 = paddle.create_parameter([2, 1], "float32", name="w2")
+        loss = ((paddle.matmul(paddle.matmul(x, w1), w2) - t) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w2])
+        opt.minimize(loss)
+    paddle.disable_static()
+    x, t, _ = _data()
+    x = x[:, :2]
+    w1_before = np.asarray(w1.numpy()).copy()
+    w2_before = np.asarray(w2.numpy()).copy()
+    exe = static.Executor()
+    exe.run(main, feed={"x": x, "t": t}, fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(w1.numpy()), w1_before)
+    assert not np.allclose(np.asarray(w2.numpy()), w2_before)
+
+
+def test_clone_for_test_drops_writebacks():
+    main, loss, (w, b), _ = _build_linear_program(
+        lr_opt=lambda clip: paddle.optimizer.SGD(learning_rate=0.1))
+    infer = main.clone(for_test=True)
+    assert infer.writebacks == [] and main.writebacks
+    x, t, _ = _data(2)
+    exe = static.Executor()
+    w_before = np.asarray(w.numpy()).copy()
+    exe.run(infer, feed={"x": x, "t": t}, fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(w.numpy()), w_before)
