@@ -261,6 +261,7 @@ def main():
         retry_env = {"BENCH_PRESET": "gpt3-350M", "BENCH_STEPS": "3",
                      "BENCH_SEQ": "1024",
                      "FLAGS_use_pallas_attention": "0",
+                     "FLAGS_use_pallas_rms_norm": "0",
                      "JAX_ENABLE_COMPILATION_CACHE": "false"}
         line, err = _run_child(retry_env, min(t_tpu, 240))
         if line:

@@ -65,13 +65,12 @@ class _Agent:
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
         self.ip = os.environ.get("POD_IP", "127.0.0.1")
-        # DISTINCT pools: handlers on the caller's pool would deadlock —
-        # 8 outstanding rpc_async calls fill it with threads blocked on
-        # replies that the queued handlers can never produce
+        # client-side async pool; the SERVER is thread-per-connection
+        # (keep-alive connections park in recv for their lifetime — on a
+        # bounded pool, world_size-1 pooled peers would permanently
+        # occupy every worker and starve new connections)
         self._pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"rpc-client-{name}")
-        self._serve_pool = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix=f"rpc-server-{name}")
         self._is_store_master = is_master
         self._conns: Dict[str, List] = {}
         self._conn_lock = threading.Lock()
@@ -99,7 +98,8 @@ class _Agent:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
-            self._serve_pool.submit(self._handle, conn)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
 
     def _handle(self, conn):
         try:
@@ -140,8 +140,9 @@ class _Agent:
                              f"{sorted(self._peers)}")
         s = self._checkout_conn(to, info, timeout)
         try:
-            if timeout and timeout > 0:
-                s.settimeout(timeout)
+            # always (re)set: a pooled socket keeps its previous call's
+            # deadline otherwise
+            s.settimeout(timeout if timeout and timeout > 0 else None)
             _send_msg(s, pickle.dumps((fn, args, kwargs)))
             status, payload = pickle.loads(_recv_msg(s)[0])
         except BaseException:
@@ -201,7 +202,6 @@ class _Agent:
                         pass
             self._conns.clear()
         self._pool.shutdown(wait=False)
-        self._serve_pool.shutdown(wait=False)
 
     def infos(self) -> List[WorkerInfo]:
         return sorted(self._peers.values(), key=lambda w: w.rank)

@@ -94,6 +94,8 @@ define_flag("allocator_strategy", "auto_growth", "accepted for API parity")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "accepted for API parity")
 define_flag("use_pallas_attention", True,
             "route attention through the Pallas flash kernel on TPU")
+define_flag("use_pallas_rms_norm", True,
+            "route fused_rms_norm through the Pallas kernel on TPU")
 define_flag("pallas_interpret", False,
             "run Pallas kernels in interpreter mode (CPU tests)")
 define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")

@@ -195,6 +195,17 @@ class Model:
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
+            # distributed auto-wiring (ref: Model._init_context +
+            # DistributedBatchSampler in hapi/model.py): under a
+            # multi-process launch each rank reads its own shard
+            from ..distributed import get_world_size
+            if get_world_size() > 1:
+                from ..io import DistributedBatchSampler
+                sampler = DistributedBatchSampler(
+                    data, batch_size=batch_size, shuffle=shuffle,
+                    drop_last=False)
+                return DataLoader(data, batch_sampler=sampler,
+                                  num_workers=num_workers)
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               num_workers=num_workers, drop_last=False)
         return data  # assume iterable of batches
@@ -226,6 +237,11 @@ class Model:
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch, {})
+            # distributed sampler reshuffles per epoch (ref: Model.fit
+            # advancing DistributedBatchSampler.set_epoch)
+            sampler = getattr(loader, "batch_sampler", None)
+            if sampler is not None and hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(epoch)
             for metric in self._metrics:
                 metric.reset()
             logs = {}

@@ -11,12 +11,27 @@ import jax.numpy as jnp
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, **kw):
-    """ref: incubate fused_rms_norm."""
+    """ref: incubate fused_rms_norm — Pallas kernel on TPU (hand-written
+    fwd/bwd, ops/pallas/rms_norm.py), jnp composition elsewhere."""
     args = [x if isinstance(x, Tensor) else Tensor(x),
             norm_weight if isinstance(norm_weight, Tensor) else Tensor(norm_weight)]
     has_bias = norm_bias is not None
     if has_bias:
         args.append(norm_bias if isinstance(norm_bias, Tensor) else Tensor(norm_bias))
+
+    from ....ops.pallas import rms_norm as _prms
+    if _prms.available():
+        from ....flags import get_flag
+        interp = bool(get_flag("pallas_interpret"))
+
+        def f(v, w, *rest):
+            out = _prms.rms_norm_pallas(v, w, float(epsilon),
+                                        _prms.DEFAULT_BLOCK_N, interp)
+            if rest:
+                out = out + rest[0]
+            return out
+
+        return call_op(f, tuple(args), {}, op_name="rms_norm"), None
 
     def f(v, w, *rest):
         var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,

@@ -280,19 +280,32 @@ class Optimizer:
         lr = float(self.get_lr())
 
         # discover each param's state (names, inits) with a shimmed dry
-        # run on zeros — nothing touches the real accumulators
+        # run on zeros — nothing touches the real accumulators.  The
+        # dry run (and the replay) patches _global_step: optimizers with
+        # step-dependent bias correction (RAdam/NAdam) read it, and the
+        # eager value here is 0 (division by (1 - beta^0) explodes)
         metas = []
         state_tensors = []
-        for j, p in enumerate(params):
-            shim = _AccShim(p)
-            with shim.bound(self):
-                self._update_param(p, jnp.zeros_like(p._data),
-                                   jnp.zeros_like(p._data), lr, {}, j)
-            metas.append(shim.names)
-            for name in shim.names:
-                t = Tensor(shim.inits[name])
-                t.name = f"{p.name or 'p%d' % j}_{name}"
-                state_tensors.append(t)
+        saved_step = self._global_step
+        try:
+            self._global_step = 1
+            for j, p in enumerate(params):
+                shim = _AccShim(p)
+                with shim.bound(self):
+                    self._update_param(p, jnp.zeros_like(p._data),
+                                       jnp.zeros_like(p._data), lr, {}, j)
+                metas.append(shim.names)
+                for name in shim.names:
+                    t = Tensor(shim.inits[name])
+                    t.name = f"{p.name or 'p%d' % j}_{name}"
+                    state_tensors.append(t)
+        finally:
+            self._global_step = saved_step
+        # the step counter itself is traced state (a baked python int
+        # would freeze bias correction at the build-time value)
+        step_t = Tensor(jnp.zeros((), jnp.int32))
+        step_t.name = "global_step"
+        state_tensors.append(step_t)
 
         n = len(params)
         opt = self
@@ -301,24 +314,32 @@ class Optimizer:
             pvs = list(arrays[:n])
             gvs = list(arrays[n:2 * n])
             svs = list(arrays[2 * n:])
+            gs_new = svs[-1] + 1          # traced step counter
+            svs = svs[:-1]
             if opt._grad_clip is not None:
                 # clip classes are pure jnp over g._data — trace-safe
                 pg_t = [(p, Tensor(g)) for p, g in zip(params, gvs)]
                 gvs = [t._data for _, t in opt._grad_clip(pg_t)]
             new_ps, new_ss = [], []
             si = 0
-            for j, (p, names) in enumerate(zip(params, metas)):
-                gv = gvs[j].astype(pvs[j].dtype)
-                if not opt._decoupled_decay:
-                    gv = opt._apply_regularization(p, gv, {}, pv=pvs[j])
-                shim = _AccShim(p, preset=dict(
-                    zip(names, svs[si:si + len(names)])))
-                with shim.bound(opt):
-                    new_p = opt._update_param(p, pvs[j], gv, lr, {}, j)
-                new_ps.append(new_p.astype(arrays[j].dtype))
-                new_ss.extend(shim.values[nm] for nm in names)
-                si += len(names)
-            return tuple(new_ps) + tuple(new_ss)
+            saved = opt._global_step
+            try:
+                opt._global_step = gs_new
+                for j, (p, names) in enumerate(zip(params, metas)):
+                    gv = gvs[j].astype(pvs[j].dtype)
+                    if not opt._decoupled_decay:
+                        gv = opt._apply_regularization(p, gv, {},
+                                                       pv=pvs[j])
+                    shim = _AccShim(p, preset=dict(
+                        zip(names, svs[si:si + len(names)])))
+                    with shim.bound(opt):
+                        new_p = opt._update_param(p, pvs[j], gv, lr, {}, j)
+                    new_ps.append(new_p.astype(arrays[j].dtype))
+                    new_ss.extend(shim.values[nm] for nm in names)
+                    si += len(names)
+            finally:
+                opt._global_step = saved
+            return tuple(new_ps) + tuple(new_ss) + (gs_new,)
 
         out_ps = [Tensor(jnp.zeros_like(p._data),
                          name=f"{p.name or 'p%d' % i}@NEW")

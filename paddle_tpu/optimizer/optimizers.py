@@ -313,12 +313,15 @@ class RAdam(Optimizer):
         rho_inf = 2 / (1 - b2) - 1
         rho_t = rho_inf - 2 * t * (b2 ** t) / (1 - b2 ** t)
         m_hat = m / (1 - b1 ** t)
-        if rho_t > 5:
-            lt = jnp.sqrt((1 - b2 ** t)) / (jnp.sqrt(v) + eps)
-            rt = ((rho_t - 4) * (rho_t - 2) * rho_inf /
-                  ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
-            return pv - lr * m_hat * rt * lt
-        return pv - lr * m_hat
+        # branchless variance-rectification select: t may be a TRACED
+        # step (static minimize threads it through the jitted update),
+        # where a python `if rho_t > 5` cannot trace; the not-taken
+        # branch is clamped so its sqrt stays finite
+        lt = jnp.sqrt((1 - b2 ** t)) / (jnp.sqrt(v) + eps)
+        rt_num = jnp.maximum((rho_t - 4) * (rho_t - 2) * rho_inf, 0.0)
+        rt_den = jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, eps)
+        rt = jnp.sqrt(rt_num / rt_den)
+        return pv - lr * jnp.where(rho_t > 5, m_hat * rt * lt, m_hat)
 
 
 class Rprop(Optimizer):

@@ -223,7 +223,8 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierUniform()
     p = Parameter(jnp.asarray(init(shape, dtype)),
-                  name=name or attr.name)
+                  name=name or attr.name,
+                  trainable=getattr(attr, "trainable", True))
     p._paddle_attrs = attr
     return p
 
