@@ -236,4 +236,100 @@ class _CudaNamespace:
         pass
 
 
+class Event:
+    """ref: paddle.device.cuda.Event — timestamp semantics over the
+    XLA queue: record() synchronizes-and-stamps (XLA has no user-visible
+    stream timeline; kernel-level timing belongs to paddle.profiler)."""
+
+    def __init__(self, enable_timing: bool = True, blocking: bool = False,
+                 interprocess: bool = False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def query(self) -> bool:
+        return self._t is not None
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end: "Event") -> float:
+        """Milliseconds between two recorded events."""
+        if self._t is None or end._t is None:
+            raise RuntimeError("both events must be recorded first")
+        return (end._t - self._t) * 1000.0
+
+
+class Stream:
+    """ref: paddle.device.cuda.Stream — XLA owns scheduling; the API
+    surface is preserved so stream-annotated code runs unchanged."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self) -> bool:
+        return True
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _current_stream
+
+
+class stream_guard:
+    """ref: paddle.device.cuda.stream_guard — a no-op scope (XLA
+    schedules; kept so guarded code is portable)."""
+
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_device_properties(device=None):
+    """ref: cuda.get_device_properties — TPU chip properties."""
+    d = jax.devices()[0]
+    stats = getattr(d, "memory_stats", lambda: None)() or {}
+
+    class _Props:
+        name = getattr(d, "device_kind", "TPU")
+        major, minor = 0, 0
+        total_memory = stats.get("bytes_limit", 0)
+        multi_processor_count = 1
+
+        def __repr__(self):
+            return (f"_gpuDeviceProperties(name='{self.name}', "
+                    f"total_memory={self.total_memory})")
+
+    return _Props()
+
+
 cuda = _CudaNamespace()
+cuda.Event = Event
+cuda.Stream = Stream
+cuda.current_stream = current_stream
+cuda.stream_guard = stream_guard
+cuda.get_device_properties = get_device_properties
