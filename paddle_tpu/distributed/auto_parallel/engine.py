@@ -17,6 +17,26 @@ from ...nn.layer.layers import Layer
 from .api import ProcessMesh, get_mesh
 from .strategy import Strategy
 
+# bf16 peak FLOPs per chip by TPU generation (public spec sheets) —
+# keyed on device_kind, mirroring bench.py's table
+_TPU_PEAK_BF16 = {
+    "v2": 46e12, "v3": 123e12, "v4": 275e12,
+    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+}
+
+
+def _chip_peak_flops() -> float:
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower().replace(" ", "")
+    for key, peak in sorted(_TPU_PEAK_BF16.items(),
+                            key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return peak
+    # non-TPU backend (CPU test mesh): a nominal figure — cost() is a
+    # planning estimate, not a measurement
+    return 1e12
+
 
 class Engine:
     def __init__(self, model: Layer, loss=None, optimizer=None,
@@ -122,4 +142,36 @@ class Engine:
                 self._optimizer.set_state_dict(pload(path + ".pdopt"))
 
     def cost(self, mode="train"):
-        return None
+        """ref: Engine.cost — estimated (max_memory, time) of one step.
+
+        The reference runs its own analytic cost model over the
+        partitioned program; here XLA itself is the cost model: the
+        jitted step's memory analysis gives the executable's peak
+        footprint (args + outputs + temps) and its cost analysis gives
+        FLOPs.  Returns ``(max_memory_bytes, time_cost_s)`` like the
+        reference (time from FLOPs at a nominal 50% MFU of the attached
+        chip's peak); ``None`` before the step has compiled."""
+        step = self._train_step
+        if step is None or getattr(step, "_jitted", None) is None:
+            return None
+        try:
+            compiled = step._jitted.lower(*step._cost_args).compile()
+            cost = compiled.cost_analysis()
+        except Exception:
+            return None
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        mem_bytes = 0
+        try:
+            ma = compiled.memory_analysis()
+            mem_bytes = int(
+                getattr(ma, "argument_size_in_bytes", 0)
+                + getattr(ma, "output_size_in_bytes", 0)
+                + getattr(ma, "temp_size_in_bytes", 0))
+        except Exception:
+            pass
+        if not mem_bytes:
+            mem_bytes = int(float(cost.get("bytes accessed", 0.0)))
+        time_cost = flops / (0.5 * _chip_peak_flops()) if flops else 0.0
+        return (mem_bytes, time_cost)

@@ -43,6 +43,13 @@ def define_flag(name: str, default: Any, help: str = "",
     env = os.environ.get(name)
     flag.value = _coerce(ftype, env) if env is not None else default
     _REGISTRY[name] = flag
+    if env is not None and on_change is not None:
+        try:
+            on_change(flag.value)   # env override takes effect at import
+        except Exception as e:      # a typo'd env var must not brick import
+            import warnings
+            warnings.warn(f"ignoring invalid {name}={env!r}: {e}")
+            flag.value = default
 
 
 def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
@@ -69,9 +76,12 @@ def set_flags(flags: Dict[str, Any]) -> None:
         if key not in _REGISTRY:
             raise ValueError(f"unknown flag {n!r}")
         f = _REGISTRY[key]
-        f.value = _coerce(f.type, v)
+        new = _coerce(f.type, v)
+        # validate via on_change BEFORE committing: a rejected value
+        # must not leave the registry diverged from actual behavior
         if f.on_change is not None:
-            f.on_change(f.value)
+            f.on_change(new)
+        f.value = new
 
 
 def get_flag(name: str) -> Any:
@@ -98,6 +108,21 @@ define_flag("use_pallas_rms_norm", True,
             "route fused_rms_norm through the Pallas kernel on TPU")
 define_flag("pallas_interpret", False,
             "run Pallas kernels in interpreter mode (CPU tests)")
+def _apply_transfer_guard(val: str):
+    """Race-detection aid (SURVEY.md §5): surface implicit host<->device
+    transfers — the TPU analogue of the reference's stream-safety
+    debugging flags.  Values: allow | log | disallow."""
+    if val not in ("allow", "log", "disallow", "log_explicit",
+                   "disallow_explicit"):
+        raise ValueError(
+            f"FLAGS_transfer_guard must be allow/log/disallow, got {val!r}")
+    import jax
+    jax.config.update("jax_transfer_guard", val)
+
+
+define_flag("transfer_guard", "allow",
+            "guard implicit host<->device transfers (allow|log|disallow)",
+            on_change=_apply_transfer_guard)
 define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")
 define_flag("embedding_deterministic", 0, "deterministic embedding lookup")
 define_flag("log_level", 0, "framework VLOG level")

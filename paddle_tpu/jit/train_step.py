@@ -259,6 +259,14 @@ class TrainStep:
             fn = jax.jit(step, **kw)
             self._jit_cache[key] = fn
         lr = jnp.asarray(self._current_lr(), dtype=jnp.float32)
+        # abstract call signature for cost analysis (Engine.cost lowers
+        # the step once more on ShapeDtypeStructs — no arrays retained);
+        # built once per trace key, never on the steady-state hot path
+        if self._jitted is not fn:
+            self._jitted = fn
+            _sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            self._cost_args = (jax.tree.map(_sds, state), _sds(lr),
+                               jax.tree.map(_sds, batch_arrays))
         new_state, loss = fn(state, lr, batch_arrays)
         # swap updated arrays back into the live objects
         for p, v in zip(self.params, new_state["p"]):

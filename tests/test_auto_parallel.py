@@ -136,3 +136,32 @@ def test_shard_layer_replicates():
     layer = nn.Linear(4, 4)
     shard_layer(layer, mesh)
     assert layer.weight._dist_attr is not None
+
+
+def test_engine_cost_returns_estimates():
+    """Engine.cost (ref: Engine.cost) — XLA cost analysis of the step."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed.auto_parallel import Engine
+
+    m = paddle.nn.Linear(16, 4)
+    o = popt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    eng = Engine(m, loss=lambda out, y: ((out - y) ** 2).mean(),
+                 optimizer=o)
+    assert eng.cost() is None      # not compiled yet
+
+    class DS:
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            rs = np.random.RandomState(i)
+            return (rs.randn(4, 16).astype("float32"),
+                    rs.randn(4, 4).astype("float32"))
+
+    eng.fit(DS(), batch_size=None, epochs=1)
+    cost = eng.cost()
+    assert cost is not None
+    mem_bytes, time_s = cost
+    assert mem_bytes > 0 and time_s >= 0

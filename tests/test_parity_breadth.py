@@ -314,3 +314,22 @@ def test_svd_lowrank(rng):
     approx = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
     np.testing.assert_allclose(approx, a, rtol=1e-3, atol=1e-3)
     assert S.shape == [5]
+
+
+def test_transfer_guard_flag():
+    """FLAGS_transfer_guard (SURVEY.md §5 race detection): disallow
+    surfaces implicit device->host transfers as errors."""
+    import numpy as np
+    import paddle_tpu as paddle
+    paddle.set_flags({"FLAGS_transfer_guard": "disallow"})
+    try:
+        x = paddle.to_tensor(np.ones((4,), "float32"))
+        with pytest.raises(Exception):
+            np.asarray(x.value + 1)
+    finally:
+        paddle.set_flags({"FLAGS_transfer_guard": "allow"})
+    # and back to allowed
+    x = paddle.to_tensor(np.ones((4,), "float32"))
+    assert np.asarray(x.value + 1).sum() == 8
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_transfer_guard": "bogus"})
