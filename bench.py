@@ -36,20 +36,11 @@ import numpy as np
 A100_PEAK_BF16 = 312e12
 A100_MFU_EST = 0.45
 
-# bf16 peak FLOPs per chip by TPU generation (public spec sheets); used
-# only for the extra "mfu" diagnostic, never for vs_baseline.
-TPU_PEAK_BF16 = {
-    "v2": 46e12, "v3": 123e12, "v4": 275e12,
-    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
-}
-
-
 def _chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for key, peak in sorted(TPU_PEAK_BF16.items(), key=lambda kv: -len(kv[0])):
-        if key in kind:
-            return peak
-    return 197e12  # unknown TPU: assume v5e-class
+    """Peak bf16 FLOPs for the "mfu" diagnostic (never vs_baseline).
+    Canonical table lives in paddle_tpu.device.chip_peak_flops."""
+    from paddle_tpu.device import chip_peak_flops
+    return chip_peak_flops(device, default=197e12)  # unknown: v5e-class
 
 
 def _baseline_tokens_per_sec(n_params: float) -> float:

@@ -196,6 +196,26 @@ def synchronize(device=None):
     jnp.zeros(()).block_until_ready()
 
 
+# bf16 peak FLOPs per chip by TPU generation (public spec sheets) —
+# the single source for Engine.cost and bench.py MFU numbers
+TPU_PEAK_BF16 = {
+    "v2": 46e12, "v3": 123e12, "v4": 275e12,
+    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+}
+
+
+def chip_peak_flops(device=None, default: float = 1e12) -> float:
+    """Peak bf16 FLOPs of the attached chip, keyed on device_kind;
+    ``default`` for non-TPU backends (CPU test mesh)."""
+    d = device if device is not None else jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower().replace(" ", "")
+    for key, peak in sorted(TPU_PEAK_BF16.items(),
+                            key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return peak
+    return default
+
+
 class _CudaNamespace:
     """paddle.device.cuda parity shims (memory stats come from PJRT)."""
 

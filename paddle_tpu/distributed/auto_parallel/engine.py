@@ -17,25 +17,7 @@ from ...nn.layer.layers import Layer
 from .api import ProcessMesh, get_mesh
 from .strategy import Strategy
 
-# bf16 peak FLOPs per chip by TPU generation (public spec sheets) —
-# keyed on device_kind, mirroring bench.py's table
-_TPU_PEAK_BF16 = {
-    "v2": 46e12, "v3": 123e12, "v4": 275e12,
-    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
-}
-
-
-def _chip_peak_flops() -> float:
-    import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower().replace(" ", "")
-    for key, peak in sorted(_TPU_PEAK_BF16.items(),
-                            key=lambda kv: -len(kv[0])):
-        if key in kind:
-            return peak
-    # non-TPU backend (CPU test mesh): a nominal figure — cost() is a
-    # planning estimate, not a measurement
-    return 1e12
+from ...device import chip_peak_flops as _chip_peak_flops
 
 
 class Engine:
@@ -154,8 +136,19 @@ class Engine:
         step = self._train_step
         if step is None or getattr(step, "_jitted", None) is None:
             return None
+        # lower+compile bypasses jax.jit's executable cache — cache the
+        # result per trace signature so repeated cost() calls (logging
+        # loops) don't pay a redundant full XLA compile each time
+        cache = getattr(step, "_cost_compiled", None)
+        if cache is not None and cache[0] is step._cost_args:
+            compiled = cache[1]
+        else:
+            try:
+                compiled = step._jitted.lower(*step._cost_args).compile()
+            except Exception:
+                return None
+            step._cost_compiled = (step._cost_args, compiled)
         try:
-            compiled = step._jitted.lower(*step._cost_args).compile()
             cost = compiled.cost_analysis()
         except Exception:
             return None

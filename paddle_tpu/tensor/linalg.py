@@ -347,3 +347,11 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         return u[..., :, :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :, :q]
     outs = call_op(f, (x,), {}, multi_out=True, op_name="pca_lowrank")
     return outs[0], outs[1], outs[2]
+
+
+def matrix_exp(x, name=None):
+    """ref: paddle.linalg.matrix_exp — Padé-approximant expm (XLA's
+    scaling-and-squaring via jax.scipy)."""
+    from jax.scipy.linalg import expm as _expm
+    x = ensure_tensor(x)
+    return call_op(lambda a: _expm(a), (x,), {}, op_name="matrix_exp")

@@ -275,3 +275,385 @@ class RoIPool:
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self.output_size,
                         self.spatial_scale)
+
+
+
+# ---------------------------------------------------------------------------
+# detection ops (ref: vision/ops.py deform_conv2d / yolo_box / prior_box /
+# psroi_pool / matrix_nms — phi CUDA kernels there; jnp/gather here)
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """ref: vision/ops.py deform_conv2d (v1; v2 when ``mask`` given).
+
+    Gather-based bilinear sampling builds the deformed im2col tensor,
+    then ONE grouped einsum against the flattened weight — sampling is
+    VPU-gather work, the contraction lands on the MXU.
+    """
+    import jax
+    x = _as_tensor(x)
+    offset = _as_tensor(offset)
+    weight = _as_tensor(weight)
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(_as_tensor(mask))
+    if bias is not None:
+        args.append(_as_tensor(bias))
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def f(xv, off, w, *rest):
+        mk = rest[0] if has_mask else None
+        bv = rest[-1] if has_bias else None
+        N, C, H, W = xv.shape
+        Co, Cg, kh, kw = w.shape
+        dg = deformable_groups
+        cpg = C // dg
+        K = kh * kw
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        # base sampling position per kernel tap: [K, Ho, Wo]
+        oy = jnp.arange(Ho) * s[0] - p[0]
+        ox = jnp.arange(Wo) * s[1] - p[1]
+        ky = jnp.repeat(jnp.arange(kh) * d[0], kw)
+        kx = jnp.tile(jnp.arange(kw) * d[1], kh)
+        base_y = (oy[None, :, None] + ky[:, None, None]).astype(jnp.float32)
+        base_x = (ox[None, None, :] + kx[:, None, None]).astype(jnp.float32)
+        # offsets [N, 2*dg*K, Ho, Wo] → per-tap (y, x): [N, dg, K, Ho, Wo]
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        sy = base_y[None, None] + off[:, :, :, 0]
+        sx = base_x[None, None] + off[:, :, :, 1]
+
+        ximg = xv.reshape(N, dg, cpg, H, W)
+
+        def sample_one(img, yy, xx):
+            # img [cpg, H, W]; yy/xx [K, Ho, Wo] float sampling points.
+            # Zero-padding semantics PER NEIGHBOR (ref CUDA kernel): a
+            # point at y=-0.5 blends 0.5*row0 + 0.5*zero — clipping the
+            # coordinate would give full-weight row0 and wrong border
+            # values/gradients
+            y0i = jnp.floor(yy).astype(jnp.int32)
+            x0i = jnp.floor(xx).astype(jnp.int32)
+            ly = (yy - y0i)[None]
+            lx = (xx - x0i)[None]
+
+            def tap(yi, xi):
+                ok = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+                v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                return v * ok[None]
+
+            v00 = tap(y0i, x0i)
+            v01 = tap(y0i, x0i + 1)
+            v10 = tap(y0i + 1, x0i)
+            v11 = tap(y0i + 1, x0i + 1)
+            return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                    v10 * ly * (1 - lx) + v11 * ly * lx)
+
+        # [N, dg, cpg, K, Ho, Wo]
+        sampled = jax.vmap(jax.vmap(sample_one))(ximg, sy, sx)
+        if mk is not None:
+            m = mk.reshape(N, dg, K, Ho, Wo)
+            sampled = sampled * m[:, :, None]
+        # conv-group contraction: weight [groups, Cog, Cg*K]
+        col = sampled.reshape(N, C, K, Ho * Wo)
+        colg = col.reshape(N, groups, Cg, K, Ho * Wo) \
+            .reshape(N, groups, Cg * K, Ho * Wo)
+        wf = w.reshape(groups, Co // groups, Cg * kh * kw)
+        out = jnp.einsum("gof,ngfl->ngol", wf, colg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Co, Ho, Wo).astype(xv.dtype)
+        if bv is not None:
+            out = out + bv.reshape(1, Co, 1, 1)
+        return out
+
+    return call_op(f, args, {}, op_name="deform_conv2d")
+
+
+class DeformConv2D:
+    """ref: vision/ops.py DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+        from ..nn import initializer as I
+        kh, kw = ((kernel_size, kernel_size)
+                  if isinstance(kernel_size, int) else tuple(kernel_size))
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+        import math
+        fan_in = in_channels * kh * kw
+        bound = 1.0 / math.sqrt(fan_in)
+        from ..tensor.creation import create_parameter
+        self.weight = create_parameter(
+            [out_channels, in_channels // groups, kh, kw], "float32",
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else create_parameter(
+            [out_channels], "float32", is_bias=True,
+            default_initializer=I.Uniform(-bound, bound)))
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+    def parameters(self):
+        return [p for p in (self.weight, self.bias) if p is not None]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """ref: vision/ops.py yolo_box — decode YOLOv3 head predictions.
+
+    x: [N, na*(5+nc), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, na*H*W, 4] xyxy in image coords,
+             scores [N, na*H*W, nc]).
+    """
+    import jax
+    x = _as_tensor(x)
+    img_size = _as_tensor(img_size)
+    anchors_np = np.asarray(anchors, "float32").reshape(-1, 2)
+    na = anchors_np.shape[0]
+
+    def f(xv, imgs):
+        import jax
+        N, _, H, W = xv.shape
+        nc = class_num
+        ioup = None
+        if iou_aware:
+            # iou-aware head (PP-YOLO): the leading na channels are the
+            # predicted-iou logits; conf is refined below
+            ioup = jax.nn.sigmoid(xv[:, :na])          # [N, na, H, W]
+            xv = xv[:, na:]
+        v = xv.reshape(N, na, 5 + nc, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        sxy = float(scale_x_y)
+        bias = -0.5 * (sxy - 1.0)
+        cx = (jax.nn.sigmoid(v[:, :, 0]) * sxy + bias
+              + gx[None, None, None, :]) / W
+        cy = (jax.nn.sigmoid(v[:, :, 1]) * sxy + bias
+              + gy[None, None, :, None]) / H
+        aw = jnp.asarray(anchors_np[:, 0])[None, :, None, None]
+        ah = jnp.asarray(anchors_np[:, 1])[None, :, None, None]
+        in_w = downsample_ratio * W
+        in_h = downsample_ratio * H
+        bw = jnp.exp(v[:, :, 2]) * aw / in_w
+        bh = jnp.exp(v[:, :, 3]) * ah / in_h
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        if ioup is not None:
+            f_ = float(iou_aware_factor)
+            conf = conf ** (1.0 - f_) * ioup ** f_
+        probs = jax.nn.sigmoid(v[:, :, 5:])
+        score = conf[:, :, None] * probs           # [N,na,nc,H,W]
+        imgh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imgw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imgw
+        y1 = (cy - bh / 2) * imgh
+        x2 = (cx + bw / 2) * imgw
+        y2 = (cy + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)   # [N,na,H,W,4]
+        boxes = boxes.reshape(N, na * H * W, 4)
+        # zero out low-confidence detections (reference semantics)
+        keep = (conf > conf_thresh).reshape(N, na * H * W)
+        boxes = boxes * keep[..., None]
+        scores = score.transpose(0, 1, 3, 4, 2).reshape(N, na * H * W, nc)
+        scores = scores * keep[..., None]
+        return boxes, scores
+
+    outs = call_op(f, [x, img_size], multi_out=True, op_name="yolo_box")
+    return outs[0], outs[1]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """ref: vision/ops.py prior_box — SSD anchor generation."""
+    input = _as_tensor(input)
+    image = _as_tensor(image)
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    def f(feat, img):
+        H, W = feat.shape[2], feat.shape[3]
+        IH, IW = img.shape[2], img.shape[3]
+        sh = steps[1] if steps[1] > 0 else IH / H
+        sw = steps[0] if steps[0] > 0 else IW / W
+        cy = (jnp.arange(H, dtype=jnp.float32) + offset) * sh
+        cx = (jnp.arange(W, dtype=jnp.float32) + offset) * sw
+        whs = []
+        for k, ms in enumerate(min_sizes):
+            ms = float(ms)
+            if min_max_aspect_ratios_order:
+                whs.append((ms, ms))
+                if max_sizes:
+                    big = float(np.sqrt(ms * float(max_sizes[k])))
+                    whs.append((big, big))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            else:
+                for ar in ars:
+                    whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                if max_sizes:
+                    big = float(np.sqrt(ms * float(max_sizes[k])))
+                    whs.append((big, big))
+        wh = jnp.asarray(np.asarray(whs, "float32"))    # [P, 2]
+        P = wh.shape[0]
+        cxg = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+        cyg = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+        bw = jnp.broadcast_to(wh[:, 0][None, None], (H, W, P)) / 2
+        bh = jnp.broadcast_to(wh[:, 1][None, None], (H, W, P)) / 2
+        out = jnp.stack([(cxg - bw) / IW, (cyg - bh) / IH,
+                         (cxg + bw) / IW, (cyg + bh) / IH], axis=-1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(
+            jnp.asarray(np.asarray(variance, "float32")), (H, W, P, 4))
+        return out, var
+
+    outs = call_op(f, [input, image], multi_out=True, op_name="prior_box")
+    return outs[0], outs[1]
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """ref: vision/ops.py psroi_pool — position-sensitive average ROI
+    pooling: output channel c of bin (i, j) pools ONLY from input
+    channel c*ph*pw + i*pw + j over that bin's region."""
+    import jax
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x, boxes, boxes_num = (_as_tensor(x), _as_tensor(boxes),
+                           _as_tensor(boxes_num))
+
+    def impl(xa, ba, bn):
+        N, C, H, W = xa.shape
+        Co = C // (ph * pw)
+        box_batch = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=ba.shape[0])
+
+        def one_roi(box, b):
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            x2 = box[2] * spatial_scale
+            y2 = box[3] * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1) / ph
+            rw = jnp.maximum(x2 - x1, 0.1) / pw
+            img = xa[b].reshape(Co, ph, pw, H, W)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+
+            def bin_val(i, j):
+                ys0 = y1 + i * rh
+                ys1 = y1 + (i + 1) * rh
+                xs0 = x1 + j * rw
+                xs1 = x1 + (j + 1) * rw
+                my = ((ys + 0.5 > ys0) & (ys + 0.5 <= ys1))
+                mx = ((xs + 0.5 > xs0) & (xs + 0.5 <= xs1))
+                m = (my[:, None] & mx[None, :]).astype(xa.dtype)
+                cnt = jnp.maximum(m.sum(), 1.0)
+                # channel block (i, j) for all Co outputs
+                return (img[:, i, j] * m[None]).sum(axis=(1, 2)) / cnt
+
+            rows = jnp.stack([jnp.stack([bin_val(i, j)
+                                         for j in range(pw)], axis=-1)
+                              for i in range(ph)], axis=-2)
+            return rows                       # [Co, ph, pw]
+
+        return jax.vmap(one_roi)(ba, box_batch)
+
+    return call_op(impl, [x, boxes, boxes_num], op_name="psroi_pool")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """ref: vision/ops.py matrix_nms — parallel soft-NMS by decay
+    matrix (host-side: data-dependent output, like nms)."""
+    b = bboxes.numpy() if isinstance(bboxes, Tensor) else np.asarray(bboxes)
+    s = scores.numpy() if isinstance(scores, Tensor) else np.asarray(scores)
+    N, M, _ = b.shape
+    C = s.shape[1]
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(N):
+        dets = []
+        idxs = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.nonzero(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bb = b[n, order]
+            ss = sc[order]
+            # IoU matrix (upper triangle)
+            x1 = np.maximum(bb[:, None, 0], bb[None, :, 0])
+            y1 = np.maximum(bb[:, None, 1], bb[None, :, 1])
+            x2 = np.minimum(bb[:, None, 2], bb[None, :, 2])
+            y2 = np.minimum(bb[:, None, 3], bb[None, :, 3])
+            ext = 0.0 if normalized else 1.0
+            inter = (np.clip(x2 - x1 + ext, 0, None)
+                     * np.clip(y2 - y1 + ext, 0, None))
+            area = ((bb[:, 2] - bb[:, 0] + ext)
+                    * (bb[:, 3] - bb[:, 1] + ext))
+            iou = inter / np.maximum(area[:, None] + area[None] - inter,
+                                     1e-10)
+            iou = np.triu(iou, k=1)
+            # decay[i, j]: det j decays by its overlap with higher-
+            # scored det i, compensated by det i's OWN max overlap with
+            # anything above it (iou_cmax[i] — row-indexed)
+            iou_cmax = iou.max(axis=0)
+            if use_gaussian:
+                # SOLOv2 form: exp(-sigma*iou^2)/exp(-sigma*cmax^2)
+                decay = np.exp(-gaussian_sigma
+                               * (iou ** 2 - iou_cmax[:, None] ** 2))
+            else:
+                decay = (1 - iou) / np.maximum(1 - iou_cmax[:, None],
+                                               1e-10)
+            decay = decay.min(axis=0)
+            ds = ss * decay
+            ok = ds > post_threshold
+            for i in np.nonzero(ok)[0]:
+                dets.append([c, ds[i], *bb[i]])
+                idxs.append(n * M + order[i])
+        if dets:
+            dets = np.asarray(dets, "float32")
+            order = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[order]
+            idxs = np.asarray(idxs, "int64")[order]
+        else:
+            dets = np.zeros((0, 6), "float32")
+            idxs = np.zeros((0,), "int64")
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, axis=0)))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(np.concatenate(all_idx))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(rois_num, "int32"))))
+    return tuple(ret) if len(ret) > 1 else out

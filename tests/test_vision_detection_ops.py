@@ -1,0 +1,160 @@
+"""Detection ops: deform_conv2d / yolo_box / prior_box / psroi_pool /
+matrix_nms (ref: test/legacy_test test_deformable_conv_op.py,
+test_yolo_box_op.py, test_prior_box_op.py, test_psroi_pool_op.py,
+test_matrix_nms_op.py — numpy-reference oracles)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import ops as vops
+
+
+def test_deform_conv2d_zero_offset_matches_conv2d():
+    """with zero offsets (and no mask) deform conv IS a plain conv."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 8, 8).astype("float32")
+    w = rs.randn(6, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 9, 8, 8), "float32")
+    out = vops.deform_conv2d(x, off, w, padding=1).numpy()
+    import paddle_tpu.nn.functional as F
+    ref = F.conv2d(Tensor(x), Tensor(w), padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_mask_and_grads():
+    rs = np.random.RandomState(1)
+    x = Tensor(rs.randn(1, 2, 6, 6).astype("float32"))
+    x.stop_gradient = False
+    w = Tensor(rs.randn(3, 2, 3, 3).astype("float32"))
+    w.stop_gradient = False
+    off = Tensor(0.3 * rs.randn(1, 18, 6, 6).astype("float32"))
+    off.stop_gradient = False
+    mask = Tensor(rs.rand(1, 9, 6, 6).astype("float32"))
+    out = vops.deform_conv2d(x, off, w, padding=1, mask=mask)
+    assert list(out.shape) == [1, 3, 6, 6]
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None \
+        and off.grad is not None
+    assert np.abs(np.asarray(off.grad.numpy())).sum() > 0
+
+
+def test_deform_conv2d_layer():
+    layer = vops.DeformConv2D(4, 8, 3, padding=1, deformable_groups=2)
+    x = paddle.randn([2, 4, 5, 5])
+    off = paddle.zeros([2, 2 * 2 * 9, 5, 5])
+    out = layer(x, off)
+    assert list(out.shape) == [2, 8, 5, 5]
+
+
+def test_yolo_box_decode():
+    rs = np.random.RandomState(2)
+    N, na, nc, H, W = 1, 2, 3, 4, 4
+    x = rs.randn(N, na * (5 + nc), H, W).astype("float32")
+    img = np.array([[64, 64]], "int32")
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                                  class_num=nc, conf_thresh=0.0,
+                                  downsample_ratio=16)
+    assert list(boxes.shape) == [N, na * H * W, 4]
+    assert list(scores.shape) == [N, na * H * W, nc]
+    b = np.asarray(boxes.numpy())
+    assert (b >= 0).all() and (b <= 64).all()       # clip_bbox
+    # spot-check one cell against the formula
+    v = x.reshape(N, na, 5 + nc, H, W)
+    def sig(a): return 1 / (1 + np.exp(-a))
+    cx = (sig(v[0, 0, 0, 0, 0]) + 0) / W * 64
+    bw = np.exp(v[0, 0, 2, 0, 0]) * 10 / (16 * W) * 64
+    np.testing.assert_allclose(b[0, 0, 0], max(cx - bw / 2, 0), rtol=1e-4)
+
+
+def test_prior_box_properties():
+    feat = paddle.randn([1, 8, 4, 4])
+    img = paddle.randn([1, 3, 32, 32])
+    boxes, var = vops.prior_box(feat, img, min_sizes=[8.0],
+                                max_sizes=[16.0], aspect_ratios=[2.0],
+                                flip=True, clip=True)
+    # priors per cell: 1 (ar=1,min) + 2 (ar=2, 1/2) + 1 (max) = 4
+    assert list(boxes.shape) == [4, 4, 4, 4]
+    assert list(var.shape) == [4, 4, 4, 4]
+    b = np.asarray(boxes.numpy())
+    assert (b >= 0).all() and (b <= 1).all()
+    # center of cell (0,0) is offset*step/IW = 0.5*8/32
+    np.testing.assert_allclose((b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2,
+                               0.125, atol=1e-6)
+
+
+def test_psroi_pool_position_sensitivity():
+    ph = pw = 2
+    Co, H, W = 3, 8, 8
+    # each input channel holds its own constant → each output bin must
+    # read exactly its designated channel's constant
+    x = np.zeros((1, Co * ph * pw, H, W), "float32")
+    for c in range(Co * ph * pw):
+        x[0, c] = c
+    boxes = np.array([[0.0, 0.0, 8.0, 8.0]], "float32")
+    out = vops.psroi_pool(x, boxes, np.array([1], "int32"), (ph, pw))
+    o = np.asarray(out.numpy())
+    assert o.shape == (1, Co, ph, pw)
+    for c in range(Co):
+        for i in range(ph):
+            for j in range(pw):
+                np.testing.assert_allclose(o[0, c, i, j],
+                                           c * ph * pw + i * pw + j)
+
+
+def test_matrix_nms_suppresses_duplicates():
+    # two near-identical high-score boxes + one distinct
+    bboxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                        [30, 30, 40, 40]]], "float32")
+    scores = np.zeros((1, 2, 3), "float32")
+    scores[0, 1] = [0.9, 0.85, 0.8]      # class 1 (0 is background)
+    out, idx, num = vops.matrix_nms(bboxes, scores, score_threshold=0.1,
+                                    post_threshold=0.0, return_index=True)
+    o = np.asarray(out.numpy())
+    assert np.asarray(num.numpy()).tolist() == [3]
+    # top det keeps full score; the duplicate decays
+    assert o[0, 1] == pytest.approx(0.9)
+    dup_scores = sorted(o[:, 1])
+    assert dup_scores[0] < 0.85 * 0.7     # decayed well below original
+
+
+def test_yolo_box_iou_aware():
+    """PP-YOLO iou-aware head: leading na channels refine conf."""
+    rs = np.random.RandomState(3)
+    N, na, nc, H, W = 1, 2, 3, 2, 2
+    body = rs.randn(N, na * (5 + nc), H, W).astype("float32")
+    ioup = rs.randn(N, na, H, W).astype("float32")
+    x = np.concatenate([ioup, body], axis=1)
+    img = np.array([[32, 32]], "int32")
+    b1, s1 = vops.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                           class_num=nc, conf_thresh=0.0,
+                           downsample_ratio=16, iou_aware=True,
+                           iou_aware_factor=0.5)
+    b0, s0 = vops.yolo_box(body, img, anchors=[10, 13, 16, 30],
+                           class_num=nc, conf_thresh=0.0,
+                           downsample_ratio=16)
+    assert list(s1.shape) == [N, na * H * W, nc]
+    # boxes identical; scores refined by sigmoid(ioup)^0.5 factor
+    np.testing.assert_allclose(np.asarray(b1.numpy()),
+                               np.asarray(b0.numpy()), rtol=1e-5)
+    def sig(a): return 1 / (1 + np.exp(-a))
+    v = body.reshape(N, na, 5 + nc, H, W)
+    conf0 = sig(v[0, 0, 4, 0, 0])
+    want = conf0 ** 0.5 * sig(ioup[0, 0, 0, 0]) ** 0.5 * sig(v[0, 0, 5, 0, 0])
+    np.testing.assert_allclose(np.asarray(s1.numpy())[0, 0, 0], want,
+                               rtol=1e-4)
+
+
+def test_deform_conv2d_border_zero_padding():
+    """a sampling point at y=-0.5 blends half zero-padding, not a
+    full-weight clamped row."""
+    x = np.ones((1, 1, 4, 4), "float32")
+    w = np.zeros((1, 1, 1, 1), "float32"); w[0, 0, 0, 0] = 1.0
+    # 1x1 kernel at stride 1: offset -0.5 rows everywhere
+    off = np.zeros((1, 2, 4, 4), "float32")
+    off[0, 0] = -0.5
+    out = np.asarray(vops.deform_conv2d(x, off, w).numpy())
+    np.testing.assert_allclose(out[0, 0, 0], 0.5)   # top row half-faded
+    np.testing.assert_allclose(out[0, 0, 1], 1.0)   # interior intact
