@@ -1,5 +1,5 @@
 """paddle.incubate (ref: python/paddle/incubate/)."""
-from . import asp, distributed, nn, optimizer
+from . import asp, autograd, distributed, nn, optimizer
 from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage
 
 

@@ -1,0 +1,55 @@
+"""paddle.incubate.autograd — prim-based autodiff API (ref:
+python/paddle/incubate/autograd/: primapi.py forward_grad/grad,
+enable_prim/disable_prim — the reference lowers to primitive ops and
+transposes them; jax IS that system, so forward_grad is jax.jvp and
+grad is jax.grad over the tape-level functions).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ...autograd import jvp as _jvp
+from ...core.dispatch import grad as _tape_grad
+
+__all__ = ["enable_prim", "disable_prim", "prim_enabled", "forward_grad",
+           "grad"]
+
+_prim = False
+
+
+def enable_prim():
+    """ref: primapi.enable_prim — here a semantic no-op recorded for
+    parity: every op already lowers to jax primitives with jvp/transpose
+    rules (the very design the reference's prim mode is building)."""
+    global _prim
+    _prim = True
+
+
+def disable_prim():
+    global _prim
+    _prim = False
+
+
+def prim_enabled() -> bool:
+    return _prim
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """ref: primapi.forward_grad — forward-mode JVP d(outputs)/d(inputs)
+    with tangents ``grad_inputs`` (defaults to ones).
+
+    Callable form: ``forward_grad(func, (xs,), v)`` also works (the
+    functional jvp), mirroring how the reference accepts both static
+    vars and callables across versions.
+    """
+    if callable(outputs):
+        return _jvp(outputs, inputs, grad_inputs)
+    raise NotImplementedError(
+        "var-based forward_grad requires the static prim graph; pass a "
+        "callable: forward_grad(fn, (xs,), tangents)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """ref: primapi.grad — reverse-mode, same contract as paddle.grad."""
+    return _tape_grad(outputs, inputs, grad_outputs,
+                      allow_unused=True)

@@ -859,6 +859,129 @@ _PARITY: List[P] = [
 ]
 
 
+def _np_softmax(x, axis=-1):
+    m = np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _np_sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def _spd():
+    """symmetric positive-definite 4x4 cases (linalg solvers)."""
+    def gen():
+        rs = np.random.RandomState(0)
+        a = rs.randn(4, 4).astype("float32")
+        return [(a @ a.T + 3 * np.eye(4, dtype="float32"),)]
+    return gen
+
+
+def _spd_b():
+    def gen():
+        rs = np.random.RandomState(0)
+        a = rs.randn(4, 4).astype("float32")
+        return [(a @ a.T + 3 * np.eye(4, dtype="float32"),
+                 rs.randn(4, 2).astype("float32"))]
+    return gen
+
+
+def _gather_case():
+    def gen():
+        rs = np.random.RandomState(0)
+        return [(rs.randn(5, 4).astype("float32"),
+                 np.array([0, 2, 4], "int64"))]
+    return gen
+
+
+def _take_along_case():
+    def gen():
+        rs = np.random.RandomState(0)
+        return [(rs.randn(3, 5).astype("float32"),
+                 rs.randint(0, 5, (3, 2)).astype("int64"))]
+    return gen
+
+
+_PARITY += [
+    # ---- activations (nn.functional) ----
+    P("relu", _f((3, 4)), lambda x: np.maximum(x, 0), grad=True),
+    P("relu6", _f((3, 4)), lambda x: np.clip(x, 0, 6)),
+    P("leaky_relu", _f((3, 4)),
+      lambda x: np.where(x > 0, x, 0.01 * x), grad=True),
+    P("elu", _f((3, 4)),
+      lambda x: np.where(x > 0, x, np.expm1(x)), grad=True),
+    P("selu", _f((3, 4)),
+      lambda x: 1.0507009873554805 * np.where(
+          x > 0, x, 1.6732632423543772 * np.expm1(x)), tol=1e-4),
+    P("celu", _f((3, 4)),
+      lambda x: np.maximum(x, 0) + np.minimum(np.expm1(x), 0), tol=1e-4),
+    P("gelu", _f((3, 4)),
+      lambda x: 0.5 * x * (1 + np.vectorize(_math.erf)
+                           (x / np.sqrt(2.0))), grad=True, tol=1e-4),
+    P("silu", _f((3, 4)), lambda x: x * _np_sigmoid(x), grad=True),
+    P("swish", _f((3, 4)), lambda x: x * _np_sigmoid(x), grad=True),
+    P("mish", _f((3, 4)),
+      lambda x: x * np.tanh(np.log1p(np.exp(x))), grad=True, tol=1e-4),
+    P("hardtanh", _f((3, 4)), lambda x: np.clip(x, -1, 1)),
+    P("hardsigmoid", _f((3, 4)),
+      lambda x: np.clip(x / 6.0 + 0.5, 0.0, 1.0)),
+    P("hardswish", _f((3, 4)),
+      lambda x: x * np.clip(x + 3, 0, 6) / 6, tol=1e-5),
+    P("tanhshrink", _f((3, 4)), lambda x: x - np.tanh(x), grad=True),
+    P("softshrink", _f((3, 4)),
+      lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0)),
+    P("log_sigmoid", _f((3, 4)),
+      lambda x: -np.log1p(np.exp(-x)), grad=True, tol=1e-4),
+    P("thresholded_relu", _f((3, 4)),
+      lambda x: np.where(x > 1.0, x, 0.0)),
+    P("softmax", _f((3, 4)), _np_softmax, grad=True),
+    P("log_softmax", _f((3, 4)),
+      lambda x: np.log(_np_softmax(x)), grad=True, tol=1e-4),
+    # ---- losses (nn.functional) ----
+    P("mse_loss", _f((3, 4), (3, 4)),
+      lambda x, y: np.mean((x - y) ** 2), grad=True),
+    P("l1_loss", _f((3, 4), (3, 4)),
+      lambda x, y: np.mean(np.abs(x - y))),
+    # ---- linalg ----
+    P("linalg.norm", _f((3, 4)), lambda x: np.linalg.norm(x), tol=1e-4),
+    P("linalg.det", _spd(), np.linalg.det, tol=1e-3),
+    P("linalg.inv", _spd(), np.linalg.inv, tol=1e-4),
+    P("linalg.pinv", _f((4, 3)), np.linalg.pinv, tol=1e-3),
+    P("linalg.solve", _spd_b(), np.linalg.solve, tol=1e-4),
+    P("linalg.cholesky", _spd(), np.linalg.cholesky, tol=1e-4),
+    P("linalg.matrix_power", _spd(),
+      lambda x: np.linalg.matrix_power(x, 3),
+      kwargs={"n": 3}, np_kwargs={}, tol=1e-2),
+    P("linalg.matrix_rank", _spd(),
+      lambda x: np.linalg.matrix_rank(x)),
+    P("linalg.cond", _spd(), np.linalg.cond, tol=1e-3),
+    P("linalg.multi_dot", _f((3, 4), (4, 5)),
+      lambda *a: np.linalg.multi_dot(a), list_input=True, tol=1e-4),
+    P("linalg.matrix_exp", lambda: [(np.array(
+        [[0.0, 1.0], [-1.0, 0.0]], "float32"),)],
+      lambda x: np.array([[np.cos(1), np.sin(1)],
+                          [-np.sin(1), np.cos(1)]], "float32"), tol=1e-5),
+    # ---- fft ----
+    P("fft.fft", _f((4, 8)), np.fft.fft, tol=1e-4),
+    P("fft.ifft", _f((4, 8)), np.fft.ifft, tol=1e-4),
+    P("fft.rfft", _f((4, 8)), np.fft.rfft, tol=1e-4),
+    P("fft.irfft", lambda: _complex_cases(1), np.fft.irfft, tol=1e-4),
+    P("fft.fft2", _f((4, 8)), np.fft.fft2, tol=1e-3),
+    P("fft.fftshift", _f((4, 8)), np.fft.fftshift),
+    P("fft.ifftshift", _f((4, 8)), np.fft.ifftshift),
+    # ---- indexing ----
+    P("index_select", _gather_case(),
+      lambda x, i, axis=0: np.take(x, i, axis=axis),
+      kwargs={"axis": 0}),
+    P("take_along_axis", _take_along_case(),
+      lambda x, i, axis=1: np.take_along_axis(x, i, axis=axis),
+      kwargs={"axis": 1}),
+    P("gather", _gather_case(),
+      lambda x, i: np.take(x, i, axis=0)),
+]
+
+
 def _surface_modules():
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F

@@ -374,13 +374,19 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return call_op(f, args, {}, op_name="deform_conv2d")
 
 
-class DeformConv2D:
-    """ref: vision/ops.py DeformConv2D layer."""
+def _deform_layer_base():
+    from ..nn import Layer
+    return Layer
+
+
+class DeformConv2D(_deform_layer_base()):
+    """ref: vision/ops.py DeformConv2D layer — an nn.Layer, so parent
+    models collect its weight/bias into parameters()/state_dict()."""
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, deformable_groups=1, groups=1,
                  weight_attr=None, bias_attr=None):
-        from .. import nn
+        super().__init__()
         from ..nn import initializer as I
         kh, kw = ((kernel_size, kernel_size)
                   if isinstance(kernel_size, int) else tuple(kernel_size))
@@ -389,21 +395,18 @@ class DeformConv2D:
         import math
         fan_in = in_channels * kh * kw
         bound = 1.0 / math.sqrt(fan_in)
-        from ..tensor.creation import create_parameter
-        self.weight = create_parameter(
-            [out_channels, in_channels // groups, kh, kw], "float32",
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr,
             default_initializer=I.Uniform(-bound, bound))
-        self.bias = (None if bias_attr is False else create_parameter(
-            [out_channels], "float32", is_bias=True,
-            default_initializer=I.Uniform(-bound, bound)))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
 
-    def __call__(self, x, offset, mask=None):
+    def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              self.stride, self.padding, self.dilation,
                              self.deformable_groups, self.groups, mask)
-
-    def parameters(self):
-        return [p for p in (self.weight, self.bias) if p is not None]
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
@@ -553,10 +556,13 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                                total_repeat_length=ba.shape[0])
 
         def one_roi(box, b):
-            x1 = box[0] * spatial_scale
-            y1 = box[1] * spatial_scale
-            x2 = box[2] * spatial_scale
-            y2 = box[3] * spatial_scale
+            # reference (R-FCN kernel) semantics: ROUND the roi coords,
+            # end at +1, and pool integer pixels [floor(start),
+            # ceil(end)) per bin — adjacent bins may share border pixels
+            y1 = jnp.round(box[1]) * spatial_scale
+            x1 = jnp.round(box[0]) * spatial_scale
+            y2 = jnp.round(box[3] + 1.0) * spatial_scale
+            x2 = jnp.round(box[2] + 1.0) * spatial_scale
             rh = jnp.maximum(y2 - y1, 0.1) / ph
             rw = jnp.maximum(x2 - x1, 0.1) / pw
             img = xa[b].reshape(Co, ph, pw, H, W)
@@ -564,16 +570,18 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             xs = jnp.arange(W, dtype=jnp.float32)
 
             def bin_val(i, j):
-                ys0 = y1 + i * rh
-                ys1 = y1 + (i + 1) * rh
-                xs0 = x1 + j * rw
-                xs1 = x1 + (j + 1) * rw
-                my = ((ys + 0.5 > ys0) & (ys + 0.5 <= ys1))
-                mx = ((xs + 0.5 > xs0) & (xs + 0.5 <= xs1))
+                hs = jnp.clip(jnp.floor(y1 + i * rh), 0, H)
+                he = jnp.clip(jnp.ceil(y1 + (i + 1) * rh), 0, H)
+                ws = jnp.clip(jnp.floor(x1 + j * rw), 0, W)
+                we = jnp.clip(jnp.ceil(x1 + (j + 1) * rw), 0, W)
+                my = (ys >= hs) & (ys < he)
+                mx = (xs >= ws) & (xs < we)
                 m = (my[:, None] & mx[None, :]).astype(xa.dtype)
                 cnt = jnp.maximum(m.sum(), 1.0)
+                is_empty = (he <= hs) | (we <= ws)
                 # channel block (i, j) for all Co outputs
-                return (img[:, i, j] * m[None]).sum(axis=(1, 2)) / cnt
+                val = (img[:, i, j] * m[None]).sum(axis=(1, 2)) / cnt
+                return jnp.where(is_empty, 0.0, val)
 
             rows = jnp.stack([jnp.stack([bin_val(i, j)
                                          for j in range(pw)], axis=-1)

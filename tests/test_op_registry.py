@@ -56,8 +56,8 @@ def test_registry_is_the_index():
                   if r.paddle_fn is None and r.source == "absorbed"]
     assert not unresolved, unresolved
     # the parity subset is materially large, not a token sample
-    assert len(_PARITY_ROWS) >= 140, len(_PARITY_ROWS)
-    assert len(_GRAD_ROWS) >= 50, len(_GRAD_ROWS)
+    assert len(_PARITY_ROWS) >= 200, len(_PARITY_ROWS)
+    assert len(_GRAD_ROWS) >= 70, len(_GRAD_ROWS)
 
 
 @pytest.mark.parametrize("name", _PARITY_ROWS)

@@ -158,3 +158,30 @@ def test_deform_conv2d_border_zero_padding():
     out = np.asarray(vops.deform_conv2d(x, off, w).numpy())
     np.testing.assert_allclose(out[0, 0, 0], 0.5)   # top row half-faded
     np.testing.assert_allclose(out[0, 0, 1], 1.0)   # interior intact
+
+
+def test_deform_conv2d_registers_in_parent_layer():
+    """DeformConv2D is an nn.Layer: parents collect its params."""
+    from paddle_tpu import nn
+
+    class Det(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.dcn = vops.DeformConv2D(2, 4, 3, padding=1)
+
+        def forward(self, x, off):
+            return self.dcn(x, off)
+
+    m = Det()
+    names = dict(m.named_parameters())
+    assert any("dcn" in n for n in names), names
+    assert len(m.parameters()) == 2          # weight + bias
+    sd = m.state_dict()
+    assert len(sd) == 2
+    # attrs honored
+    from paddle_tpu.framework.param_attr import ParamAttr
+    from paddle_tpu.nn import initializer as I
+    d2 = vops.DeformConv2D(2, 4, 3, weight_attr=ParamAttr(
+        initializer=I.Constant(0.5)), bias_attr=False)
+    assert d2.bias is None
+    assert np.allclose(np.asarray(d2.weight.numpy()), 0.5)
