@@ -1,10 +1,14 @@
 """paddle.io (ref: python/paddle/io/ — dataset.py, dataloader/).
 
-TPU-native note: the reference's multiprocess worker pool + shared-memory
-queue exists to keep GPUs fed; on TPU the input bottleneck is host-side
-preprocessing, so the DataLoader here uses a thread prefetcher (workers
-overlap with device compute because jax dispatch releases the GIL during
-device execution).  ``num_workers`` maps to prefetch threads.
+TPU-native note: like the reference, ``num_workers > 0`` runs a
+MULTIPROCESS worker pool for map-style datasets (python-side transforms
+are GIL-bound; processes scale them).  Workers collate to numpy and the
+parent rehydrates to device Tensors — worker code must stay numpy-only
+(the same contract as the reference's CUDA-parent fork).  Iterable
+datasets, the no-sampler mode, and ``use_shared_memory=False`` use a
+thread prefetcher instead (overlaps with device compute; jax dispatch
+releases the GIL).  ``PADDLE_WORKER_START_METHOD=spawn`` trades worker
+startup time for full process isolation.
 """
 from __future__ import annotations
 
@@ -273,23 +277,35 @@ class DistributedBatchSampler(BatchSampler):
 # collate + DataLoader
 # ---------------------------------------------------------------------------
 
-def default_collate_fn(batch):
+def _collate_tree(batch, stack):
+    """One traversal for every collate mode — ``stack`` is the leaf
+    combiner (device Tensors for the main process, numpy for workers);
+    a single structure walk means the two modes can never drift."""
     sample = batch[0]
-    if isinstance(sample, Tensor):
-        import jax.numpy as jnp
-        return Tensor(jnp.stack([b._data for b in batch]))
-    if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
-    if isinstance(sample, (int, float, np.integer, np.floating)):
-        return Tensor(np.asarray(batch))
+    if isinstance(sample, (Tensor, np.ndarray, int, float, np.integer,
+                           np.floating)):
+        return stack(batch)
     if isinstance(sample, (str, bytes)):
         return list(batch)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+        return {k: _collate_tree([b[k] for b in batch], stack)
+                for k in sample}
     if isinstance(sample, (tuple, list)):
-        return type(sample)(default_collate_fn(list(items))
+        return type(sample)(_collate_tree(list(items), stack)
                             for items in zip(*batch))
     return list(batch)
+
+
+def default_collate_fn(batch):
+    def stack(b):
+        s = b[0]
+        if isinstance(s, Tensor):
+            import jax.numpy as jnp
+            return Tensor(jnp.stack([t._data for t in b]))
+        if isinstance(s, np.ndarray):
+            return Tensor(np.stack(b))
+        return Tensor(np.asarray(b))
+    return _collate_tree(batch, stack)
 
 
 def default_convert_fn(batch):
@@ -315,6 +331,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -356,9 +376,124 @@ class DataLoader:
         for idxs in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in idxs])
 
+    def _ensure_pool(self):
+        import multiprocessing as mp
+        import os
+        if getattr(self, "_pool", None) is not None:
+            ws, _, _ = self._pool
+            if all(w.is_alive() for w in ws):
+                return self._pool
+            self._teardown_pool()
+        ctx = mp.get_context(os.environ.get(
+            "PADDLE_WORKER_START_METHOD", "fork"))
+        nw = self.num_workers
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        workers = [ctx.Process(
+            target=_mp_worker_loop,
+            args=(self.dataset, self.collate_fn, task_q, result_q, w, nw,
+                  self.worker_init_fn, base_seed), daemon=True)
+            for w in range(nw)]
+        for w in workers:
+            w.start()
+        self._pool = (workers, task_q, result_q)
+        self._mp_epoch = 0
+        return self._pool
+
+    def _teardown_pool(self):
+        pool = getattr(self, "_pool", None)
+        if pool is None:
+            return
+        workers, task_q, _ = pool
+        for _ in workers:
+            try:
+                task_q.put(None)
+            except Exception:
+                pass
+        for w in workers:
+            w.join(timeout=2.0)
+            if w.is_alive():
+                w.terminate()
+        self._pool = None
+
+    def __del__(self):
+        try:
+            self._teardown_pool()
+        except Exception:
+            pass
+
+    def _mp_iter(self):
+        """Multiprocess map-style iteration (ref: dataloader_iter.py
+        _DataLoaderIterMultiProcess): tasks (epoch, batch_idx, indices)
+        fan out to worker processes; results reorder in the parent and
+        rehydrate numpy → Tensor here (workers never touch the device).
+
+        Fork start method by default (workers must stay numpy-only —
+        the same contract as torch's CUDA-parent fork);
+        PADDLE_WORKER_START_METHOD=spawn buys full isolation at the
+        cost of re-importing the framework per worker.  The pool
+        persists across epochs when ``persistent_workers=True``;
+        dead workers are detected instead of blocking forever."""
+        import queue as _q
+        workers, task_q, result_q = self._ensure_pool()
+        self._mp_epoch += 1
+        epoch = self._mp_epoch
+        batches = list(self.batch_sampler)
+        timeout = self.timeout if self.timeout and self.timeout > 0 \
+            else None
+        try:
+            limit = min(len(batches), self.prefetch_factor
+                        * self.num_workers)
+            for send in range(limit):
+                task_q.put((epoch, send, batches[send]))
+            send = limit
+            buf = {}
+            for want in range(len(batches)):
+                while want not in buf:
+                    try:
+                        ep, bidx, out, err = result_q.get(timeout=1.0)
+                    except _q.Empty:
+                        dead = [w for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"{len(dead)} DataLoader worker(s) died "
+                                f"(exitcodes "
+                                f"{[w.exitcode for w in dead]}) — see "
+                                f"worker stderr for the traceback")
+                        if timeout is not None:
+                            timeout -= 1.0
+                            if timeout <= 0:
+                                raise RuntimeError(
+                                    f"DataLoader worker timed out after "
+                                    f"{self.timeout}s")
+                        continue
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{err}")
+                    if ep != epoch:
+                        continue    # stale batch from an aborted epoch
+                    buf[bidx] = out
+                if send < len(batches):
+                    task_q.put((epoch, send, batches[send]))
+                    send += 1
+                yield _to_tensor_tree(buf.pop(want))
+        finally:
+            if not self.persistent_workers:
+                self._teardown_pool()
+
     def __iter__(self):
         if self.num_workers <= 0:
             yield from self._gen()
+            return
+        if self.use_shared_memory and self.batch_sampler is not None:
+            # true multiprocess workers (ref: dataloader_iter.py
+            # _DataLoaderIterMultiProcess + worker.py): python-side
+            # transforms/augmentation are GIL-bound, so threads cannot
+            # scale them — processes can.  Iterable datasets and the
+            # no-sampler per-sample mode keep the thread prefetcher
+            # (use_shared_memory=False forces it too)
+            yield from self._mp_iter()
             return
         # thread prefetcher: decode/collate overlaps device compute
         q: _queue.Queue = _queue.Queue(
@@ -401,5 +536,92 @@ class DataLoader:
             t.join()
 
 
+class WorkerInfo:
+    """ref: io/dataloader/worker.py WorkerInfo — visible to dataset code
+    running inside a worker via get_worker_info()."""
+
+    def __init__(self, id: int, num_workers: int, seed: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
 def get_worker_info():
-    return None
+    """ref: paddle.io.get_worker_info — WorkerInfo inside a dataloader
+    worker process, None in the main process."""
+    return _worker_info
+
+
+def _np_collate(batch):
+    """Collate to NUMPY trees — workers must not touch the device (the
+    parent rehydrates to Tensors); same traversal as default_collate_fn."""
+    def stack(b):
+        s = b[0]
+        if isinstance(s, Tensor):
+            return np.stack([np.asarray(t.numpy()) for t in b])
+        if isinstance(s, np.ndarray):
+            return np.stack(b)
+        return np.asarray(b)
+    return _collate_tree(batch, stack)
+
+
+def _to_np_tree(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    if isinstance(x, dict):
+        return {k: _to_np_tree(v) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return type(x)(_to_np_tree(v) for v in x)
+    return x
+
+
+def _to_tensor_tree(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, dict):
+        return {k: _to_tensor_tree(v) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return type(x)(_to_tensor_tree(v) for v in x)
+    return x
+
+
+def _mp_worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
+                    num_workers, worker_init_fn, base_seed):
+    """Worker process body (ref: worker.py _worker_loop).  Tasks and
+    results carry an epoch tag so a persistent pool never delivers a
+    stale batch from an abandoned iteration into the next epoch."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers,
+                              base_seed + worker_id, dataset)
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+    except Exception:
+        # report init failures through the queue — dying silently would
+        # leave the parent blocked on results that never come
+        import traceback
+        result_q.put((-1, -1, None, traceback.format_exc()))
+        return
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            epoch, bidx, idxs = task
+            try:
+                samples = [dataset[i] for i in idxs]
+                if collate_fn is default_collate_fn:
+                    out = _np_collate(samples)
+                else:
+                    out = _to_np_tree(collate_fn(samples))
+                result_q.put((epoch, bidx, out, None))
+            except Exception:
+                import traceback
+                result_q.put((epoch, bidx, None, traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass

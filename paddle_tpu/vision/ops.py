@@ -374,12 +374,10 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return call_op(f, args, {}, op_name="deform_conv2d")
 
 
-def _deform_layer_base():
-    from ..nn import Layer
-    return Layer
+from ..nn import Layer as _Layer  # noqa: E402  (no cycle: nn ⇏ vision)
 
 
-class DeformConv2D(_deform_layer_base()):
+class DeformConv2D(_Layer):
     """ref: vision/ops.py DeformConv2D layer — an nn.Layer, so parent
     models collect its weight/bias into parameters()/state_dict()."""
 

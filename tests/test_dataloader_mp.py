@@ -1,0 +1,126 @@
+"""Multiprocess DataLoader workers (ref: io/dataloader/dataloader_iter.py
+_DataLoaderIterMultiProcess + worker.py; test/legacy_test
+test_dataloader_*). Workers collate numpy; the parent rehydrates."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+
+class _Square(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.full((3,), i * i, "float32"), np.int64(i)
+
+
+class _Boom(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("sample 5 is poisoned")
+        return np.zeros((2,), "float32")
+
+
+def _seen_worker(i):
+    # runs inside the worker process
+    info = get_worker_info()
+    assert info is not None and info.id == i
+    assert info.num_workers == 2
+
+
+def test_mp_loader_order_and_values():
+    loader = DataLoader(_Square(), batch_size=4, shuffle=False,
+                        num_workers=2)
+    xs, ys = [], []
+    for x, y in loader:
+        xs.append(np.asarray(x.numpy()))
+        ys.append(np.asarray(y.numpy()))
+    assert len(xs) == 4
+    got = np.concatenate(ys)
+    np.testing.assert_array_equal(got, np.arange(16))   # order preserved
+    np.testing.assert_allclose(np.concatenate(xs)[:, 0],
+                               np.arange(16) ** 2)
+
+
+def test_mp_loader_two_epochs_and_shuffle():
+    loader = DataLoader(_Square(), batch_size=4, shuffle=True,
+                        num_workers=2)
+    e1 = [np.asarray(y.numpy()) for _, y in loader]
+    e2 = [np.asarray(y.numpy()) for _, y in loader]
+    assert sorted(np.concatenate(e1)) == list(range(16))
+    assert sorted(np.concatenate(e2)) == list(range(16))
+
+
+def test_mp_loader_worker_init_fn_and_info():
+    loader = DataLoader(_Square(), batch_size=8, num_workers=2,
+                        worker_init_fn=_seen_worker)
+    n = sum(1 for _ in loader)
+    assert n == 2
+
+
+def test_mp_loader_propagates_dataset_error():
+    loader = DataLoader(_Boom(), batch_size=4, num_workers=2)
+    with pytest.raises(RuntimeError, match="sample 5 is poisoned"):
+        list(loader)
+
+
+def test_mp_loader_custom_collate():
+    def collate(samples):
+        xs = np.stack([s[0] for s in samples])
+        return {"sum": xs.sum(axis=0), "n": len(samples)}
+
+    loader = DataLoader(_Square(), batch_size=4, num_workers=2,
+                        collate_fn=collate)
+    out = next(iter(loader))
+    assert set(out) == {"sum", "n"}
+    np.testing.assert_allclose(np.asarray(out["sum"].numpy()),
+                               np.array([14.0] * 3))   # 0+1+4+9
+    assert out["n"] == 4
+
+
+def test_thread_fallback_still_works():
+    loader = DataLoader(_Square(), batch_size=4, num_workers=2,
+                        use_shared_memory=False)
+    ys = np.concatenate([np.asarray(y.numpy()) for _, y in loader])
+    np.testing.assert_array_equal(ys, np.arange(16))
+
+
+def _bad_init(i):
+    raise RuntimeError("init exploded")
+
+
+def test_mp_loader_worker_init_failure_raises_not_hangs():
+    loader = DataLoader(_Square(), batch_size=4, num_workers=2,
+                        worker_init_fn=_bad_init)
+    with pytest.raises(RuntimeError, match="init exploded"):
+        list(loader)
+
+
+def test_mp_loader_persistent_workers_reuse_pool():
+    loader = DataLoader(_Square(), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+    list(loader)
+    pool1 = loader._pool
+    assert pool1 is not None                    # survived the epoch
+    pids1 = [w.pid for w in pool1[0]]
+    ys = np.concatenate([np.asarray(y.numpy()) for _, y in loader])
+    np.testing.assert_array_equal(np.sort(ys), np.arange(16))
+    assert [w.pid for w in loader._pool[0]] == pids1   # same processes
+    loader._teardown_pool()
+
+
+def test_collate_modes_share_structure():
+    """the numpy and Tensor collates traverse identically."""
+    from paddle_tpu.io import _np_collate, default_collate_fn
+    batch = [{"a": np.ones((2,), "float32"), "b": (1.0, "x")},
+             {"a": np.zeros((2,), "float32"), "b": (2.0, "y")}]
+    t = default_collate_fn(batch)
+    n = _np_collate(batch)
+    assert set(t) == set(n) == {"a", "b"}
+    np.testing.assert_array_equal(np.asarray(t["a"].numpy()), n["a"])
+    assert n["b"][1] == ["x", "y"]
