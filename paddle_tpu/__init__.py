@@ -77,6 +77,28 @@ in_dygraph_mode = in_dynamic_mode
 in_dynamic_or_pir_mode = in_dynamic_mode
 
 
+def iinfo(dtype):
+    """ref: paddle.iinfo — integer dtype limits."""
+    import numpy as _np
+    from . import dtype as _dt
+    d = dtype.numpy_dtype if isinstance(dtype, _dt.DType) else dtype
+    return _np.iinfo(_np.dtype(str(d).replace("paddle.", "")))
+
+
+def finfo(dtype):
+    """ref: paddle.finfo — float dtype limits (bf16-aware via ml_dtypes)."""
+    import numpy as _np
+    from . import dtype as _dt
+    if not isinstance(dtype, _dt.DType):
+        # normalize strings/raw dtypes through the DType table so the
+        # bfloat16 branch below applies to every spelling
+        dtype = _dt.DType(str(dtype).replace("paddle.", ""))
+    if dtype.name == "bfloat16":
+        import ml_dtypes
+        return ml_dtypes.finfo(ml_dtypes.bfloat16)
+    return _np.finfo(dtype.numpy_dtype)
+
+
 def get_cudnn_version():
     return None
 
@@ -86,7 +108,8 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
                 "linalg", "fft", "signal", "framework", "jit", "static",
                 "distributed", "distribution", "vision", "hapi", "incubate",
                 "utils", "profiler", "sparse", "text", "audio",
-                "quantization", "onnx", "version", "inference"]
+                "quantization", "onnx", "version", "inference",
+                "hub", "sysconfig"]
 
 
 def __getattr__(name):

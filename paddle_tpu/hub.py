@@ -1,0 +1,63 @@
+"""paddle.hub (ref: python/paddle/hazy hub.py — list/help/load over a
+hubconf.py).  Local/offline source only: this environment has no
+network egress, matching air-gapped cluster usage; a github source
+raises with a clear message instead of hanging on a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise ValueError(
+            f"hub source {source!r} is unavailable in this offline "
+            f"build; clone the repo and use source='local'")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """ref: paddle.hub.list — entrypoint names of a local hub repo."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> Optional[str]:
+    """ref: paddle.hub.help — the entrypoint's docstring."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir!r}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """ref: paddle.hub.load — call the entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir!r}")
+    return fn(**kwargs)

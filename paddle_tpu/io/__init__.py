@@ -7,8 +7,10 @@ parent rehydrates to device Tensors — worker code must stay numpy-only
 (the same contract as the reference's CUDA-parent fork).  Iterable
 datasets, the no-sampler mode, and ``use_shared_memory=False`` use a
 thread prefetcher instead (overlaps with device compute; jax dispatch
-releases the GIL).  ``PADDLE_WORKER_START_METHOD=spawn`` trades worker
-startup time for full process isolation.
+releases the GIL).  ``PADDLE_WORKER_START_METHOD=forkserver|spawn``
+trades worker startup time for isolation from the parent's jax runtime
+threads (fork, the torch-parity default, requires workers to stay off
+the device — see the fork/threads caveat in CPython).
 """
 from __future__ import annotations
 
@@ -450,6 +452,7 @@ class DataLoader:
             send = limit
             buf = {}
             for want in range(len(batches)):
+                remaining = timeout   # PER-BATCH wait (ref semantics)
                 while want not in buf:
                     try:
                         ep, bidx, out, err = result_q.get(timeout=1.0)
@@ -460,19 +463,23 @@ class DataLoader:
                                 f"{len(dead)} DataLoader worker(s) died "
                                 f"(exitcodes "
                                 f"{[w.exitcode for w in dead]}) — see "
-                                f"worker stderr for the traceback")
-                        if timeout is not None:
-                            timeout -= 1.0
-                            if timeout <= 0:
+                                f"worker stderr for the traceback; if it "
+                                f"mentions fork/threads, set "
+                                f"PADDLE_WORKER_START_METHOD=forkserver")
+                        if remaining is not None:
+                            remaining -= 1.0
+                            if remaining <= 0:
                                 raise RuntimeError(
-                                    f"DataLoader worker timed out after "
+                                    f"DataLoader batch timed out after "
                                     f"{self.timeout}s")
                         continue
-                    if err is not None:
+                    if err is not None and (ep is None or ep == epoch):
+                        # current-epoch failure, or a worker-init error
+                        # (epoch-independent)
                         raise RuntimeError(
                             f"DataLoader worker failed:\n{err}")
                     if ep != epoch:
-                        continue    # stale batch from an aborted epoch
+                        continue   # stale result from an aborted epoch
                     buf[bidx] = out
                 if send < len(batches):
                     task_q.put((epoch, send, batches[send]))
@@ -605,7 +612,8 @@ def _mp_worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
         # report init failures through the queue — dying silently would
         # leave the parent blocked on results that never come
         import traceback
-        result_q.put((-1, -1, None, traceback.format_exc()))
+        # epoch None = epoch-independent (init runs once per pool)
+        result_q.put((None, -1, None, traceback.format_exc()))
         return
     try:
         while True:

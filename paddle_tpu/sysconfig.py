@@ -1,0 +1,29 @@
+"""paddle.sysconfig (ref: python/paddle/sysconfig.py — get_include /
+get_lib for building extensions against the install)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory of C headers for extensions (the native layer's csrc —
+    extensions build against the same toolchain contract)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native", "csrc")
+
+
+def get_lib() -> str:
+    """Directory holding the built native library (builds it on first
+    call; raises with the underlying toolchain error on failure — a
+    silently wrong path would only resurface as an opaque linker
+    error)."""
+    from .native import build
+    try:
+        return os.path.dirname(build())
+    except Exception as e:
+        raise RuntimeError(
+            f"paddle.sysconfig.get_lib: native library build failed "
+            f"({e}); install a C++ toolchain or use the pure-Python "
+            f"fallbacks") from e
