@@ -77,26 +77,35 @@ in_dygraph_mode = in_dynamic_mode
 in_dynamic_or_pir_mode = in_dynamic_mode
 
 
+def _as_dtype_obj(dtype):
+    """Normalize DType / 'float32' / 'paddle.float32' / np.int32 /
+    np.dtype spellings to the DType table."""
+    import numpy as _np
+    from . import dtype as _dt
+    if isinstance(dtype, _dt.DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name == "bfloat16":
+            return _dt.bfloat16
+        return _dt.DType(_np.dtype(name).name)
+    return _dt.DType(_np.dtype(dtype).name)   # numpy class / np.dtype
+
+
 def iinfo(dtype):
     """ref: paddle.iinfo — integer dtype limits."""
     import numpy as _np
-    from . import dtype as _dt
-    d = dtype.numpy_dtype if isinstance(dtype, _dt.DType) else dtype
-    return _np.iinfo(_np.dtype(str(d).replace("paddle.", "")))
+    return _np.iinfo(_as_dtype_obj(dtype).numpy_dtype)
 
 
 def finfo(dtype):
     """ref: paddle.finfo — float dtype limits (bf16-aware via ml_dtypes)."""
     import numpy as _np
-    from . import dtype as _dt
-    if not isinstance(dtype, _dt.DType):
-        # normalize strings/raw dtypes through the DType table so the
-        # bfloat16 branch below applies to every spelling
-        dtype = _dt.DType(str(dtype).replace("paddle.", ""))
-    if dtype.name == "bfloat16":
+    d = _as_dtype_obj(dtype)
+    if d.name == "bfloat16":
         import ml_dtypes
         return ml_dtypes.finfo(ml_dtypes.bfloat16)
-    return _np.finfo(dtype.numpy_dtype)
+    return _np.finfo(d.numpy_dtype)
 
 
 def get_cudnn_version():

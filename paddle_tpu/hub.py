@@ -19,10 +19,22 @@ def _load_hubconf(repo_dir: str):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
-    spec = importlib.util.spec_from_file_location("paddle_hubconf", path)
+    # unique module name per repo: concurrent repos must not overwrite
+    # each other in sys.modules (pickle resolves hub classes by module),
+    # and a failed exec must not leave a half-built entry behind
+    import hashlib
+    name = "paddle_hubconf_" + hashlib.sha1(
+        os.path.abspath(repo_dir).encode()).hexdigest()[:10]
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules["paddle_hubconf"] = mod
-    spec.loader.exec_module(mod)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)
+        raise
     return mod
 
 

@@ -12,3 +12,4 @@ from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
 from .ernie_moe import (ErnieMoEConfig, ErnieMoEModel,
                         ErnieMoEForPretraining, ernie_moe_config,
                         ERNIE_MOE_PRESETS)
+from .convert import bert_from_hf, llama_from_hf

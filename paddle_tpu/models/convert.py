@@ -1,0 +1,187 @@
+"""HuggingFace checkpoint interop (ref: the reference ecosystem's
+PaddleNLP ``convert_*_weights`` utilities — users switching frameworks
+bring their checkpoints with them).
+
+``llama_from_hf`` maps a transformers Llama state dict onto
+:class:`~paddle_tpu.models.llama.LlamaForCausalLM`:
+
+* torch ``nn.Linear`` weights are ``[out, in]`` — transposed into this
+  framework's ``[in, out]`` layout;
+* HF rotary embeddings use the half-split ("neox") convention while
+  this runtime rotates interleaved pairs (GPT-J style, what the fused
+  rope kernel computes) — q/k projection rows are permuted per head
+  (``new[2i] = old[i]; new[2i+1] = old[i + hd/2]``), the standard
+  HF↔Meta permutation, which makes attention scores bit-identical;
+* norms/embeddings copy through.
+
+Verified by logits parity against the torch implementation
+(tests/test_hf_convert.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["llama_from_hf", "bert_from_hf"]
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu()
+        if str(t.dtype) == "torch.bfloat16":
+            # torch cannot .numpy() bf16; widen first (the target dtype
+            # is applied at the jnp cast anyway)
+            t = t.float()
+        t = t.numpy()
+    return np.asarray(t)
+
+
+def _interleave_rope_rows(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Permute projection OUT rows from half-split to interleaved rope
+    convention, per head.  w: [n_heads*hd, in]."""
+    out, _ = w.shape
+    hd = out // n_heads
+    idx = np.empty(hd, dtype=np.int64)
+    idx[0::2] = np.arange(hd // 2)
+    idx[1::2] = np.arange(hd // 2) + hd // 2
+    per_head = w.reshape(n_heads, hd, -1)[:, idx, :]
+    return per_head.reshape(out, -1)
+
+
+def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                  config=None, dtype: str = "float32"):
+    """Build a LlamaForCausalLM carrying a transformers Llama
+    checkpoint's weights.  Pass either the HF model or
+    (state_dict, hf_config)."""
+    from .llama import LlamaConfig, LlamaForCausalLM
+
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    # strip an optional "model."-style prefix difference
+    if not any(k.startswith("model.") for k in sd) and \
+            any(k.startswith("layers.") for k in sd):
+        sd = {"model." + k if not k.startswith("lm_head") else k: v
+              for k, v in sd.items()}
+
+    tie = bool(getattr(config, "tie_word_embeddings", False))
+    cfg = LlamaConfig(
+        vocab_size=config.vocab_size,
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        num_layers=config.num_hidden_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=getattr(config, "num_key_value_heads",
+                             config.num_attention_heads),
+        max_position_embeddings=config.max_position_embeddings,
+        rms_eps=config.rms_norm_eps,
+        rope_theta=getattr(config, "rope_theta", 10000.0),
+        tie_word_embeddings=tie,
+    )
+    model = LlamaForCausalLM(cfg)
+    ll = model.llama
+    cast = lambda a: jnp.asarray(a, dtype=dtype)
+
+    ll.embed_tokens.weight._data = cast(sd["model.embed_tokens.weight"])
+    ll.norm.weight._data = cast(sd["model.norm.weight"])
+    if not tie:
+        model.lm_head_weight._data = cast(sd["lm_head.weight"])
+
+    for i, layer in enumerate(ll.layers):
+        p = f"model.layers.{i}."
+        a = layer.self_attn
+        a.q_proj.weight._data = cast(_interleave_rope_rows(
+            sd[p + "self_attn.q_proj.weight"], cfg.num_heads).T)
+        a.k_proj.weight._data = cast(_interleave_rope_rows(
+            sd[p + "self_attn.k_proj.weight"], cfg.num_kv_heads).T)
+        a.v_proj.weight._data = cast(sd[p + "self_attn.v_proj.weight"].T)
+        a.o_proj.weight._data = cast(sd[p + "self_attn.o_proj.weight"].T)
+        layer.mlp.gate_proj.weight._data = cast(
+            sd[p + "mlp.gate_proj.weight"].T)
+        layer.mlp.up_proj.weight._data = cast(
+            sd[p + "mlp.up_proj.weight"].T)
+        layer.mlp.down_proj.weight._data = cast(
+            sd[p + "mlp.down_proj.weight"].T)
+        layer.input_layernorm.weight._data = cast(
+            sd[p + "input_layernorm.weight"])
+        layer.post_attention_layernorm.weight._data = cast(
+            sd[p + "post_attention_layernorm.weight"])
+    return model
+
+
+def bert_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                 config=None, dtype: str = "float32"):
+    """Build a BertModel carrying a transformers BERT checkpoint's
+    encoder weights (embeddings + encoder + pooler)."""
+    from .bert import BertConfig, BertModel
+
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    if any(k.startswith("bert.") for k in sd):
+        sd = {k[len("bert."):]: v for k, v in sd.items()
+              if k.startswith("bert.")}
+
+    cfg = BertConfig(
+        vocab_size=config.vocab_size,
+        hidden_size=config.hidden_size,
+        num_layers=config.num_hidden_layers,
+        num_heads=config.num_attention_heads,
+        intermediate_size=config.intermediate_size,
+        max_position_embeddings=config.max_position_embeddings,
+        type_vocab_size=config.type_vocab_size,
+        hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0,
+    )
+    model = BertModel(cfg)
+    cast = lambda a: jnp.asarray(a, dtype=dtype)
+
+    emb = model.embeddings
+    emb.word_embeddings.weight._data = cast(
+        sd["embeddings.word_embeddings.weight"])
+    emb.position_embeddings.weight._data = cast(
+        sd["embeddings.position_embeddings.weight"])
+    emb.token_type_embeddings.weight._data = cast(
+        sd["embeddings.token_type_embeddings.weight"])
+    emb.layer_norm.weight._data = cast(sd["embeddings.LayerNorm.weight"])
+    emb.layer_norm.bias._data = cast(sd["embeddings.LayerNorm.bias"])
+
+    for i, layer in enumerate(model.encoder):
+        p = f"encoder.layer.{i}."
+
+        def W(name):
+            return cast(sd[p + name + ".weight"].T)
+
+        def B(name):
+            return cast(sd[p + name + ".bias"])
+
+        att = layer.attention
+        # fused qkv: out columns ordered [q-block, k-block, v-block]
+        att.qkv_proj.weight._data = cast(np.concatenate(
+            [sd[p + "attention.self.query.weight"].T,
+             sd[p + "attention.self.key.weight"].T,
+             sd[p + "attention.self.value.weight"].T], axis=1))
+        att.qkv_proj.bias._data = cast(np.concatenate(
+            [sd[p + "attention.self.query.bias"],
+             sd[p + "attention.self.key.bias"],
+             sd[p + "attention.self.value.bias"]]))
+        att.out_proj.weight._data = W("attention.output.dense")
+        att.out_proj.bias._data = B("attention.output.dense")
+        layer.ln1.weight._data = cast(
+            sd[p + "attention.output.LayerNorm.weight"])
+        layer.ln1.bias._data = cast(
+            sd[p + "attention.output.LayerNorm.bias"])
+        layer.fc1.weight._data = W("intermediate.dense")
+        layer.fc1.bias._data = B("intermediate.dense")
+        layer.fc2.weight._data = W("output.dense")
+        layer.fc2.bias._data = B("output.dense")
+        layer.ln2.weight._data = cast(sd[p + "output.LayerNorm.weight"])
+        layer.ln2.bias._data = cast(sd[p + "output.LayerNorm.bias"])
+
+    model.pooler.dense.weight._data = cast(sd["pooler.dense.weight"].T)
+    model.pooler.dense.bias._data = cast(sd["pooler.dense.bias"])
+    return model
