@@ -77,31 +77,18 @@ in_dygraph_mode = in_dynamic_mode
 in_dynamic_or_pir_mode = in_dynamic_mode
 
 
-def _as_dtype_obj(dtype):
-    """Normalize DType / 'float32' / 'paddle.float32' / np.int32 /
-    np.dtype spellings to the DType table."""
-    import numpy as _np
-    from . import dtype as _dt
-    if isinstance(dtype, _dt.DType):
-        return dtype
-    if isinstance(dtype, str):
-        name = dtype.replace("paddle.", "")
-        if name == "bfloat16":
-            return _dt.bfloat16
-        return _dt.DType(_np.dtype(name).name)
-    return _dt.DType(_np.dtype(dtype).name)   # numpy class / np.dtype
-
-
 def iinfo(dtype):
     """ref: paddle.iinfo — integer dtype limits."""
     import numpy as _np
-    return _np.iinfo(_as_dtype_obj(dtype).numpy_dtype)
+    from .dtype import convert_dtype
+    return _np.iinfo(convert_dtype(dtype).numpy_dtype)
 
 
 def finfo(dtype):
     """ref: paddle.finfo — float dtype limits (bf16-aware via ml_dtypes)."""
     import numpy as _np
-    d = _as_dtype_obj(dtype)
+    from .dtype import convert_dtype
+    d = convert_dtype(dtype)
     if d.name == "bfloat16":
         import ml_dtypes
         return ml_dtypes.finfo(ml_dtypes.bfloat16)

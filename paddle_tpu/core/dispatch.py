@@ -105,8 +105,21 @@ def call_op(fn: Callable, tensor_args: Sequence[Tensor],
     """
     kwargs = kwargs or {}
     arrays = [t._data for t in tensor_args]
+    rec_fn = fn
     if _amp_hook is not None:
-        arrays = _amp_hook(op_name or getattr(fn, "__name__", ""), arrays)
+        cast = _amp_hook(op_name or getattr(fn, "__name__", ""), arrays)
+        if cast is not arrays:   # hook returns the SAME list when off
+            pre = [a.dtype for a in arrays]
+            arrays = cast
+            dts = tuple(a.dtype for a in arrays)
+            if list(dts) != pre:
+                # the amp decision must survive into recorded programs:
+                # a static replay calls the RECORDED fn on raw (uncast)
+                # inputs, so bake this call's cast into it
+                def rec_fn(*xs, __fn=fn, __dts=dts, **kw):
+                    xs = [x.astype(d) if hasattr(x, "astype") else x
+                          for x, d in zip(xs, __dts)]
+                    return __fn(*xs, **kw)
 
     needs_grad = (grad_enabled()
                   and any(not t.stop_gradient for t in tensor_args)
@@ -117,16 +130,17 @@ def call_op(fn: Callable, tensor_args: Sequence[Tensor],
         _t0 = _time.perf_counter()
         try:
             return _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out,
-                                  op_name, needs_grad)
+                                  op_name, needs_grad, rec_fn)
         finally:
             _prof_op_hook(op_name or getattr(fn, "__name__", "op"), _t0,
                           _time.perf_counter())
     return _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out,
-                          op_name, needs_grad)
+                          op_name, needs_grad, rec_fn)
 
 
 def _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out, op_name,
-                   needs_grad):
+                   needs_grad, rec_fn=None):
+    rec_fn = rec_fn or fn
     if not needs_grad:
         outs = fn(*arrays, **kwargs)
         if get_flag("check_nan_inf"):
@@ -135,7 +149,7 @@ def _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out, op_name,
             _sync(outs)
         wrapped = _wrap_outputs(outs, multi_out, None, True)
         if _op_observer is not None:
-            _op_observer(fn, kwargs, tensor_args,
+            _op_observer(rec_fn, kwargs, tensor_args,
                          list(wrapped) if multi_out else [wrapped],
                          multi_out, op_name)
         return wrapped
@@ -152,7 +166,7 @@ def _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out, op_name,
         _sync(outs)
     wrapped = _wrap_outputs(outs, multi_out, node, False)
     if _op_observer is not None:
-        _op_observer(fn, kwargs, tensor_args,
+        _op_observer(rec_fn, kwargs, tensor_args,
                      list(wrapped) if multi_out else [wrapped],
                      multi_out, op_name)
     return wrapped

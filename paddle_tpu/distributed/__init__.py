@@ -16,7 +16,7 @@ from .communication.store import Store, TCPStore
 from .communication import (Group, ReduceOp, get_group, new_group,
                             destroy_process_group, all_reduce, all_gather,
                             all_gather_object, broadcast,
-                            broadcast_object_list, reduce, scatter,
+                            broadcast_object_list, reduce, scatter, gather,
                             scatter_object_list, reduce_scatter, alltoall,
                             alltoall_single, send, recv, isend, irecv,
                             P2POp, batch_isend_irecv, barrier, wait, stream)

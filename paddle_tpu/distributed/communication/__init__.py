@@ -1,7 +1,7 @@
 from .group import Group, ReduceOp, get_group, new_group, destroy_process_group
 from .collective_ops import (all_reduce, all_gather, all_gather_object,
                              broadcast, broadcast_object_list, reduce,
-                             scatter, scatter_object_list, reduce_scatter,
+                             scatter, gather, scatter_object_list, reduce_scatter,
                              alltoall, alltoall_single, send, recv, isend,
                              irecv, P2POp, batch_isend_irecv, barrier, wait)
 from . import stream

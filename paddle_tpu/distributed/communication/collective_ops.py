@@ -134,6 +134,17 @@ def all_gather(tensor_list: Optional[List], tensor=None, group=None,
     return _Work(tuple(tensor_list))
 
 
+def gather(tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op: bool = True, use_calc_stream: bool = False):
+    """ref: communication/gather.py — collect every rank's tensor at
+    ``dst`` (every rank receives the list here — the same legal
+    strengthening of the contract as reduce)."""
+    if gather_list is None:
+        gather_list = []
+    all_gather(gather_list, tensor, group=group)
+    return _Work(tuple(gather_list))
+
+
 def all_gather_object(object_list: List, obj, group=None):
     g = _resolve_group(group)
     del object_list[:]

@@ -15,7 +15,7 @@ __all__ = ["list", "help", "load"]
 _HUBCONF = "hubconf.py"
 
 
-def _load_hubconf(repo_dir: str):
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
@@ -25,6 +25,8 @@ def _load_hubconf(repo_dir: str):
     import hashlib
     name = "paddle_hubconf_" + hashlib.sha1(
         os.path.abspath(repo_dir).encode()).hexdigest()[:10]
+    if force_reload:
+        sys.modules.pop(name, None)
     if name in sys.modules:
         return sys.modules[name]
     spec = importlib.util.spec_from_file_location(name, path)
@@ -48,7 +50,7 @@ def _check_source(source: str):
 def list(repo_dir: str, source: str = "local", force_reload: bool = False):
     """ref: paddle.hub.list — entrypoint names of a local hub repo."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [k for k, v in vars(mod).items()
             if callable(v) and not k.startswith("_")]
 
@@ -57,7 +59,7 @@ def help(repo_dir: str, model: str, source: str = "local",
          force_reload: bool = False) -> Optional[str]:
     """ref: paddle.hub.help — the entrypoint's docstring."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise RuntimeError(f"no entrypoint {model!r} in {repo_dir!r}")
@@ -68,7 +70,7 @@ def load(repo_dir: str, model: str, source: str = "local",
          force_reload: bool = False, **kwargs):
     """ref: paddle.hub.load — call the entrypoint."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise RuntimeError(f"no entrypoint {model!r} in {repo_dir!r}")

@@ -465,3 +465,41 @@ class nn:
         bn = dynn.BatchNorm1D(input.shape[1]) if input.ndim == 2 else \
             dynn.BatchNorm2D(input.shape[1])
         return bn(input)
+
+
+class _StaticAmp:
+    """paddle.static.amp (ref: python/paddle/static/amp/ — decorate +
+    fp16 pass).  TPU-native: the dygraph auto_cast hook fires during op
+    CAPTURE and the recorded op carries its cast (core/dispatch.py
+    rec_fn), so a program built under ``paddle.amp.auto_cast`` replays
+    in mixed precision — no separate program-rewrite pass exists or is
+    needed.  ``decorate`` wraps the optimizer for API parity and to
+    carry the loss-scaling config."""
+
+    @staticmethod
+    def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        optimizer._amp_init_loss_scaling = float(init_loss_scaling)
+        optimizer._amp_dynamic = bool(use_dynamic_loss_scaling)
+        return optimizer
+
+    @staticmethod
+    def fp16_guard():
+        from ..amp import auto_cast
+        return auto_cast(level="O2", dtype="float16")
+
+    @staticmethod
+    def bf16_guard():
+        from ..amp import auto_cast
+        return auto_cast(level="O2", dtype="bfloat16")
+
+    class CustomOpLists:
+        def __init__(self, custom_white_list=None, custom_black_list=None):
+            self.white_list = set(custom_white_list or ())
+            self.black_list = set(custom_black_list or ())
+
+    AutoMixedPrecisionLists = CustomOpLists
+
+
+amp = _StaticAmp()
+__all__.append("amp")

@@ -160,3 +160,16 @@ def test_new_group_and_world():
     assert g.nranks == 8
     w = dist.get_group(0)
     assert w.nranks == 8
+
+
+def test_gather_collects_all_ranks():
+    """ref: communication/gather.py (every rank receives the list — the
+    documented strengthening, like reduce)."""
+    import paddle_tpu.distributed as dist
+    out = []
+    dist.gather(paddle.to_tensor(np.arange(2, dtype="float32")), out,
+                dst=0)
+    from paddle_tpu.distributed.communication.group import _resolve_group
+    assert len(out) == _resolve_group(None).nranks
+    np.testing.assert_array_equal(np.asarray(out[0].numpy()),
+                                  np.arange(2, dtype="float32"))

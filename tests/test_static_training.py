@@ -170,3 +170,24 @@ def test_clone_for_test_drops_writebacks():
     w_before = np.asarray(w.numpy()).copy()
     exe.run(infer, feed={"x": x, "t": t}, fetch_list=[loss])
     np.testing.assert_array_equal(np.asarray(w.numpy()), w_before)
+
+
+def test_static_amp_cast_survives_replay():
+    """ops captured under auto_cast replay in mixed precision (the
+    recorded fn carries the cast — ref: static/amp fp16 pass)."""
+    paddle.enable_static()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        w = paddle.create_parameter([8, 4], "float32", name="w")
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(x, w)
+        loss = y.astype("float32").mean()
+        opt = static.amp.decorate(paddle.optimizer.SGD(learning_rate=0.1))
+        assert opt._amp_init_loss_scaling > 0
+    paddle.disable_static()
+    exe = static.Executor()
+    yv, lv = exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                     fetch_list=[y, loss])
+    assert str(yv.dtype) == "bfloat16"
+    assert np.isfinite(np.asarray(lv)).all()
