@@ -148,12 +148,13 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         warnings.simplefilter("ignore")  # sharding-from-file notice
         restored = ckptr.restore(src)
 
-    def assign(dst, src_tree):
+    def assign(dst, src_tree, prefix=""):
         for k, v in dst.items():
             if k not in src_tree:
                 continue
+            name = f"{prefix}{k}"
             if isinstance(v, dict):
-                assign(v, src_tree[k])
+                assign(v, src_tree[k], prefix=name + ".")
             elif isinstance(v, Tensor):
                 arr = jnp.asarray(src_tree[k])
                 if offload:
@@ -162,8 +163,12 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                 elif hasattr(v._data, "sharding"):
                     try:
                         arr = jax.device_put(arr, v._data.sharding)
-                    except Exception:
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        warnings.warn(
+                            f"load_state_dict: resharding '{name}' to the "
+                            f"destination sharding failed ({type(e).__name__}"
+                            f": {e}); the loaded array keeps its restore-time "
+                            "placement", stacklevel=2)
                 v._data = arr.astype(v._data.dtype) \
                     if arr.dtype != v._data.dtype else arr
 
