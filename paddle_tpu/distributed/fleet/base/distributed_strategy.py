@@ -27,8 +27,9 @@ _HYBRID_DEFAULTS = {
     "pp_degree": 1,
     "sharding_degree": 1,
     "sep_degree": 1,
+    "cp_degree": 1,
     "ep_degree": 1,
-    "order": ["dp", "pp", "sharding", "sep", "mp"],
+    "order": ["dp", "pp", "sharding", "sep", "cp", "mp"],
     "mp_configs": _Bunch(),
     "pp_configs": _Bunch(),
 }

@@ -23,8 +23,8 @@ class CommunicateTopology:
     """ref: topology.py CommunicateTopology — the cartesian rank grid."""
 
     def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe",
-                 "sharding", "sep", "expert", "model"),
-                 dims: Sequence[int] = (1, 1, 1, 1, 1, 1)):
+                 "sharding", "sep", "context", "expert", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1, 1, 1, 1)):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(int(d) for d in dims)
         self._world_size = int(np.prod(self._dims))
@@ -87,8 +87,10 @@ class CommunicateTopology:
 # mesh axis name per reference parallel name.  ``expert`` (ep) sits
 # between sep and mp: inner enough that the MoE all-to-all rides short
 # ICI hops, but outside mp so tp collectives keep the innermost links.
+# ``context`` (cp, ring attention) sits next to sep: its ppermute ring
+# wants ICI-neighbour hops but must stay outside mp.
 _AXIS_OF = {"data": "dp", "pipe": "pp", "sharding": "sharding",
-            "sep": "sep", "expert": "ep", "model": "mp"}
+            "sep": "sep", "context": "cp", "expert": "ep", "model": "mp"}
 
 
 class HybridCommunicateGroup:
@@ -109,6 +111,8 @@ class HybridCommunicateGroup:
         self._sharding_degree = topology.get_dim("sharding")
         self._sep_degree = topology.get_dim("sep") if "sep" in \
             topology.get_hybrid_group_names() else 1
+        self._cp_degree = topology.get_dim("context") if "context" in \
+            topology.get_hybrid_group_names() else 1
         self._ep_degree = topology.get_dim("expert") if "expert" in \
             topology.get_hybrid_group_names() else 1
         self._mp_degree = topology.get_dim("model")
@@ -126,6 +130,7 @@ class HybridCommunicateGroup:
         self._pp_rank = coord.pipe
         self._sharding_rank = coord.sharding
         self._sep_rank = getattr(coord, "sep", 0)
+        self._cp_rank = getattr(coord, "context", 0)
         self._ep_rank = getattr(coord, "expert", 0)
         self._mp_rank = coord.model
 
@@ -143,6 +148,10 @@ class HybridCommunicateGroup:
                                           ranks=_ranks(["sharding"]))
         self._sep_group = axis_group("sep", self._mesh, name="sep",
                                      ranks=_ranks(["sep"]))
+        has_cp = "context" in topology.get_hybrid_group_names()
+        self._cp_group = axis_group("cp", self._mesh, name="cp",
+                                    ranks=_ranks(["context"])) \
+            if has_cp else None
         has_ep = "expert" in topology.get_hybrid_group_names()
         self._ep_group = axis_group("ep", self._mesh, name="ep",
                                     ranks=_ranks(["expert"])) \
@@ -248,6 +257,16 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self) -> Group:
         return self._sep_group
+
+    # --- cp (ring / context parallel) ----------------------------------
+    def get_context_parallel_rank(self) -> int:
+        return self._cp_rank
+
+    def get_context_parallel_world_size(self) -> int:
+        return self._cp_degree
+
+    def get_context_parallel_group(self) -> Group:
+        return self._cp_group
 
     # --- expert parallel (MoE) -----------------------------------------
     def get_expert_parallel_rank(self) -> int:

@@ -42,6 +42,7 @@ class Fleet:
         degrees = {"data": hc["dp_degree"], "pipe": hc["pp_degree"],
                    "sharding": hc["sharding_degree"],
                    "sep": hc["sep_degree"],
+                   "context": hc.get("cp_degree", 1) or 1,
                    "expert": hc.get("ep_degree", 1) or 1,
                    "model": hc["mp_degree"]}
         # -1 / auto dp degree absorbs the remainder of the device grid
@@ -53,9 +54,11 @@ class Fleet:
             degrees["data"] = max(n_dev // known, 1)
             hc["dp_degree"] = degrees["data"]
         topo = CommunicateTopology(
-            ["data", "pipe", "sharding", "sep", "expert", "model"],
+            ["data", "pipe", "sharding", "sep", "context", "expert",
+             "model"],
             [degrees["data"], degrees["pipe"], degrees["sharding"],
-             degrees["sep"], degrees["expert"], degrees["model"]])
+             degrees["sep"], degrees["context"], degrees["expert"],
+             degrees["model"]])
         self._hcg = HybridCommunicateGroup(topo)
         _set_hcg(self._hcg)
         _mark_initialized()
@@ -101,7 +104,8 @@ class Fleet:
             if isinstance(model, PipelineLayer):
                 return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_model_parallel_world_size() > 1 or \
-                hcg.get_sep_parallel_world_size() > 1:
+                hcg.get_sep_parallel_world_size() > 1 or \
+                hcg.get_context_parallel_world_size() > 1:
             return TensorParallel(model, hcg, self._strategy)
         if hcg.get_data_parallel_world_size() > 1 or \
                 hcg.get_sharding_parallel_world_size() > 1:

@@ -6,5 +6,8 @@ from .parallel_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                               model_parallel_random_seed)
 from .tensor_parallel import TensorParallel, SegmentParallel, MetaParallelBase
 from .pipeline_parallel import PipelineParallel
+from .segment_parallel import (active_seq_parallel_axis,
+                               segment_parallel_attention, sep_attention,
+                               cp_ring_attention)
 from . import sharding
 from .pp_spmd import PipelineSpmdStep, gpt_pipeline_step, stack_params

@@ -183,8 +183,17 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids):
         c = self.config
         x = self.embeddings(input_ids)
-        # dp over batch; SP shards the sequence dim over mp between blocks
-        if c.sequence_parallel:
+        # dp over batch; the sequence dim is sharded between blocks by
+        # whichever long-context mechanism is live: sep/cp axis from the
+        # fleet topology (Ulysses/ring — attention itself runs sharded),
+        # else mp when Megatron-SP is on (attention gathers internally)
+        from ..distributed.fleet.meta_parallel.segment_parallel import (
+            active_seq_parallel_axis)
+        seq_axis = active_seq_parallel_axis()
+        if seq_axis is not None:
+            x = sharding_constraint(x, ("dp", "sharding"), seq_axis[0],
+                                    None)
+        elif c.sequence_parallel:
             x = sharding_constraint(x, ("dp", "sharding"), "mp", None)
         else:
             x = sharding_constraint(x, ("dp", "sharding"), None, None)

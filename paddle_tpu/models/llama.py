@@ -219,7 +219,13 @@ class LlamaModel(nn.Layer):
     def forward(self, input_ids):
         c = self.config
         x = self.embed_tokens(input_ids)
-        if c.sequence_parallel:
+        from ..distributed.fleet.meta_parallel.segment_parallel import (
+            active_seq_parallel_axis)
+        seq_axis = active_seq_parallel_axis()
+        if seq_axis is not None:
+            x = sharding_constraint(x, ("dp", "sharding"), seq_axis[0],
+                                    None)
+        elif c.sequence_parallel:
             x = sharding_constraint(x, ("dp", "sharding"), "mp", None)
         else:
             x = sharding_constraint(x, ("dp", "sharding"), None, None)

@@ -19,6 +19,20 @@ from ...random_state import next_key
 from ...flags import get_flag
 
 
+_seg_par_mod = None
+
+
+def _segment_parallel():
+    # imported lazily (fleet pulls nn.Layer at import time — a module-
+    # level import here would cycle), cached after the first call
+    global _seg_par_mod
+    if _seg_par_mod is None:
+        from ...distributed.fleet.meta_parallel import (
+            segment_parallel as _sp)
+        _seg_par_mod = _sp
+    return _seg_par_mod
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p: float = 0.0,
                                  is_causal: bool = False,
@@ -27,6 +41,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     attention layout)."""
     query, key, value = (ensure_tensor(query), ensure_tensor(key),
                          ensure_tensor(value))
+    # sequence/context parallelism: when the fleet topology carries a
+    # sep (Ulysses) or cp (ring) axis, attention itself is the op that
+    # must run sequence-sharded — route it before the local hot paths
+    out = _segment_parallel().segment_parallel_attention(
+        query, key, value, attn_mask, dropout_p, is_causal, training)
+    if out is not None:
+        return out
     args = [query, key, value]
     has_mask = attn_mask is not None
     # hot path: Pallas flash kernel (no mask, no dropout, aligned shapes)
