@@ -207,7 +207,10 @@ def call_op_custom_vjp(fwd_fn: Callable, bwd_fn: Callable,
     if multi_out is None:  # infer: a tuple of arrays means multiple outputs
         multi_out = isinstance(outs, tuple)
     if not needs_grad:
-        return _wrap_outputs(outs, multi_out, None, True)
+        wrapped = _wrap_outputs(outs, multi_out, None, True)
+        _observe_custom_vjp(fwd_fn, bwd_fn, kwargs, tensor_args, wrapped,
+                            multi_out, op_name)
+        return wrapped
 
     n_in = len(arrays)
 
@@ -223,7 +226,44 @@ def call_op_custom_vjp(fwd_fn: Callable, bwd_fn: Callable,
     out_list = list(outs) if multi_out else [outs]
     out_avals = [(tuple(o.shape), o.dtype) for o in out_list]
     node = GradNode(vjp_fn, tensor_args, out_avals, multi_out, op_name)
-    return _wrap_outputs(outs, multi_out, node, False)
+    wrapped = _wrap_outputs(outs, multi_out, node, False)
+    _observe_custom_vjp(fwd_fn, bwd_fn, kwargs, tensor_args, wrapped,
+                        multi_out, op_name)
+    return wrapped
+
+
+def _observe_custom_vjp(fwd_fn, bwd_fn, kwargs, tensor_args, wrapped,
+                        multi_out, op_name):
+    """Make custom-vjp ops visible to program capture (static Program /
+    SOT-lite): record a pure replay fn that carries the SAME hand-written
+    backward via jax.custom_vjp, so replayed programs differentiate the
+    op exactly like the eager tape does."""
+    if _op_observer is None:
+        return
+    kw = dict(kwargs)
+    n_in = len(tensor_args)
+
+    @jax.custom_vjp
+    def replay(*xs):
+        return fwd_fn(*xs, **kw)[0]
+
+    def replay_fwd(*xs):
+        o, r = fwd_fn(*xs, **kw)
+        return o, (r, xs)
+
+    def replay_bwd(res, cots):
+        r, xs = res
+        got = bwd_fn(r, cots)
+        if not isinstance(got, (tuple, list)):
+            got = (got,)
+        got = list(got) + [None] * (n_in - len(got))
+        return tuple(jnp.zeros_like(x) if g is None else g
+                     for g, x in zip(got, xs))
+
+    replay.defvjp(replay_fwd, replay_bwd)
+    _op_observer(replay, {}, tensor_args,
+                 list(wrapped) if multi_out else [wrapped], multi_out,
+                 op_name)
 
 
 # ---------------------------------------------------------------------------

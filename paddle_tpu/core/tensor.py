@@ -26,6 +26,11 @@ def _is_tracer(v) -> bool:
     return isinstance(v, jax.core.Tracer)
 
 
+# installed by paddle_tpu.jit.sot_lite while recording a specialization:
+# called with the Tensor on every host concretization (graph break point)
+_host_read_hook = None
+
+
 class Tensor:
     """Eager tensor. ``stop_gradient`` defaults to True like the reference;
     Parameters default to False."""
@@ -179,6 +184,9 @@ class Tensor:
             raise RuntimeError(
                 "Tensor.numpy() is not available while tracing under "
                 "paddle.jit; this is a graph-break point.")
+        if _host_read_hook is not None:
+            # SOT-lite recording: a host read is a graph break + guard
+            _host_read_hook(self)
         return np.asarray(self._data)
 
     def item(self, *args):

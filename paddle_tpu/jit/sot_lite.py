@@ -1,0 +1,340 @@
+"""SOT-lite: guard-based segment compilation for ``@to_static``.
+
+ref: python/paddle/jit/sot/ — the reference's bytecode-level symbolic
+tracer (eval-frame hook, OpcodeExecutor, guards, graph-break fallback,
+~80k LoC).  TPU-native re-design: instead of capturing CPython bytecode,
+the function is run EAGERLY once per specialization while every op is
+recorded through the ``core.dispatch`` chokepoint (the same observer the
+static ``Program`` uses).  A host read — ``.numpy()`` / ``.item()`` /
+``bool(t)`` / ``int(t)`` — does not abort the capture: it becomes a
+**graph break**.  The op stream is cut at the read, the leaked value
+becomes a **guard**, and each contiguous op run becomes one jit-compiled
+segment.
+
+Replay of a specialization executes::
+
+    segment_0 (compiled) -> guard check -> segment_1 (compiled) -> ...
+
+A failed guard means the host-visible value differs from the recorded
+one, so the recorded Python control flow can no longer be trusted — the
+call re-records a NEW specialization for that path (each distinct branch
+gets its own compiled chain).  Specializations per input signature are
+bounded; past the cap the function stays eager for that signature.
+
+Semantics notes (shared with the reference's SOT design):
+- guards are concretized constants: gradients do not flow through a
+  break (each segment is differentiated separately — here the segments
+  go through ``call_op`` so the eager tape chains them);
+- values computed in Python from a leaked value (e.g. ``int(x.mean())``
+  baked into a later op) are validated by the guard on the leak itself —
+  value-equality guards are strictly stronger than the reference's
+  predicate guards (safe, possibly more re-records);
+- RNG-consuming ops (dropout) bake the key drawn at record time, so a
+  replayed specialization re-uses its recorded mask — matching static
+  ``Program`` replay semantics, not fresh-eager semantics.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..static.capture import Program, pop_program, push_program, \
+    in_static_capture
+
+# max specializations (distinct guard paths) per input signature
+MAX_TRACES_PER_SIG = 8
+# a leaked value bigger than this is not worth guarding on (e.g. a full
+# weight matrix pulled for logging) — the signature stays eager
+MAX_GUARD_ELEMS = 65536
+
+
+class GraphBreakUnsupported(Exception):
+    """The recorded function can't be specialized (oversized guard,
+    nested capture, ...) — caller should stay eager."""
+
+
+# --------------------------------------------------------------------------
+# recording
+# --------------------------------------------------------------------------
+
+_active: Optional["_Recording"] = None
+
+
+def notify_host_read(t: Tensor):
+    """Called by Tensor.numpy() on every host concretization."""
+    if _active is not None:
+        _active.host_read(t)
+
+
+def recording_active() -> bool:
+    return _active is not None
+
+
+class _Recording:
+    def __init__(self):
+        self.program = Program()
+        # (op_index, tensor, snapshot) — op_index is where the stream cuts
+        self.breaks: List[Tuple[int, Tensor, np.ndarray]] = []
+        # set when the run can't be specialized; the recording still
+        # completes (the function executes exactly ONCE — no re-run, no
+        # doubled side effects), it just isn't cached
+        self.unsupported: Optional[str] = None
+
+    def host_read(self, t: Tensor):
+        val = np.asarray(t._data)
+        if val.size > MAX_GUARD_ELEMS:
+            self.unsupported = (f"host read of a {val.size}-element "
+                                "tensor is too large to guard on")
+            return
+        self.breaks.append((len(self.program.ops), t, val.copy()))
+
+    def rng_drawn(self):
+        # an RNG-consuming op (dropout …) bakes its key into the record;
+        # replaying would freeze the mask — refuse to specialize
+        self.unsupported = ("an RNG-consuming op (e.g. dropout) ran "
+                            "during the recording; a replay would reuse "
+                            "the recorded mask")
+
+
+def record(fn: Callable, args, kwargs):
+    """Run ``fn`` eagerly, recording ops + breaks.  Returns
+    (recording, output).  Exceptions from ``fn`` propagate (user bug)."""
+    global _active
+    if _active is not None or in_static_capture():
+        raise GraphBreakUnsupported(
+            "nested SOT/static capture is not supported")
+    rec = _Recording()
+    import paddle_tpu.core.dispatch as _dispatch
+    import paddle_tpu.core.tensor as _tensor_mod
+    import paddle_tpu.random_state as _rs
+    push_program(rec.program)
+    from ..static.capture import record_op
+    prev_observer = _dispatch._op_observer
+    prev_hook = _tensor_mod._host_read_hook
+    prev_rng = _rs._rng_draw_hook
+    _dispatch._op_observer = record_op
+    _tensor_mod._host_read_hook = notify_host_read
+    _rs._rng_draw_hook = rec.rng_drawn
+    _active = rec
+    try:
+        out = fn(*args, **kwargs)
+    finally:
+        _active = None
+        _dispatch._op_observer = prev_observer
+        _tensor_mod._host_read_hook = prev_hook
+        _rs._rng_draw_hook = prev_rng
+        pop_program()
+    return rec, out
+
+
+# --------------------------------------------------------------------------
+# trace building
+# --------------------------------------------------------------------------
+
+class _Segment:
+    """One compiled op run.  Holds only lightweight op SPECS (fn, kwargs,
+    input/output ids) — never recorded Tensor objects — so the recording
+    run's intermediate activations are freed once the trace is built."""
+
+    __slots__ = ("in_ids", "out_ids", "pure", "n_ops")
+
+    def __init__(self, ops, in_ids, out_ids):
+        self.in_ids = in_ids      # recorded-tensor ids, call order
+        self.out_ids = out_ids
+        self.n_ops = len(ops)
+        id_pos = {tid: i for i, tid in enumerate(in_ids)}
+        specs = [(op.fn, dict(op.kwargs), [id(t) for t in op.inputs],
+                  [id(t) for t in op.outputs], op.multi_out)
+                 for op in ops]
+
+        def pure(*xs):
+            env: Dict[int, Any] = {tid: xs[i] for tid, i in id_pos.items()}
+            for fn, kw, in_tids, out_tids, multi in specs:
+                got = fn(*(env[t] for t in in_tids), **kw)
+                if multi:
+                    for tid, o in zip(out_tids, got):
+                        env[tid] = o
+                else:
+                    env[out_tids[0]] = got
+            return tuple(env[tid] for tid in out_ids)
+
+        self.pure = jax.jit(pure)
+
+
+class SotTrace:
+    """One guard-specialized compiled chain for one input signature."""
+
+    def __init__(self, recording: _Recording, input_ids: List[int],
+                 out_tree, out_leaves: List[Tensor]):
+        ops = recording.program.ops
+        self.out_tree = out_tree
+        out_leaf_ids = [id(t) for t in out_leaves]
+        self.out_leaf_ids = out_leaf_ids
+        self.input_ids = input_ids
+
+        # break positions cut the stream; merge duplicates at one index
+        bounds = sorted({i for i, _, _ in recording.breaks})
+        spans = []
+        prev = 0
+        for b in bounds:
+            spans.append((prev, b))
+            prev = b
+        spans.append((prev, len(ops)))
+        # guards grouped by their boundary index
+        self.guards_at: Dict[int, List[Tuple[Tensor, np.ndarray]]] = {}
+        for i, t, v in recording.breaks:
+            self.guards_at.setdefault(i, []).append((t, v))
+
+        needed_later: Dict[int, int] = {}      # id -> last span needing it
+        for si, (a, b) in enumerate(spans):
+            for op in ops[a:b]:
+                for t in op.inputs:
+                    needed_later[id(t)] = si
+        for tid in out_leaf_ids:
+            needed_later[tid] = len(spans)
+        for i, t, _ in recording.breaks:
+            # a guard at boundary i is evaluated after the span ending at i
+            needed_later[id(t)] = max(needed_later.get(id(t), 0),
+                                      len(spans))
+
+        self.segments: List[Tuple[int, _Segment]] = []  # (end_bound, seg)
+        for si, (a, b) in enumerate(spans):
+            seg_ops = ops[a:b]
+            # an input is external to the span iff not yet produced at
+            # its point of use (use-before-produce keeps the pre-value —
+            # the same order-sensitive rule as Program.build_replay)
+            in_ids, seen, produced = [], set(), set()
+            for op in seg_ops:
+                for t in op.inputs:
+                    tid = id(t)
+                    if tid not in produced and tid not in seen:
+                        seen.add(tid)
+                        in_ids.append(tid)
+                for t in op.outputs:
+                    produced.add(id(t))
+            out_ids = [tid for tid in
+                       dict.fromkeys(id(t) for op in seg_ops
+                                     for t in op.outputs)
+                       if needed_later.get(tid, -1) > si]
+            self.segments.append((b, _Segment(seg_ops, in_ids, out_ids)))
+
+        # strong refs ONLY for tensors replays must read live or rebuild:
+        # externals (params/buffers/constants — never produced by an op),
+        # guard targets, and output leaves.  Produced intermediates are
+        # NOT retained — the recording run's activations are freed here
+        # (their baked ids never hit the _tensors fallback: env always
+        # covers them by liveness).
+        self._tensors: Dict[int, Tensor] = {}
+        input_set = set(input_ids)
+        produced_run: set = set()
+        for op in ops:   # order-sensitive: external at FIRST use
+            for t in op.inputs:
+                tid = id(t)
+                if tid not in produced_run and tid not in input_set:
+                    self._tensors.setdefault(tid, t)
+            for t in op.outputs:
+                produced_run.add(id(t))
+        for i, t, _ in recording.breaks:
+            self._tensors.setdefault(id(t), t)
+        for t in out_leaves:
+            self._tensors.setdefault(id(t), t)
+
+    # -- replay ------------------------------------------------------------
+    def replay(self, input_tensors: Sequence[Tensor]):
+        """Run the compiled chain.  Returns the rebuilt output, or None if
+        a guard failed (caller records a new specialization)."""
+        env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
+
+        def resolve(tid) -> Tensor:
+            t = env.get(tid)
+            if t is not None:
+                return t
+            return self._tensors[tid]   # external: param/const, live data
+
+        for end_bound, seg in self.segments:
+            ins = tuple(resolve(tid) for tid in seg.in_ids)
+            if seg.n_ops:
+                outs = call_op(seg.pure, ins, {}, multi_out=True,
+                               op_name="sot_segment")
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                for tid, o in zip(seg.out_ids, outs):
+                    rec_t = self._tensors.get(tid)
+                    if rec_t is not None:
+                        o.stop_gradient = rec_t.stop_gradient
+                    env[tid] = o
+            # guards at this boundary
+            for t, expected in self.guards_at.get(end_bound, ()):  # noqa: B909
+                cur = env.get(id(t), t)
+                got = np.asarray(cur._data)
+                if got.shape != expected.shape or \
+                        not np.array_equal(got, expected):
+                    return None
+        return self._rebuild(env)
+
+    def _rebuild(self, env):
+        def walk(o):
+            if isinstance(o, tuple) and len(o) == 2 and o[0] == "__sot__":
+                tid = o[1]
+                return env.get(tid, self._tensors.get(tid))
+            if isinstance(o, list):
+                return [walk(i) for i in o]
+            if isinstance(o, tuple):
+                return tuple(walk(i) for i in o)
+            if isinstance(o, dict):
+                return {k: walk(v) for k, v in o.items()}
+            return o
+        return walk(self.out_tree)
+
+
+def build_trace(recording: _Recording, input_tensors: Sequence[Tensor],
+                output) -> Tuple[SotTrace, Any]:
+    """Turn a recording into a replayable trace; returns (trace,
+    output_to_return) where the output is the recording run's (already
+    correct, eager) result."""
+    input_ids = [id(t) for t in input_tensors]
+    leaves: List[Tensor] = []
+
+    def encode(o):
+        if isinstance(o, Tensor):
+            leaves.append(o)
+            return ("__sot__", id(o))
+        if isinstance(o, list):
+            return [encode(i) for i in o]
+        if isinstance(o, tuple):
+            return tuple(encode(i) for i in o)
+        if isinstance(o, dict):
+            return {k: encode(v) for k, v in o.items()}
+        return o
+
+    tree = encode(output)
+    trace = SotTrace(recording, input_ids, tree, leaves)
+    return trace, output
+
+
+class SotCache:
+    """Per-signature list of guard-specialized traces.
+
+    ``gave_up`` stops NEW recordings only — already-compiled traces keep
+    being consulted, so recurring guard values still hit the cache."""
+
+    def __init__(self):
+        self.traces: List[SotTrace] = []
+        self.gave_up = False
+
+    def lookup_and_replay(self, input_tensors):
+        for trace in self.traces:
+            out = trace.replay(input_tensors)
+            if out is not None:
+                return out
+        return None
+
+    def add(self, trace: SotTrace):
+        self.traces.append(trace)
+        if len(self.traces) >= MAX_TRACES_PER_SIG:
+            self.gave_up = True

@@ -66,13 +66,17 @@ class StaticFunction:
 
     def __init__(self, function: Callable, input_spec=None,
                  build_strategy=None, layer: Optional[Layer] = None,
-                 full_graph: bool = True):
+                 full_graph: bool = False):
         functools.update_wrapper(self, function)
         self._function = function
         self._input_spec = input_spec
         self._layer = layer
         self._cache = {}
         self._broken = False
+        self._full_graph = bool(full_graph)
+        # SOT-lite: per-signature guard-specialized segment chains for
+        # functions with graph breaks (ref: jit/sot/ guard+fallback)
+        self._sot_cache = {}
         self.__name__ = getattr(function, "__name__", "static_fn")
 
     # -- bound-method protocol (to_static on Layer.forward) -------------
@@ -87,7 +91,8 @@ class StaticFunction:
             if bound is not None:
                 return bound
         bound = StaticFunction(self._function.__get__(instance, owner),
-                               self._input_spec, layer=instance)
+                               self._input_spec, layer=instance,
+                               full_graph=self._full_graph)
         if cache is not None:
             cache[key] = bound
         return bound
@@ -189,17 +194,16 @@ class StaticFunction:
         training = all(not isinstance(l, Layer) or l.training
                        for l in [self._layer] if l is not None)
         sig = _signature(args, kwargs, training)
+        # a signature that already graph-broke goes straight to SOT-lite
+        if sig in self._sot_cache:
+            return self._sot_call(sig, args, kwargs)
         entry = self._cache.get(sig)
         if entry is None:
             try:
                 pure, slots, out_box = self._build(args, kwargs, params,
                                                    training)
-            except Exception as e:  # graph break → eager fallback
-                warnings.warn(
-                    f"to_static fallback to eager (graph break): {e}",
-                    RuntimeWarning)
-                self._broken = True
-                return self._function(*args, **kwargs)
+            except Exception as e:  # graph break
+                return self._graph_break(sig, args, kwargs, e)
             entry = (pure, out_box)
             self._cache[sig] = entry
         pure, out_box = entry
@@ -233,16 +237,7 @@ class StaticFunction:
                            {}, multi_out=True, op_name="to_static")
         except Exception as e:
             self._cache.pop(sig, None)
-            # distinguish a genuine graph break (.numpy() on a tracer,
-            # data-dependent control flow) from a plain user error: if the
-            # function ALSO fails eagerly, it's the user's bug — re-raise
-            # and do NOT disable compilation
-            result = self._function(*args, **kwargs)  # may (rightly) raise
-            warnings.warn(
-                f"to_static fallback to eager (graph break): {e}",
-                RuntimeWarning)
-            self._broken = True
-            return result
+            return self._graph_break(sig, args, kwargs, e)
         if not isinstance(outs, tuple):
             outs = (outs,)
 
@@ -258,6 +253,93 @@ class StaticFunction:
             return o
 
         return rebuild_out(out_box["tree"])
+
+    # -- SOT-lite: graph breaks ------------------------------------------
+    def _graph_break(self, sig, args, kwargs, exc):
+        """Whole-graph tracing hit a break (.numpy()/.item()/bool on a
+        tracer, data-dependent python control flow).
+
+        full_graph=True → the reference's AST-path contract: warn, run
+        eager, disable compilation.  Otherwise (default, the SOT path) —
+        record the function eagerly, split it into compiled segments at
+        the host reads, and guard on the leaked values (ref: jit/sot/)."""
+        from . import sot_lite
+        if self._full_graph:
+            # run eager FIRST: if the function also fails eagerly it's a
+            # plain user bug — re-raise without disabling compilation
+            result = self._function(*args, **kwargs)
+            warnings.warn(
+                f"to_static fallback to eager (graph break): {exc}",
+                RuntimeWarning)
+            self._broken = True
+            return result
+        self._sot_cache[sig] = sot_lite.SotCache()
+        warnings.warn(
+            f"to_static graph break ({exc}); compiling in guarded "
+            "segments (SOT)", RuntimeWarning)
+        return self._sot_call(sig, args, kwargs)
+
+    def _sot_inputs(self, args, kwargs):
+        """Wrap array leaves as Tensors (stable identities for the
+        recording) and collect the input tensors in walk order."""
+        tensors: List[Tensor] = []
+
+        def walk(o):
+            if isinstance(o, Tensor):
+                tensors.append(o)
+                return o
+            if isinstance(o, (np.ndarray, jnp.ndarray, jax.Array)):
+                t = Tensor(o)
+                tensors.append(t)
+                return t
+            if isinstance(o, list):
+                return [walk(i) for i in o]
+            if isinstance(o, tuple):
+                return tuple(walk(i) for i in o)
+            if isinstance(o, dict):
+                return {k: walk(v) for k, v in o.items()}
+            return o
+
+        new_args = walk(tuple(args))
+        new_kwargs = walk(dict(kwargs))
+        return new_args, new_kwargs, tensors
+
+    def _sot_call(self, sig, args, kwargs):
+        from . import sot_lite
+        sot = self._sot_cache[sig]
+        new_args, new_kwargs, inputs = self._sot_inputs(args, kwargs)
+        out = sot.lookup_and_replay(inputs)
+        if out is not None:
+            return out
+        if sot.gave_up:    # cap reached / unsupported: no NEW recordings
+            return self._function(*new_args, **new_kwargs)
+        try:
+            rec, out = sot_lite.record(self._function, new_args,
+                                       new_kwargs)
+        except sot_lite.GraphBreakUnsupported as e:
+            warnings.warn(
+                f"to_static: cannot specialize this graph break ({e}); "
+                "staying eager for this signature", RuntimeWarning)
+            sot.gave_up = True
+            return self._function(*new_args, **new_kwargs)
+        if rec.unsupported is not None:
+            # the recording itself already ran the function exactly once;
+            # return its (correct, eager) result and stop specializing
+            warnings.warn(
+                f"to_static: cannot specialize this graph break "
+                f"({rec.unsupported}); staying eager for this signature",
+                RuntimeWarning)
+            sot.gave_up = True
+            return out
+        trace, out = sot_lite.build_trace(rec, inputs, out)
+        sot.add(trace)
+        if sot.gave_up:
+            warnings.warn(
+                f"to_static: {len(sot.traces)} guard specializations for "
+                "one signature — no new recordings for it (cached paths "
+                "keep replaying; unseen guard values run eager)",
+                RuntimeWarning)
+        return out
 
     # -- reference API ----------------------------------------------------
     def concrete_program_specify_input_spec(self, *a, **kw):
@@ -276,13 +358,17 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph: bool = True, **kwargs):
-    """ref: paddle.jit.to_static."""
+              backend=None, full_graph: bool = False, **kwargs):
+    """ref: paddle.jit.to_static.  ``full_graph=False`` (the reference's
+    default since the SOT era) allows graph breaks: host reads fall back
+    to guarded compiled segments (see jit/sot_lite.py).  With
+    ``full_graph=True`` a break downgrades the function to eager."""
     def wrap(fn):
         if isinstance(fn, Layer):
-            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn,
+                                        full_graph=full_graph)
             return fn
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, full_graph=full_graph)
     if function is not None:
         return wrap(function)
     return wrap

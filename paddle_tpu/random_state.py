@@ -15,6 +15,10 @@ import jax
 import numpy as np
 
 
+# installed by paddle_tpu.jit.sot_lite while recording a specialization
+_rng_draw_hook = None
+
+
 class Generator:
     """The key is created LAZILY on first use: jax.random.PRNGKey
     initializes the jax backend, and the module-level default generator
@@ -40,6 +44,10 @@ class Generator:
         return self._seed
 
     def next_key(self):
+        if _rng_draw_hook is not None:
+            # SOT-lite recording: a drawn key would be baked into the
+            # replayed program — let the recorder refuse to specialize
+            _rng_draw_hook()
         self._key, sub = jax.random.split(self._state)
         return sub
 
