@@ -33,6 +33,7 @@ class PipelineParallel(MetaParallelBase):
         self.accumulate_steps = cfg["accumulate_steps"] if cfg else 1
         self.schedule_mode = cfg.get("schedule_mode", "1F1B") if cfg else "1F1B"
         self.total_loss = None
+        self._host_sched = None
 
     def _split_micro(self, data, n):
         if isinstance(data, (tuple, list)):
@@ -42,22 +43,41 @@ class PipelineParallel(MetaParallelBase):
         mb = b // n
         return [data[i * mb:(i + 1) * mb] for i in range(n)]
 
+    def _scheduler(self):
+        """The host-driven schedule driver for this wrapper's
+        ``schedule_mode`` (FThenB/1F1B/VPP/ZBH1 — ref: the reference's
+        schedule zoo), built lazily."""
+        if self._host_sched is None:
+            from .pp_schedules import HostPipelineSchedule
+            self._host_sched = HostPipelineSchedule(
+                self._layers, schedule_mode=self.schedule_mode)
+        return self._host_sched
+
     def forward_backward_pipeline(self, data, scaler=None):
-        """Gradient-accumulating microbatch loop.  Stage overlap is XLA's
-        job once the step is jitted; eager mode gives the same numerics."""
+        """Microbatch loop under the selected schedule.
+
+        schedule_mode routes to the host-driven event drivers
+        (pp_schedules.py): per-stage jitted fns, explicit fwd/bwd event
+        order, stage overlap via async dispatch.  GradScaler runs use the
+        plain grad-accum loop (the scaler hooks the tape's backward)."""
         inputs, labels = data
         n = self.accumulate_steps
         micro_inputs = self._split_micro(inputs, n)
         micro_labels = self._split_micro(labels, n)
+        if scaler is None:
+            sched = self._scheduler()
+            x_arrays = [x._data if isinstance(x, Tensor) else x
+                        for x in micro_inputs]
+            y_arrays = [y._data if isinstance(y, Tensor) else y
+                        for y in micro_labels]
+            self.total_loss = sched.forward_backward(x_arrays, y_arrays)
+            return self.total_loss
         total = None
         for x, y in zip(micro_inputs, micro_labels):
             out = self._layers(x)
             loss = self._layers._loss_fn(out, y)
-            if scaler is not None:
-                scaled = scaler.scale(loss / n)
-                scaled.backward()
-            else:
-                (loss / n).backward()
+            scaled = scaler.scale(loss / n)
+            scaled.backward()
             total = loss.detach() if total is None else total + loss.detach()
         self.total_loss = total / n if total is not None else None
         return self.total_loss

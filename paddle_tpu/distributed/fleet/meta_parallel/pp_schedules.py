@@ -1,0 +1,380 @@
+"""Host-driven pipeline schedules: FThenB / 1F1B / VPP / ZBH1.
+
+ref: fleet/meta_parallel/pipeline_parallel.py (F-then-B and 1F1B over
+NCCL p2p) and distributed/passes/pipeline_scheduler_pass.py (interleaved
+VPP, zero-bubble ZBH1).
+
+TPU-native design.  The reference is MPMD: every rank runs its own
+schedule loop and p2p-exchanges activations.  Under the single-controller
+runtime the same schedules become a host-driven EVENT LOOP over
+per-stage jit-compiled functions:
+
+- each pipeline stage is a pure fn ``fwd(params, h) -> h`` compiled with
+  ``jax.jit`` and pinned to its stage's device, so consecutive host
+  dispatches to different stages overlap through XLA's async execution —
+  the host loop only sequences, it never blocks on device work;
+- backward runs through ``jax.vjp`` of the jitted stage fn (compiled),
+  giving per-(stage, microbatch) backward events the schedule can place
+  freely — exactly the knob the reference's schedule zoo turns;
+- the schedule itself is a dependency-driven tick simulation: at every
+  tick each stage executes at most one ready event, in the per-stage
+  order that DEFINES the schedule (all-forwards-then-all-backwards for
+  FThenB; warmup/steady-1F1B/cooldown for 1F1B; the same over V virtual
+  stages per device for VPP; ZBH1 splits backward into activation-grad
+  (BWD_D) and weight-grad (BWD_W) events — two separate vjps — and
+  fills the cooldown bubble with the deferred weight grads).
+
+All schedules are numerically identical (grad accumulation is a sum);
+what differs is event ORDER (asserted in tests via ``event_log``) and
+peak residency of saved activations (``peak_live_residuals``: FThenB
+holds all M×S forward residuals, 1F1B at most S per stage).  For hybrid
+meshes (mp/sharding inside a stage) the compiled shard_map ring
+(pp_spmd.py) remains the fast path; these drivers carry the reference's
+schedule semantics and the pp-only path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.autograd_state import no_grad
+from ....core.tensor import Tensor
+
+FWD, BWD, BWD_D, BWD_W = "F", "B", "Bd", "Bw"
+
+
+def _is_sharded(arr) -> bool:
+    """Multi-device (GSPMD-committed) arrays keep their sharding; only
+    single-device arrays are pinned to the stage device."""
+    sh = getattr(arr, "sharding", None)
+    return sh is not None and getattr(sh, "num_devices", 1) > 1
+
+
+# ---------------------------------------------------------------------------
+# per-stage compiled runners
+# ---------------------------------------------------------------------------
+
+class _StageRunner:
+    """One pipeline stage as a pure, jitted function of
+    ``(params, h, key[, labels])``.
+
+    The PRNG key is an ARGUMENT: the host draws a fresh key per
+    (stage, microbatch) forward event and the generator is sandboxed
+    around the layer calls, so dropout gets fresh masks every microbatch
+    and step instead of a key baked at trace time (the pp_spmd
+    ``block_with_key`` pattern).  ``recompute_every`` > 0 honors the
+    PipelineLayer's ``_recompute_interval``: layers are grouped into
+    chunks of that size and each chunk is wrapped in ``jax.checkpoint``,
+    bounding saved residuals exactly like the eager recompute() path."""
+
+    def __init__(self, layers: Sequence, device, loss_fn=None,
+                 recompute_every: int = 0):
+        self.layers = list(layers)
+        self.device = device
+        self.loss_fn = loss_fn        # set on the LAST stage only
+        seen, params = set(), []
+        for l in self.layers:
+            for p in l.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    params.append(p)
+        self.params = params
+        from ....random_state import default_generator
+
+        # chunk the layers for recompute; one chunk == no checkpointing
+        k = int(recompute_every) if recompute_every else 0
+        if k > 0:
+            chunks = [self.layers[i:i + k]
+                      for i in range(0, len(self.layers), k)]
+        else:
+            chunks = [self.layers]
+
+        def apply_chunk(chunk, chunk_key, param_arrays, h):
+            # pure in (param_arrays, h); layers' params are swapped in
+            # around the call (tape off — jax.vjp differentiates this)
+            saved_k = default_generator.get_state()
+            default_generator.set_state(chunk_key)
+            with no_grad():
+                saved = [p._data for p in self.params]
+                for p, v in zip(self.params, param_arrays):
+                    p._data = v
+                try:
+                    t = Tensor(h)
+                    for l in chunk:
+                        t = l(t)
+                    return t._data
+                finally:
+                    for p, v in zip(self.params, saved):
+                        p._data = v
+                    default_generator.set_state(saved_k)
+
+        chunk_fns = []
+        for ci, chunk in enumerate(chunks):
+            fn = functools.partial(apply_chunk, chunk)
+            if k > 0:
+                fn = jax.checkpoint(fn)
+            chunk_fns.append(fn)
+
+        def run(param_arrays, h, key, labels=None):
+            for ci, fn in enumerate(chunk_fns):
+                h = fn(jax.random.fold_in(key, ci), param_arrays, h)
+            if self.loss_fn is not None:
+                saved_k = default_generator.get_state()
+                default_generator.set_state(
+                    jax.random.fold_in(key, len(chunk_fns)))
+                with no_grad():
+                    try:
+                        out = self.loss_fn(Tensor(h), Tensor(labels))
+                    finally:
+                        default_generator.set_state(saved_k)
+                return out._data
+            return h
+
+        self._run = run
+        self.fwd = jax.jit(run)
+        # pin this stage's parameters to its device — the computation
+        # follows its inputs, so stage dispatches land on distinct
+        # devices and overlap through XLA async execution
+        if device is not None:
+            for p in self.params:
+                if not _is_sharded(p._data):
+                    p._data = jax.device_put(p._data, device)
+
+    def param_values(self):
+        return [p._data for p in self.params]
+
+
+# ---------------------------------------------------------------------------
+# schedule timetables (per-stage event order — this IS the schedule)
+# ---------------------------------------------------------------------------
+
+def _order_fthenb(stage: int, n_stages: int, m: int):
+    return [(FWD, i) for i in range(m)] + [(BWD, i) for i in range(m)]
+
+
+def _order_1f1b(stage: int, n_stages: int, m: int):
+    """ref: PipelineParallel 1F1B — warmup fwds, steady fwd/bwd pairs,
+    cooldown bwds."""
+    warmup = min(n_stages - stage - 1, m)
+    ev: List[Tuple[str, int]] = [(FWD, i) for i in range(warmup)]
+    b = 0
+    for f in range(warmup, m):
+        ev.append((FWD, f))
+        ev.append((BWD, b))
+        b += 1
+    ev += [(BWD, i) for i in range(b, m)]
+    return ev
+
+
+def _order_zbh1(stage: int, n_stages: int, m: int):
+    """ZBH1 (ref: pipeline_scheduler_pass zero-bubble H1): like 1F1B but
+    backward splits into Bd (activation grad, on the critical path) and
+    Bw (weight grad, deferred into the cooldown bubble)."""
+    warmup = min(n_stages - stage - 1, m)
+    ev: List[Tuple[str, int]] = [(FWD, i) for i in range(warmup)]
+    b = 0
+    for f in range(warmup, m):
+        ev.append((FWD, f))
+        ev.append((BWD_D, b))
+        # deeper stages start weight grads immediately (they have no
+        # bubble); earlier stages defer them into the drain phase
+        if stage == n_stages - 1:
+            ev.append((BWD_W, b))
+        b += 1
+    for i in range(b, m):
+        ev.append((BWD_D, i))
+    if stage != n_stages - 1:
+        ev += [(BWD_W, i) for i in range(m)]
+    else:
+        ev += [(BWD_W, i) for i in range(b, m)]
+    return ev
+
+
+_ORDERS = {"FThenB": _order_fthenb, "F-then-B": _order_fthenb,
+           "1F1B": _order_1f1b, "ZBH1": _order_zbh1, "ZBpp": _order_zbh1}
+
+
+# ---------------------------------------------------------------------------
+# the host event loop
+# ---------------------------------------------------------------------------
+
+class HostPipelineSchedule:
+    """Drive a segmented PipelineLayer through an explicit schedule.
+
+    ``schedule_mode``: FThenB | 1F1B | VPP | ZBH1.  VPP is 1F1B over
+    ``num_virtual_pipeline_stages`` chunks per device (interleaved);
+    the chunk of virtual stage k lives on device k % P.
+    """
+
+    def __init__(self, pipeline_layer, schedule_mode: str = "1F1B",
+                 devices: Optional[Sequence] = None):
+        self.pl = pipeline_layer
+        self.mode = schedule_mode
+        n_stages = pipeline_layer.get_num_stages()
+        v = getattr(pipeline_layer, "_num_virtual_pipeline_stages", 1) or 1
+        if schedule_mode == "VPP":
+            if v <= 1:
+                raise ValueError(
+                    "schedule_mode='VPP' needs "
+                    "num_virtual_pipeline_stages > 1 on the PipelineLayer")
+        else:
+            v = 1
+        self.n_virtual = n_stages * v
+        self.n_devices = n_stages
+        if devices is None:
+            devs = jax.devices()
+            devices = [devs[s % len(devs)] for s in range(n_stages)]
+        # virtual stage k -> device k % P (interleaved mapping)
+        self.runners: List[_StageRunner] = []
+        bounds = _virtual_bounds(pipeline_layer, self.n_virtual)
+        rc = getattr(pipeline_layer, "_recompute_interval", 0) or 0
+        for k in range(self.n_virtual):
+            a, b = bounds[k]
+            layers = pipeline_layer.run_function[a:b]
+            is_last = k == self.n_virtual - 1
+            self.runners.append(_StageRunner(
+                layers, devices[k % n_stages],
+                loss_fn=pipeline_layer._loss_fn if is_last else None,
+                recompute_every=rc))
+        self.event_log: List[Tuple[int, str, int]] = []
+        self.peak_live_residuals = 0
+
+    # -- one scheduled step -------------------------------------------------
+    def forward_backward(self, micro_inputs, micro_labels):
+        """Run all microbatches through the schedule; accumulates grads
+        into the stage parameters' ``.grad``; returns the mean loss."""
+        m = len(micro_inputs)
+        S = self.n_virtual
+        order_fn = _ORDERS.get("1F1B" if self.mode == "VPP" else self.mode)
+        if order_fn is None:
+            raise ValueError(f"unknown schedule_mode {self.mode!r} "
+                             f"(have {sorted(_ORDERS)} + VPP)")
+        queues = [list(order_fn(s, S, m)) for s in range(S)]
+        qpos = [0] * S
+
+        from ....random_state import default_generator
+        vjps: Dict[Tuple[int, int], Callable] = {}
+        dgrad_done: Dict[Tuple[int, int], bool] = {}
+        wgrad_pending: Dict[Tuple[int, int], List] = {}
+        acts: Dict[Tuple[int, int], jnp.ndarray] = {}   # fwd outputs
+        gin: Dict[Tuple[int, int], jnp.ndarray] = {}    # bwd cotangents
+        losses: List = []
+        grad_acc: List[Optional[List]] = [None] * S
+        self.event_log = []
+        self.peak_live_residuals = 0
+
+        def deps_ready(s, kind, i):
+            if kind == FWD:
+                return s == 0 or (s - 1, i) in acts
+            if kind == BWD or kind == BWD_D:
+                if (s, i) not in vjps:
+                    return False
+                return s == S - 1 or (s + 1, i) in gin
+            # BWD_W: needs its own dgrad pass done (grads stashed)
+            return dgrad_done.get((s, i), False)
+
+        def run_event(s, kind, i):
+            self.event_log.append((s, kind, i))
+            r = self.runners[s]
+            if kind == FWD:
+                h = micro_inputs[i] if s == 0 else acts[(s - 1, i)]
+                if r.device is not None and not _is_sharded(h):
+                    h = jax.device_put(h, r.device)
+                pv = r.param_values()
+                # fresh per-(stage, micro) dropout stream from the host
+                # generator — an ARGUMENT of the jitted fn, never baked
+                key = default_generator.next_key()
+                if s == S - 1:
+                    out, vjp = jax.vjp(r.fwd, pv, h, key, micro_labels[i])
+                    losses.append(out)
+                else:
+                    out, vjp = jax.vjp(r.fwd, pv, h, key)
+                    acts[(s, i)] = out
+                vjps[(s, i)] = vjp
+                self.peak_live_residuals = max(self.peak_live_residuals,
+                                               len(vjps))
+                return
+            if kind in (BWD, BWD_D):
+                cot = (jnp.ones_like(losses[0]) / m) if s == S - 1 \
+                    else gin[(s + 1, i)]
+                if r.device is not None and not _is_sharded(cot):
+                    cot = jax.device_put(cot, r.device)
+                got = vjps.pop((s, i))(cot)
+                dparams, dh = got[0], got[1]
+                if s > 0:
+                    gin[(s, i)] = dh
+                if kind == BWD:
+                    _accumulate(grad_acc, s, dparams)
+                else:
+                    # ZBH1: the weight-grad ACCUMULATION is the deferred
+                    # Bw event (kernels are dispatched async with Bd; a
+                    # kernel-level split would need jax.linearize and a
+                    # second residual store — same total FLOPs either
+                    # way, and this keeps cost identical to 1F1B)
+                    wgrad_pending[(s, i)] = dparams
+                    dgrad_done[(s, i)] = True
+                return
+            # BWD_W: fold the stashed weight grads into the accumulator
+            _accumulate(grad_acc, s, wgrad_pending.pop((s, i)))
+
+        remaining = sum(len(q) for q in queues)
+        while remaining:
+            progressed = False
+            for s in range(S):
+                if qpos[s] >= len(queues[s]):
+                    continue
+                kind, i = queues[s][qpos[s]]
+                if deps_ready(s, kind, i):
+                    run_event(s, kind, i)
+                    qpos[s] += 1
+                    remaining -= 1
+                    progressed = True
+            if not progressed:
+                stuck = [(s, queues[s][qpos[s]]) for s in range(S)
+                         if qpos[s] < len(queues[s])]
+                raise RuntimeError(
+                    f"pipeline schedule deadlock (mode={self.mode}): "
+                    f"waiting on {stuck[:4]}")
+
+        # write accumulated grads into the parameters
+        for s in range(S):
+            if grad_acc[s] is None:
+                continue
+            for p, g in zip(self.runners[s].params, grad_acc[s]):
+                if p.stop_gradient:
+                    continue
+                if p._grad is None:
+                    p._grad = Tensor(g)
+                else:
+                    p._grad = Tensor(p._grad._data + g)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return Tensor(total / m)
+
+
+def _accumulate(grad_acc, s, dparams):
+    if grad_acc[s] is None:
+        grad_acc[s] = list(dparams)
+    else:
+        grad_acc[s] = [a + g for a, g in zip(grad_acc[s], dparams)]
+
+
+def _virtual_bounds(pl, n_virtual):
+    """Virtual stage k is the k-th CONTIGUOUS slice of the layer list —
+    the interleaving lives in the device mapping (virtual stage k runs on
+    device k % P, so device s hosts model chunks {s, s+P, ...} exactly as
+    Megatron VPP assigns them)."""
+    if n_virtual == pl.get_num_stages():
+        return [pl.stage_bounds(s) for s in range(n_virtual)]
+    n = len(pl.run_function)
+    base, rem = divmod(n, n_virtual)
+    bounds, start = [], 0
+    for k in range(n_virtual):
+        size = base + (1 if k < rem else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
