@@ -64,7 +64,11 @@ class PipelineParallel(MetaParallelBase):
         n = self.accumulate_steps
         micro_inputs = self._split_micro(inputs, n)
         micro_labels = self._split_micro(labels, n)
-        if scaler is None:
+        # the host schedule drivers take one activation tensor between
+        # stages; multi-input models (tuple/list micro elements) keep the
+        # tape-driven grad-accum loop
+        single_in = not isinstance(inputs, (tuple, list))
+        if scaler is None and single_in:
             sched = self._scheduler()
             x_arrays = [x._data if isinstance(x, Tensor) else x
                         for x in micro_inputs]
@@ -76,8 +80,10 @@ class PipelineParallel(MetaParallelBase):
         for x, y in zip(micro_inputs, micro_labels):
             out = self._layers(x)
             loss = self._layers._loss_fn(out, y)
-            scaled = scaler.scale(loss / n)
-            scaled.backward()
+            scaled_loss = loss / n
+            if scaler is not None:
+                scaled_loss = scaler.scale(scaled_loss)
+            scaled_loss.backward()
             total = loss.detach() if total is None else total + loss.detach()
         self.total_loss = total / n if total is not None else None
         return self.total_loss

@@ -280,7 +280,9 @@ class HostPipelineSchedule:
             self.event_log.append((s, kind, i))
             r = self.runners[s]
             if kind == FWD:
-                h = micro_inputs[i] if s == 0 else acts[(s - 1, i)]
+                # pop: the boundary activation has exactly one consumer —
+                # holding it would defeat the 1F1B residency bound
+                h = micro_inputs[i] if s == 0 else acts.pop((s - 1, i))
                 if r.device is not None and not _is_sharded(h):
                     h = jax.device_put(h, r.device)
                 pv = r.param_values()
@@ -299,7 +301,7 @@ class HostPipelineSchedule:
                 return
             if kind in (BWD, BWD_D):
                 cot = (jnp.ones_like(losses[0]) / m) if s == S - 1 \
-                    else gin[(s + 1, i)]
+                    else gin.pop((s + 1, i))
                 if r.device is not None and not _is_sharded(cot):
                     cot = jax.device_put(cot, r.device)
                 got = vjps.pop((s, i))(cot)
