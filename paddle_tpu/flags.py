@@ -108,6 +108,13 @@ define_flag("use_pallas_rms_norm", True,
             "route fused_rms_norm through the Pallas kernel on TPU")
 define_flag("pallas_interpret", False,
             "run Pallas kernels in interpreter mode (CPU tests)")
+define_flag("pallas_autotune", False,
+            "time flash-attention block-size candidates on first use per "
+            "(seq, head_dim, dtype) instead of the static heuristic")
+define_flag("use_pallas_adamw", True,
+            "route the AdamW update through the fused Pallas kernel on TPU")
+define_flag("use_pallas_rope", True,
+            "route rotary embedding through the fused Pallas kernel on TPU")
 def _apply_transfer_guard(val: str):
     """Race-detection aid (SURVEY.md §5): surface implicit host<->device
     transfers — the TPU analogue of the reference's stream-safety

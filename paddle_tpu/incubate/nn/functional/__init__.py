@@ -49,10 +49,63 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
                          bias=norm_bias, epsilon=epsilon), None
 
 
+# rope pallas gate: memo of table-layout checks, keyed on the table's
+# array identity (rope caches are built once per layer)
+_pair_repeat_memo = {}
+
+
+def _pair_repeating(sin_t, neox: bool) -> bool:
+    """True iff each frequency repeats across its rotated pair
+    (sin[2i]==sin[2i+1] interleaved; sin[j]==sin[j+d/2] neox) — the
+    invariant the Pallas rope VJP relies on."""
+    import numpy as _np
+    arr = sin_t._data if isinstance(sin_t, Tensor) else sin_t
+    if isinstance(arr, jax.core.Tracer):
+        return False            # can't verify under trace — jnp fallback
+    key = (id(arr), neox)
+    hit = _pair_repeat_memo.get(key)
+    if hit is not None:
+        return hit
+    a = _np.asarray(arr)
+    d = a.shape[-1]
+    ok = bool(_np.array_equal(a[..., : d // 2], a[..., d // 2:]) if neox
+              else _np.array_equal(a[..., 0::2], a[..., 1::2]))
+    if len(_pair_repeat_memo) > 256:
+        _pair_repeat_memo.clear()
+    _pair_repeat_memo[key] = ok
+    return ok
+
+
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True):
     """ref: fused_rope — rotate q/k by (sin, cos)."""
+    from ....ops.pallas import rope as _prope
+
     def rope(t, sin_a, cos_a):
+        d = t.shape[-1]
+        # Pallas hot path: one kernel per tensor (ref: phi fusion
+        # fused_rope); needs plain [S, D] tables, an even head_dim, AND
+        # the pair-repeating table layout — the kernel's VJP (same
+        # rotation with -sin) is the true transpose only when sin
+        # commutes with the pair permutation
+        if (_prope.available() and _prope.supports(d)
+                and len(sin_a.shape) == 2 and position_ids is None
+                and _pair_repeating(sin_a, use_neox_rotary_style)):
+            from ....flags import get_flag
+
+            def fp(x, s, c):
+                b, sl, h, hd = x.shape
+                xt = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, sl, hd)
+                out = _prope.rope_bhsd(
+                    xt, c.astype(jnp.float32), s.astype(jnp.float32),
+                    use_neox_rotary_style,
+                    interpret=bool(get_flag("pallas_interpret")))
+                return jnp.transpose(out.reshape(b, h, sl, hd),
+                                     (0, 2, 1, 3))
+
+            return call_op(fp, (t, sin_a, cos_a), {},
+                           op_name="fused_rope")
+
         def f(x, s, c):
             # x: [B, S, H, D]
             if use_neox_rotary_style:

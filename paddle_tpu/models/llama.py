@@ -28,7 +28,6 @@ import numpy as np
 
 from .. import nn
 from ..core.tensor import Tensor
-from ..core.dispatch import call_op
 from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..framework.param_attr import ParamAttr
@@ -151,12 +150,8 @@ class LlamaAttention(nn.Layer):
         sin = Tensor(self._sin[:S])
         q, k, _ = fused_rotary_position_embedding(
             q, k, sin=sin, cos=cos, use_neox_rotary_style=False)
-        rep = self.num_heads // self.num_kv
-        if rep > 1:   # GQA: broadcast kv heads (XLA fuses into the dot)
-            k = call_op(lambda a: jnp.repeat(a, rep, axis=2), (k,),
-                        op_name="gqa_repeat")
-            v = call_op(lambda a: jnp.repeat(a, rep, axis=2), (v,),
-                        op_name="gqa_repeat")
+        # GQA kv heads stay un-broadcast: sdpa repeats only for paths
+        # that need it (the Pallas kernel broadcasts in its index maps)
         q = sharding_constraint(q, None, None, "mp", None)
         k = sharding_constraint(k, None, None, "mp", None)
         v = sharding_constraint(v, None, None, "mp", None)

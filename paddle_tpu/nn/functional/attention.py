@@ -41,20 +41,37 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     attention layout)."""
     query, key, value = (ensure_tensor(query), ensure_tensor(key),
                          ensure_tensor(value))
+    hq, hkv = query.shape[2], key.shape[2]
+
+    def _expand_kv():
+        # GQA kv-head broadcast for paths that need equal head counts
+        nonlocal key, value, hkv
+        if hkv != hq:
+            rep = hq // hkv
+            key = call_op(lambda a: jnp.repeat(a, rep, axis=2), (key,),
+                          op_name="gqa_repeat")
+            value = call_op(lambda a: jnp.repeat(a, rep, axis=2), (value,),
+                            op_name="gqa_repeat")
+            hkv = hq
+
     # sequence/context parallelism: when the fleet topology carries a
     # sep (Ulysses) or cp (ring) axis, attention itself is the op that
     # must run sequence-sharded — route it before the local hot paths
-    out = _segment_parallel().segment_parallel_attention(
-        query, key, value, attn_mask, dropout_p, is_causal, training)
-    if out is not None:
-        return out
-    args = [query, key, value]
+    sp = _segment_parallel()
+    if sp.active_seq_parallel_axis() is not None:
+        _expand_kv()
+        out = sp.segment_parallel_attention(query, key, value, attn_mask,
+                                            dropout_p, is_causal, training)
+        if out is not None:
+            return out
     has_mask = attn_mask is not None
-    # hot path: Pallas flash kernel (no mask, no dropout, aligned shapes)
+    # hot path: Pallas flash kernel (no mask, no dropout, aligned
+    # shapes; GQA kv heads broadcast in-kernel, decode sq<sk supported)
     if not has_mask and (dropout_p == 0.0 or not training):
         from ...ops.pallas import flash_attention as _pfa
         if _pfa.available() and _pfa.supports(
-                query.shape[1], key.shape[1], query.shape[-1], is_causal):
+                query.shape[1], key.shape[1], query.shape[-1], is_causal,
+                hq, hkv):
             try:
                 return _pfa.pallas_flash_attention(query, key, value,
                                                    causal=is_causal)
@@ -67,6 +84,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 warnings.warn(
                     f"pallas flash attention failed ({type(e).__name__}: "
                     f"{e}); falling back to the XLA path", RuntimeWarning)
+    _expand_kv()
+    args = [query, key, value]
     if has_mask:
         args.append(ensure_tensor(attn_mask))
     drop_key = next_key() if (dropout_p > 0.0 and training) else None

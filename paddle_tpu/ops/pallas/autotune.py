@@ -1,0 +1,93 @@
+"""Block-size selection for the Pallas flash kernels.
+
+ref role: CINN's auto_schedule / the reference's per-arch flashattn
+tile-config tables (paddle/cinn/auto_schedule/, third_party/flashattn).
+TPU-native: one table, two modes —
+
+- **heuristic** (default): MXU-aligned (128, 128) blocks, shrunk to the
+  sequence when shorter; long sequences widen the key block so the
+  fori_loop body amortises better against HBM streaming.
+- **measured** (``FLAGS_pallas_autotune=1``): on first use per
+  (sq, sk, head_dim, dtype, causal) each VALID candidate is compiled and
+  timed on the real array shapes (median of 3 after warmup) and the
+  winner is cached for the process lifetime.  Only reachable on TPU —
+  interpret mode always uses the heuristic (timing the interpreter is
+  meaningless).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+from ...flags import get_flag
+from ..flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+
+# (block_q, block_k) candidates, MXU-tile multiples
+_CANDIDATES = [(128, 128), (128, 256), (256, 128), (256, 256),
+               (128, 512), (512, 128), (64, 128), (128, 64)]
+
+_cache: Dict[Tuple, Tuple[int, int]] = {}
+
+
+def _valid(bq: int, bk: int, sq: int, sk: int) -> bool:
+    bq = min(bq, sq)
+    bk = min(bk, sk)
+    return sq % bq == 0 and sk % bk == 0
+
+
+def _heuristic(sq: int, sk: int, d: int) -> Tuple[int, int]:
+    bq = min(DEFAULT_BLOCK_Q, sq)
+    bk = min(DEFAULT_BLOCK_K, sk)
+    # long-context: widen the key block (fewer loop iterations, better
+    # HBM streaming) as long as VMEM stays comfortable (d <= 128)
+    if sk >= 2048 and d <= 128 and _valid(bq, 2 * DEFAULT_BLOCK_K, sq, sk):
+        bk = 2 * DEFAULT_BLOCK_K
+    return bq, bk
+
+
+def flash_blocks(sq: int, sk: int, d: int, dtype, causal: bool,
+                 interpret: bool, bh_hint: int = 8) -> Tuple[int, int]:
+    """Pick (block_q, block_k) for a flash call."""
+    measured = not interpret and get_flag("pallas_autotune")
+    # the mode is part of the key: a heuristic result cached while the
+    # flag was off must not suppress measurement after it's turned on
+    key = (sq, sk, d, str(dtype), bool(causal), measured)
+    hit = _cache.get(key)
+    if hit is not None:
+        return hit
+    blocks = (_measure(sq, sk, d, dtype, causal, bh_hint) if measured
+              else _heuristic(sq, sk, d))
+    _cache[key] = blocks
+    return blocks
+
+
+def _measure(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
+    import jax
+    import jax.numpy as jnp
+    from ..flash_attention import _flash_fwd
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (bh, sq, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(ks[1], (bh, sk, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(ks[2], (bh, sk, d), jnp.float32).astype(dtype)
+    scale = 1.0 / (d ** 0.5)
+
+    best, best_t = _heuristic(sq, sk, d), float("inf")
+    for bq, bk in _CANDIDATES:
+        if not _valid(bq, bk, sq, sk):
+            continue
+        try:
+            f = jax.jit(lambda q, k, v, _bq=bq, _bk=bk: _flash_fwd(
+                q, k, v, scale, causal, _bq, _bk, False)[0])
+            f(q, k, v)[0].block_until_ready()       # compile + warmup
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                f(q, k, v)[0].block_until_ready()
+                ts.append(time.perf_counter() - t0)
+            t = sorted(ts)[1]
+        except Exception:   # a candidate that fails to lower is skipped
+            continue
+        if t < best_t:
+            best, best_t = (bq, bk), t
+    return best

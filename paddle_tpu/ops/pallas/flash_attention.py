@@ -5,7 +5,11 @@ the jnp-level function carries a jax.custom_vjp, so ``call_op``'s
 ``jax.vjp`` automatically uses the hand-written flash backward.
 
 Layout: paddle flash layout [B, S, H, D] (ref: python/paddle/nn/
-functional/flash_attention.py).
+functional/flash_attention.py).  Supports GQA (kv heads < q heads —
+broadcast inside the kernel index maps, never materialised) and decode
+shapes (causal with sq < sk via bottom-right mask alignment).  Block
+sizes come from ops.pallas.autotune (heuristic, or measured under
+``FLAGS_pallas_autotune``).
 """
 from __future__ import annotations
 
@@ -18,6 +22,7 @@ from ...core.dispatch import call_op
 from ...flags import get_flag
 from ..flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
                                flash_attention_bhsd)
+from .autotune import flash_blocks
 
 
 def available() -> bool:
@@ -28,35 +33,43 @@ def available() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def supports(sq: int, sk: int, d: int, causal: bool) -> bool:
+def supports(sq: int, sk: int, d: int, causal: bool,
+             hq: int = 1, hkv: int = 1) -> bool:
     """Shape gate: the kernel's pl.ds loads clamp out-of-range blocks, so
-    non-multiple-of-block sequences would silently double-count keys; the
-    causal mask uses the top-left convention, valid only when sq == sk."""
+    non-multiple-of-block sequences would silently double-count keys.
+    Causal uses bottom-right alignment, so decode (sq < sk) is fine; only
+    sq > sk has no meaningful causal convention.  GQA needs hq a multiple
+    of hkv."""
     bq = min(DEFAULT_BLOCK_Q, sq)
     bk = min(DEFAULT_BLOCK_K, sk)
     if sq % bq or sk % bk:
         return False
-    if causal and sq != sk:
+    if causal and sq > sk:
+        return False
+    if hq % hkv:
         return False
     return d % 8 == 0
 
 
 def pallas_flash_attention(query, key, value, causal: bool = False,
                            scale=None):
-    """query/key/value: Tensors [B, S, H, D] → Tensor [B, S, H, D]."""
+    """query: [B, SQ, HQ, D]; key/value: [B, SK, HKV, D] (HKV may divide
+    HQ — GQA) → Tensor [B, SQ, HQ, D]."""
     interpret = bool(get_flag("pallas_interpret"))
 
     def f(q, k, v):
-        b, sq, h, d = q.shape
-        sk = k.shape[1]
+        b, sq, hq, d = q.shape
+        _, sk, hkv, _ = k.shape
+        n_rep = hq // hkv
         sc = scale if scale is not None else 1.0 / math.sqrt(d)
-        qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-        kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-        vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+        q_off = (sk - sq) if causal else 0
+        bq, bk = flash_blocks(sq, sk, d, q.dtype, causal, interpret)
+        qt = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, d)
+        kt = jnp.swapaxes(k, 1, 2).reshape(b * hkv, sk, d)
+        vt = jnp.swapaxes(v, 1, 2).reshape(b * hkv, sk, d)
         # custom_vjp requires positional args (nondiff_argnums)
-        out = flash_attention_bhsd(qt, kt, vt, sc, causal,
-                                   DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-                                   interpret)
-        return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+        out = flash_attention_bhsd(qt, kt, vt, sc, causal, bq, bk,
+                                   interpret, q_off, n_rep)
+        return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
 
     return call_op(f, (query, key, value), {}, op_name="flash_attention")

@@ -65,6 +65,19 @@ class Adam(Optimizer):
         b2p = self._get_accumulator("beta2_pow", p, idx, fill=1.0, shape=())
         b1p = b1p * b1
         b2p = b2p * b2
+        if not self._amsgrad:
+            # fused hot path (ref: phi fusion fused_adamw): one Pallas
+            # kernel computes m/v/update; identical numerics to the
+            # unfused sequence below
+            from ..ops.pallas import fused_adamw as _fadamw
+            if _fadamw.available():
+                new_p, m, v = _fadamw.fused_adamw_update(
+                    pv, gv, m, v, lr, b1p, b2p, b1, b2, eps, wd=0.0)
+                self._set_accumulator("moment1", p, idx, m)
+                self._set_accumulator("moment2", p, idx, v)
+                self._set_accumulator("beta1_pow", p, idx, b1p)
+                self._set_accumulator("beta2_pow", p, idx, b2p)
+                return new_p
         m = b1 * m + (1 - b1) * gv
         v = b2 * v + (1 - b2) * jnp.square(gv)
         self._set_accumulator("moment1", p, idx, m)
