@@ -29,7 +29,7 @@ def get_backend() -> str:
 def __getattr__(name):
     import importlib
     if name in ("fleet", "auto_parallel", "checkpoint", "launch", "utils",
-                "sharding", "rpc"):
+                "sharding", "rpc", "passes"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

@@ -118,7 +118,31 @@ class Fleet:
         """ref: fleet.py distributed_optimizer."""
         if strategy is not None:
             self._strategy = strategy
-        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+        from ..passes.gradient_merge import GradientMergeOptimizer
+        s = self._strategy
+        k, avg = 1, True
+        if isinstance(optimizer, GradientMergeOptimizer):
+            # already merge-wrapped (e.g. by the gradient_merge pass):
+            # unwrap so the hybrid optimizer sits INSIDE the merge window
+            # (clip/step must see the averaged boundary grads, and a
+            # second wrap would square the window)
+            k, avg = optimizer.k_steps, optimizer.avg
+            optimizer = optimizer._inner
+        if s is not None and getattr(s, "gradient_merge", False):
+            k = max(k, int(s.gradient_merge_configs.get("k_steps", 1)))
+            avg = bool(s.gradient_merge_configs.get("avg", avg))
+        opt = HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+        if k > 1:
+            if s is not None and getattr(s, "amp", False):
+                raise ValueError(
+                    "strategy.gradient_merge with strategy.amp is not "
+                    "supported: GradScaler unscales the ACCUMULATED grad "
+                    "buffer on every micro-step, corrupting the merge "
+                    "window. Run grad accumulation via "
+                    "PipelineParallel/accumulate_steps or scale losses "
+                    "manually under amp")
+            return GradientMergeOptimizer(opt, k_steps=k, avg=avg)
+        return opt
 
     # static-graph parity stubs (the jit engine subsumes program rewrite)
     def minimize(self, loss, startup_program=None, parameter_list=None,

@@ -1,0 +1,28 @@
+"""paddle.distributed.passes — the pass-management façade.
+
+ref: python/paddle/distributed/passes/ (~30k LoC of ProgramDesc
+rewriters: pass_base.py + auto_parallel amp/recompute/sharding/
+gradient_merge/pipeline_scheduler passes).
+
+TPU-native design: the reference's passes rewrite a static program
+because its strategies ARE program rewrites; here strategies lower to
+sharding specs and step-function transforms (SURVEY.md §2.3 "static
+meta-optimizers: subsumed"), so a pass maps onto the corresponding
+strategy knob or wraps the optimizer/step.  The pass-management API
+(new_pass / PassManager / PassContext, same registration names) is kept
+so reference code drives the same surface; ``gradient_merge`` is a REAL
+transform (k-step gradient accumulation via GradientMergeOptimizer),
+the pipeline_scheduler passes select the host schedule drivers
+(pp_schedules.py), and pure-fusion passes are honored by construction
+(XLA fuses; recorded as no-ops).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .pass_base import (PassBase, PassContext, PassManager, new_pass,
+                        register_pass, PASS_REGISTRY)
+from .gradient_merge import GradientMergeOptimizer
+
+__all__ = ["PassBase", "PassContext", "PassManager", "new_pass",
+           "register_pass", "GradientMergeOptimizer"]

@@ -1,0 +1,95 @@
+"""Gradient merge — k-step gradient accumulation.
+
+ref: python/paddle/distributed/passes/auto_parallel_gradient_merge.py
+(and the static meta-optimizer gradient_merge): every k-th step the
+accumulated gradients are applied, in between they are summed and the
+optimizer update is skipped.
+
+TPU-native: an optimizer WRAPPER rather than a program rewrite — the
+tape already leaves summed gradients in ``param.grad`` across calls when
+``clear_grad`` is withheld, so the wrapper only needs to count steps,
+scale by 1/k on the boundary (``avg=True``), and swallow the
+off-boundary ``step()/clear_grad()`` calls.  Works for the eager loop
+and the fleet HybridParallelOptimizer alike (it wraps whatever
+``.step()`` it is given).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class GradientMergeOptimizer:
+    """Apply the inner optimizer every ``k_steps`` calls.
+
+    The training loop stays the canonical::
+
+        loss.backward(); opt.step(); opt.clear_grad()
+
+    Off-boundary calls leave the accumulated ``param.grad`` in place
+    (step and clear_grad are no-ops); on the k-th call the grads are
+    averaged (``avg=True``) and the inner step + clear run.
+    """
+
+    def __init__(self, inner_opt, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = inner_opt
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+        self._step_count = 0
+
+    # -- the wrapped triad ----------------------------------------------
+    def step(self):
+        self._step_count += 1
+        if self._step_count % self.k_steps:
+            return            # accumulation step: no update
+        if self.avg and self.k_steps > 1:
+            inv = 1.0 / self.k_steps
+            for p in self._parameters():
+                if p._grad is not None:
+                    p._grad._data = p._grad._data * inv
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero: bool = True):
+        if self._step_count % self.k_steps:
+            return            # keep accumulating
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- passthrough ----------------------------------------------------
+    def _parameters(self):
+        inner = getattr(self._inner, "_inner_opt", self._inner)
+        params = []
+        for g in getattr(inner, "_param_groups", []):
+            params.extend(g.get("params", []))
+        if not params:
+            params = list(getattr(inner, "_parameter_list", []) or [])
+        return params
+
+    @property
+    def _inner_opt(self):
+        return getattr(self._inner, "_inner_opt", self._inner)
+
+    def state_dict(self):
+        sd = self._inner.state_dict()
+        sd["gradient_merge_step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = int(sd.pop("gradient_merge_step", 0))
+        self._inner.set_state_dict(sd)
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def set_lr(self, lr):
+        return self._inner.set_lr(lr)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -1,0 +1,160 @@
+"""paddle.distributed.passes façade + the real gradient_merge transform
+(ref: python/paddle/distributed/passes/ — pass_base + gradient_merge;
+test pattern per test/distributed_passes/dist_pass_test_base.py: apply
+the pass, run with and without, compare)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet, passes
+from paddle_tpu.distributed.communication.group import _reset_groups
+from paddle_tpu.distributed.fleet.base.topology import _clear_hcg
+from paddle_tpu.distributed.mesh import reset_mesh
+from paddle_tpu.distributed.passes import (GradientMergeOptimizer,
+                                           PassContext, PassManager,
+                                           new_pass)
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    reset_mesh(); _reset_groups(); _clear_hcg()
+    yield
+    reset_mesh(); _reset_groups(); _clear_hcg()
+
+
+def test_pass_registry_names():
+    for name in ("auto_parallel_amp", "auto_parallel_fp16",
+                 "auto_parallel_recompute", "auto_parallel_sharding",
+                 "auto_parallel_gradient_merge_pass",
+                 "pipeline_scheduler_FThenB", "pipeline_scheduler_1F1B",
+                 "pipeline_scheduler_VPP", "pipeline_scheduler_ZBH1",
+                 "fuse_all_reduce", "fused_attention"):
+        p = new_pass(name)
+        assert p.name == name
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("no_such_pass")
+
+
+def test_passes_map_onto_strategy_knobs():
+    s = fleet.DistributedStrategy()
+    ctx = PassContext(strategy=s)
+    pm = PassManager([
+        new_pass("auto_parallel_amp", {"init_loss_scaling": 1024.0}),
+        new_pass("auto_parallel_recompute"),
+        new_pass("auto_parallel_sharding", {"stage": 2, "degree": 4}),
+        new_pass("pipeline_scheduler_1F1B"),
+        new_pass("fuse_all_reduce"),
+    ])
+    pm.apply([None], [None], ctx)
+    assert s.amp and s.amp_configs["init_loss_scaling"] == 1024.0
+    assert s.recompute
+    assert s.sharding and s.sharding_configs["stage"] == 2
+    assert s.sharding_configs["sharding_degree"] == 4
+    assert s.pipeline_configs["schedule_mode"] == "1F1B"
+    assert ctx.attrs["fuse_all_reduce"]
+    assert [p.name for p in ctx.passes] == pm.names
+
+
+def test_gradient_merge_pass_wraps_optimizer():
+    m = nn.Linear(4, 4)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    ctx = PassContext(strategy=fleet.DistributedStrategy(), optimizer=o)
+    new_pass("auto_parallel_gradient_merge_pass",
+             {"k_steps": 4, "avg": True}).apply([None], [None], ctx)
+    assert isinstance(ctx.optimizer, GradientMergeOptimizer)
+    assert ctx.optimizer.k_steps == 4
+    assert ctx.strategy.gradient_merge
+    assert ctx.strategy.gradient_merge_configs["k_steps"] == 4
+
+
+def test_gradient_merge_parity_vs_big_batch():
+    """k merged half-batches == one step on the full batch (avg=True) —
+    the dist_pass_test_base with/without oracle."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 4).astype(np.float32)
+    y = rs.randn(8, 4).astype(np.float32)
+
+    def loss_of(m, xs, ys):
+        return ((m(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).mean()
+
+    # oracle: one step on the full batch
+    paddle.seed(1)
+    m1 = nn.Linear(4, 4)
+    o1 = opt.SGD(learning_rate=0.1, parameters=m1.parameters())
+    loss_of(m1, x, y).backward()
+    o1.step(); o1.clear_grad()
+
+    # gradient merge: two half-batches, k_steps=2
+    paddle.seed(1)
+    m2 = nn.Linear(4, 4)
+    o2 = GradientMergeOptimizer(
+        opt.SGD(learning_rate=0.1, parameters=m2.parameters()),
+        k_steps=2, avg=True)
+    for half in (slice(0, 4), slice(4, 8)):
+        loss_of(m2, x[half], y[half]).backward()
+        o2.step()
+        o2.clear_grad()
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(m1.bias.numpy(), m2.bias.numpy(), rtol=1e-6)
+    # off-boundary step must NOT have applied an update mid-window
+    assert o2._step_count == 2
+
+
+def test_gradient_merge_via_fleet_strategy():
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    s.gradient_merge = True
+    s.gradient_merge_configs["k_steps"] = 2
+    fleet.init(is_collective=True, strategy=s)
+    m = nn.Linear(4, 4)
+    o = fleet.fleet.distributed_optimizer(
+        opt.SGD(learning_rate=0.1, parameters=m.parameters()))
+    assert isinstance(o, GradientMergeOptimizer)
+    w0 = m.weight.numpy().copy()
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    (m(x) ** 2).mean().backward()
+    o.step(); o.clear_grad()               # accumulation: no update
+    np.testing.assert_array_equal(m.weight.numpy(), w0)
+    (m(x) ** 2).mean().backward()
+    o.step(); o.clear_grad()               # boundary: update applies
+    assert not np.array_equal(m.weight.numpy(), w0)
+
+
+def test_gradient_merge_state_roundtrip():
+    m = nn.Linear(2, 2)
+    o = GradientMergeOptimizer(
+        opt.SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=3)
+    (m(paddle.to_tensor(np.ones((2, 2), np.float32))) ** 2).mean().backward()
+    o.step()
+    sd = o.state_dict()
+    assert sd["gradient_merge_step"] == 1
+    o2 = GradientMergeOptimizer(
+        opt.SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=3)
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+
+
+def test_no_double_wrap_and_amp_refusal():
+    """fleet.distributed_optimizer must not stack merge windows, and the
+    amp+gradient_merge combination (scaler unscales the accumulated
+    buffer per micro-step) is refused loudly."""
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    s.gradient_merge = True
+    s.gradient_merge_configs["k_steps"] = 4
+    fleet.init(is_collective=True, strategy=s)
+    m = nn.Linear(4, 4)
+    pre_wrapped = GradientMergeOptimizer(
+        opt.SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=4)
+    o = fleet.fleet.distributed_optimizer(pre_wrapped)
+    assert isinstance(o, GradientMergeOptimizer)
+    assert o.k_steps == 4                       # not 16
+    assert not isinstance(o._inner_opt, GradientMergeOptimizer)
+
+    s.amp = True
+    with pytest.raises(ValueError, match="gradient_merge with strategy.amp"):
+        fleet.fleet.distributed_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()))
