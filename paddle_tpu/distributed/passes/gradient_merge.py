@@ -58,9 +58,11 @@ class GradientMergeOptimizer:
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         loss.backward()
+        params_grads = [(p, p.grad) for p in self._parameters()
+                        if p.grad is not None]
         self.step()
         self.clear_grad()
-        return None, None
+        return None, params_grads
 
     # -- passthrough ----------------------------------------------------
     def _parameters(self):
@@ -92,4 +94,10 @@ class GradientMergeOptimizer:
         return self._inner.set_lr(lr)
 
     def __getattr__(self, name):
-        return getattr(self._inner, name)
+        # __dict__ access avoids unbounded recursion when the instance is
+        # mid-construction (deepcopy/pickle create it via __new__ with an
+        # empty __dict__ and immediately probe dunders)
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)

@@ -257,18 +257,121 @@ class QAT:
                 self._bake(child)
 
 
+class StaticScaleQuanter(nn.Layer):
+    """Fake-quant with a FROZEN scale (the post-calibration activation
+    quanter PTQ.convert installs)."""
+
+    def __init__(self, scale: float, quant_bits: int = 8):
+        super().__init__()
+        self._scale = float(scale)
+        self.quant_bits = quant_bits
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def forward(self, x):
+        if self._scale <= 0.0:
+            return x
+        return call_op(
+            lambda v: _fake_quant(v, self._scale, self.quant_bits),
+            [ensure_tensor(x)], op_name="fake_quantize_static_scale")
+
+    def quantize_array(self, x: Tensor) -> Tensor:
+        return call_op(
+            lambda v: _fake_quant(v, self._scale, self.quant_bits),
+            [ensure_tensor(x)], op_name="quantize_static_scale")
+
+
+class _ObservedLayer(nn.Layer):
+    """PTQ calibration wrapper: PASSTHROUGH compute + activation
+    observation (the reference's PTQ observes during calibration and only
+    quantizes at convert — unlike QAT's in-training fake-quant)."""
+
+    def __init__(self, inner, act_observer, act_bits, w_bits):
+        super().__init__()
+        self.inner = inner
+        self.act_observer = act_observer   # None = activation quant off
+        self.act_bits = act_bits
+        self.w_bits = w_bits               # None = weight quant off
+
+    def forward(self, x):
+        if self.act_observer is not None:
+            self.act_observer.observe(x)
+        return self.inner(x)
+
+
 class PTQ:
-    """ref: ptq.py PTQ — observer pass then convert."""
+    """ref: ptq.py PTQ — observer-only calibration pass, then convert
+    freezes the collected scales into fake-quant layers.
+
+    Flow::
+
+        model = PTQ(q_config).quantize(model)   # wrap with observers
+        for batch in calib_loader: model(batch) # calibration (no quant)
+        model = ptq.convert(model)              # frozen-scale fake-quant
+    """
 
     def __init__(self, config: QuantConfig):
         self.config = config
 
     def quantize(self, model: nn.Layer, inplace=False):
         m = model if inplace else copy.deepcopy(model)
-        return _apply(m, self.config)
+        return self._observe(m)
 
-    convert = QAT.convert
-    _bake = QAT._bake
+    def _observe(self, model):
+        for name, child in list(model._sub_layers.items()):
+            if child is None:
+                continue
+            cfg = self.config._config_for(child)
+            if cfg and isinstance(child, (nn.Linear, nn.Conv2D)):
+                # honor the config: each of activation/weight is observed
+                # (and later quantized) ONLY if its quanter is configured,
+                # at that quanter's bit width
+                aq = _make_quanter(cfg["activation"])
+                wq = _make_quanter(cfg["weight"])
+                act_bits = getattr(aq, "quant_bits", 8) if aq is not None \
+                    else None
+                w_bits = getattr(wq, "quant_bits", 8) if wq is not None \
+                    else None
+                obs = (AbsmaxObserver(quant_bits=act_bits)
+                       if act_bits is not None else None)
+                model._sub_layers[name] = _ObservedLayer(child, obs,
+                                                         act_bits, w_bits)
+            elif isinstance(child, nn.Layer):
+                self._observe(child)
+        return model
+
+    def convert(self, model: nn.Layer, inplace=False):
+        m = model if inplace else copy.deepcopy(model)
+        self._freeze(m)
+        return m
+
+    def _freeze(self, model):
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, _ObservedLayer):
+                inner = child.inner
+                if child.w_bits is not None:
+                    # weight scale from the trained weight itself
+                    w_obs = AbsmaxObserver(quant_bits=child.w_bits)
+                    w_obs.observe(inner.weight)
+                    inner.weight.set_value(
+                        w_obs.quantize_array(inner.weight))
+                # a layer never exercised during calibration has no
+                # activation scale — leave its activations unquantized
+                # rather than aborting the whole conversion
+                act_scale = (child.act_observer._scale
+                             if child.act_observer is not None else None)
+                act_q = (StaticScaleQuanter(act_scale, child.act_bits)
+                         if act_scale else None)
+                if isinstance(inner, nn.Linear):
+                    model._sub_layers[name] = QuantedLinear(inner, act_q,
+                                                            None)
+                else:
+                    model._sub_layers[name] = QuantedConv2D(inner, act_q,
+                                                            None)
+            elif isinstance(child, nn.Layer):
+                self._freeze(child)
 
 
 class quanters:

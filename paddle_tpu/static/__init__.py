@@ -503,3 +503,13 @@ class _StaticAmp:
 
 amp = _StaticAmp()
 __all__.append("amp")
+
+
+def __getattr__(name):
+    # lazy: paddle.static.quantization (PTQ over captured Programs)
+    if name == "quantization":
+        import importlib
+        mod = importlib.import_module(".quantization", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module '{__name__}' has no attribute '{name}'")

@@ -1,0 +1,134 @@
+"""paddle.static.quantization — post-training quantization over a
+captured Program.
+
+ref: python/paddle/static/quantization/ (PostTrainingQuantization +
+quant_post_static: run calibration batches through the inference
+program collecting per-op activation ranges, then rewrite the program
+with fake_quantize/dequantize ops).
+
+TPU-native: the Program IS an op-record list (static/capture.py), so the
+"pass" is direct — calibration replays the ops EAGERLY (observers need
+concrete values) recording absmax for each quantizable op's activation
+input and parameter inputs, then a quantized clone wraps those op fns
+with symmetric fake-quant at the frozen scales.  The quantized program
+runs through the normal jitted Executor (scales are baked constants).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ...quantization import _fake_quant
+from ..capture import Program, _OpRecord
+
+__all__ = ["PostTrainingQuantization", "quant_post_static",
+           "QUANTIZABLE_OP_TYPES"]
+
+QUANTIZABLE_OP_TYPES = ("linear", "matmul", "conv2d", "mul")
+
+
+class PostTrainingQuantization:
+    """ref: post_training_quantization.py PostTrainingQuantization."""
+
+    def __init__(self, program: Program, feed_names: Sequence[str],
+                 quantizable_op_type: Sequence[str] = QUANTIZABLE_OP_TYPES,
+                 weight_bits: int = 8, activation_bits: int = 8):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.op_types = tuple(quantizable_op_type)
+        self.w_bits = int(weight_bits)
+        self.a_bits = int(activation_bits)
+        # per-target-op calibration: op position -> {"act": s, "w": {i: s}}
+        self._stats: Dict[int, Dict[str, Any]] = {}
+
+    def _targets(self) -> List[int]:
+        return [i for i, op in enumerate(self.program.ops)
+                if op.name in self.op_types]
+
+    # -- calibration -----------------------------------------------------
+    def _run_observed(self, feed: Dict[str, Any]):
+        """One eager replay of the op list, recording absmax stats."""
+        prog = self.program
+        env: Dict[int, Any] = {}
+        for name in self.feed_names:
+            t = prog.placeholders.get(name)
+            if t is None:
+                raise KeyError(
+                    f"feed name {name!r} is not a placeholder of the "
+                    f"program (has: {sorted(prog.placeholders)})")
+            if name not in feed:
+                raise KeyError(
+                    f"calibration batch is missing feed {name!r} "
+                    f"(got keys: {sorted(feed)})")
+            env[id(t)] = jnp.asarray(feed[name])
+        targets = set(self._targets())
+        for pos, op in enumerate(prog.ops):
+            ins = [env.get(id(t), t._data) for t in op.inputs]
+            if pos in targets:
+                st = self._stats.setdefault(pos, {"act": 0.0, "w": {}})
+                for i, (t, a) in enumerate(zip(op.inputs, ins)):
+                    m = float(jnp.abs(a).max())
+                    if t._is_param:
+                        st["w"][i] = max(st["w"].get(i, 0.0), m)
+                    elif i == 0:
+                        st["act"] = max(st["act"], m)
+            got = op.fn(*ins, **op.kwargs)
+            if op.multi_out:
+                for t, o in zip(op.outputs, got):
+                    env[id(t)] = o
+            else:
+                env[id(op.outputs[0])] = got
+
+    def quantize(self, calib_feeds: Sequence[Dict[str, Any]]) -> Program:
+        """Calibrate on the feed dicts, return the quantized Program."""
+        if not calib_feeds:
+            raise ValueError("PTQ needs at least one calibration batch")
+        for feed in calib_feeds:
+            self._run_observed(feed)
+        out = Program()
+        out.placeholders = dict(self.program.placeholders)
+        out.random_seed = self.program.random_seed
+        out.writebacks = list(self.program.writebacks)
+        a_bits, w_bits = self.a_bits, self.w_bits
+        for pos, op in enumerate(self.program.ops):
+            st = self._stats.get(pos)
+            if st is None:
+                out.ops.append(op)
+                continue
+            act_s = st["act"]
+            # weights are baked ONCE here (quantized constants captured
+            # in the closure) — re-fake-quanting a frozen param on every
+            # run would be pure per-step overhead
+            baked = {i: jnp.asarray(_fake_quant(
+                        op.inputs[i]._data, s, w_bits))
+                     for i, s in st["w"].items() if s > 0.0}
+
+            def qfn(*xs, __fn=op.fn, __a=act_s, __baked=baked, **kw):
+                xs = list(xs)
+                if __a > 0.0:
+                    xs[0] = _fake_quant(xs[0], __a, a_bits)
+                for i, w in __baked.items():
+                    xs[i] = w
+                return __fn(*xs, **kw)
+
+            out.ops.append(_OpRecord(qfn, op.kwargs, op.inputs,
+                                     op.outputs, op.multi_out,
+                                     f"quant_{op.name}"))
+        return out
+
+
+def quant_post_static(executor, program: Program,
+                      feed_names: Sequence[str],
+                      calib_feeds: Sequence[Dict[str, Any]],
+                      quantizable_op_type: Sequence[str]
+                      = QUANTIZABLE_OP_TYPES,
+                      weight_bits: int = 8,
+                      activation_bits: int = 8) -> Program:
+    """ref: quant_post_static — functional wrapper (the executor arg is
+    accepted for signature parity; replay is self-contained)."""
+    ptq = PostTrainingQuantization(program, feed_names,
+                                   quantizable_op_type, weight_bits,
+                                   activation_bits)
+    return ptq.quantize(calib_feeds)

@@ -1,0 +1,207 @@
+"""QAT / PTQ flows (ref: python/paddle/quantization qat.py+ptq.py and
+python/paddle/static/quantization post_training_quantization.py; test
+pattern per test/quantization/: quantize, run, assert accuracy stays
+within tolerance of fp32)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.quantization import (AbsmaxObserver, PTQ, QAT, QuantConfig,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     QuantedConv2D, QuantedLinear)
+from paddle_tpu.quantization import StaticScaleQuanter, _ObservedLayer
+
+
+def _lenet():
+    return nn.Sequential(
+        nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(400, 120), nn.ReLU(),
+        nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, 10))
+
+
+def _data(n=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randn(n, 1, 28, 28).astype(np.float32)
+
+
+def test_qat_insert_train_convert_lenet():
+    """QAT(config).quantize inserts fake-quant wrappers, training runs
+    through them (STE), convert bakes quantized weights — and the
+    quantized model stays close to fp32 (test/quantization tolerance
+    pattern)."""
+    paddle.seed(0)
+    model = _lenet()
+    x = paddle.to_tensor(_data())
+    fp32_out = model(x).numpy()
+
+    q = QAT(QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(quant_bits=8),
+        weight=FakeQuanterWithAbsMaxObserver(quant_bits=8)))
+    qmodel = q.quantize(model, inplace=False)
+    names = [type(l).__name__ for l in qmodel.sublayers()]
+    assert "QuantedLinear" in names and "QuantedConv2D" in names
+
+    # a training step flows gradients through the STE
+    o = opt.SGD(learning_rate=1e-3, parameters=qmodel.parameters())
+    loss = (qmodel(x) ** 2).mean()
+    loss.backward()
+    o.step()
+    o.clear_grad()
+
+    out_q = q.quantize(model, inplace=False)(x).numpy()
+    rel = np.abs(out_q - fp32_out).max() / (np.abs(fp32_out).max() + 1e-9)
+    # moving-absmax scales start cold (scale=1.0, converge over steps),
+    # so the fresh-wrapper bound is looser than PTQ's calibrated one
+    assert rel < 0.2, f"int8 QAT deviates {rel:.3f} from fp32"
+
+    converted = q.convert(qmodel, inplace=False)
+    names = [type(l).__name__ for l in converted.sublayers()]
+    assert "QuantedLinear" not in names   # observers stripped
+    assert np.isfinite(converted(x).numpy()).all()
+
+
+def test_ptq_calibrate_then_convert_lenet():
+    """PTQ: observer-only calibration (outputs EXACTLY fp32 during
+    calibration), convert freezes scales into fake-quant layers."""
+    paddle.seed(1)
+    model = _lenet()
+    x = paddle.to_tensor(_data(seed=1))
+    fp32_out = model(x).numpy()
+
+    ptq = PTQ(QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(quant_bits=8),
+        weight=FakeQuanterWithAbsMaxObserver(quant_bits=8)))
+    observed = ptq.quantize(model, inplace=False)
+    # calibration passes are EXACT fp32 (observers don't quantize)
+    for i in range(3):
+        out = observed(paddle.to_tensor(_data(seed=10 + i))).numpy()
+    np.testing.assert_allclose(
+        observed(x).numpy(), fp32_out, rtol=1e-6, atol=1e-6)
+
+    converted = ptq.convert(observed, inplace=False)
+    # frozen-scale quanters installed, observers gone
+    kinds = [type(l).__name__ for l in converted.sublayers()]
+    assert "_ObservedLayer" not in kinds
+    assert "StaticScaleQuanter" in kinds
+    out_q = converted(x).numpy()
+    rel = np.abs(out_q - fp32_out).max() / (np.abs(fp32_out).max() + 1e-9)
+    assert rel < 0.1, f"int8 PTQ deviates {rel:.3f} from fp32"
+
+
+def test_ptq_static_program():
+    """quant_post_static over a captured Program: calibrate, rewrite,
+    run through the Executor — close to the fp32 program."""
+    import paddle_tpu.static as static
+    paddle.seed(2)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 16], "float32")
+            w1 = paddle.create_parameter([16, 32], "float32", name="w1")
+            w2 = paddle.create_parameter([32, 8], "float32", name="w2")
+            h = paddle.matmul(x, w1)
+            h = paddle.nn.functional.relu(h)
+            y = paddle.matmul(h, w2)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": _feat(0)}
+        fp32 = exe.run(main, feed=feed, fetch_list=[y])[0]
+
+        from paddle_tpu.static.quantization import quant_post_static
+        calib = [{"x": _feat(s)} for s in range(1, 4)]
+        qprog = quant_post_static(exe, main, ["x"], calib)
+        assert any(op.name.startswith("quant_") for op in qprog.ops)
+        qout = exe.run(qprog, feed=feed, fetch_list=[y])[0]
+        rel = np.abs(qout - fp32).max() / (np.abs(fp32).max() + 1e-9)
+        assert rel < 0.1, f"static PTQ deviates {rel:.3f}"
+    finally:
+        paddle.disable_static()
+
+
+def _feat(seed):
+    return np.random.RandomState(seed).randn(4, 16).astype(np.float32)
+
+
+def test_ptq_honors_config_choices():
+    """activation=None → no activation quant; weight bits honored."""
+    paddle.seed(3)
+    m = nn.Sequential(nn.Linear(8, 8))
+    x = paddle.to_tensor(_feat_small())
+    fp32 = m(x).numpy()
+    ptq = PTQ(QuantConfig(activation=None,
+                          weight=FakeQuanterWithAbsMaxObserver(
+                              quant_bits=4)))
+    obs = ptq.quantize(m, inplace=False)
+    layer = next(l for l in obs.sublayers()
+                 if isinstance(l, _ObservedLayer))
+    assert layer.act_observer is None and layer.w_bits == 4
+    obs(x)
+    conv = ptq.convert(obs, inplace=False)
+    kinds = [type(l).__name__ for l in conv.sublayers()]
+    assert "StaticScaleQuanter" not in kinds   # activations untouched
+    # 4-bit weights deviate much more than 8-bit would
+    rel = np.abs(conv(x).numpy() - fp32).max() / np.abs(fp32).max()
+    assert 0.0 < rel < 0.5
+
+
+def test_ptq_uncalibrated_branch_survives_convert():
+    """A wrapped layer that never ran during calibration converts with
+    activations left unquantized instead of crashing."""
+    paddle.seed(4)
+
+    class TwoHeads(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 4)
+            self.b = nn.Linear(4, 4)   # never exercised
+
+        def forward(self, x):
+            return self.a(x)
+
+    m = TwoHeads()
+    ptq = PTQ(QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver(),
+        weight=FakeQuanterWithAbsMaxObserver()))
+    obs = ptq.quantize(m, inplace=False)
+    obs(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    conv = ptq.convert(obs, inplace=False)     # must not raise
+    assert isinstance(conv.b, QuantedLinear)
+    assert conv.b.activation_quanter is None
+
+
+def test_static_ptq_feed_validation():
+    import paddle_tpu.static as static
+    from paddle_tpu.static.quantization import PostTrainingQuantization
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [2, 4], "float32")
+            _ = x * 2.0
+        ptq = PostTrainingQuantization(main, ["X_typo"])
+        with pytest.raises(KeyError, match="X_typo"):
+            ptq.quantize([{"X_typo": np.ones((2, 4), np.float32)}])
+        ptq2 = PostTrainingQuantization(main, ["x"])
+        with pytest.raises(KeyError, match="missing feed"):
+            ptq2.quantize([{"y": np.ones((2, 4), np.float32)}])
+    finally:
+        paddle.disable_static()
+
+
+def test_gradient_merge_deepcopy_safe():
+    import copy as _copy
+    from paddle_tpu.distributed.passes import GradientMergeOptimizer
+    m = nn.Linear(2, 2)
+    o = GradientMergeOptimizer(
+        opt.SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=2)
+    o2 = _copy.deepcopy(o)       # must not recurse
+    assert o2.k_steps == 2
+
+
+def _feat_small():
+    return np.random.RandomState(5).randn(4, 8).astype(np.float32)
