@@ -62,7 +62,7 @@ def main():
     engine = Engine(model, loss=model.loss_fn, optimizer=optimizer)
     history = engine.fit(MLMData(cfg), batch_size=None, epochs=1)
     print("losses:", [round(l, 4) for l in history["loss"]])
-    print("Engine.cost (bytes, est. step s):", engine.cost())
+    print("Engine.cost (est. step ms, bytes):", engine.cost())
 
 
 if __name__ == "__main__":

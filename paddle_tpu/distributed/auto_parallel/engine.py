@@ -124,15 +124,17 @@ class Engine:
                 self._optimizer.set_state_dict(pload(path + ".pdopt"))
 
     def cost(self, mode="train"):
-        """ref: Engine.cost — estimated (max_memory, time) of one step.
+        """ref: Engine.cost — estimated (time, memory) of one step.
 
         The reference runs its own analytic cost model over the
         partitioned program; here XLA itself is the cost model: the
         jitted step's memory analysis gives the executable's peak
         footprint (args + outputs + temps) and its cost analysis gives
-        FLOPs.  Returns ``(max_memory_bytes, time_cost_s)`` like the
-        reference (time from FLOPs at a nominal 50% MFU of the attached
-        chip's peak); ``None`` before the step has compiled."""
+        FLOPs.  Returns the REFERENCE's tuple shape and units:
+        ``(time_cost_ms, max_memory_bytes)`` (time from FLOPs at a
+        nominal 50% MFU of the attached chip's peak), so code ported
+        from the reference unpacking ``time, memory = engine.cost()``
+        reads correctly.  ``None`` before the step compiles."""
         step = self._train_step
         if step is None or getattr(step, "_jitted", None) is None:
             return None
@@ -167,4 +169,4 @@ class Engine:
         if not mem_bytes:
             mem_bytes = int(float(cost.get("bytes accessed", 0.0)))
         time_cost = flops / (0.5 * _chip_peak_flops()) if flops else 0.0
-        return (mem_bytes, time_cost)
+        return (time_cost * 1e3, mem_bytes)

@@ -106,10 +106,14 @@ class TCPStore(Store):
                     started = True
             if not started:
                 self._start_server()
+        # ONE deadline shared by both connect paths: falling back from
+        # the native client to the python client must not restart the
+        # clock (worst-case failure would otherwise take 2x the timeout)
+        deadline = time.time() + self._timeout
         if self._nlib is not None:
-            self._connect_native()
+            self._connect_native(deadline)
         if self._ncli is None:
-            self._connect()
+            self._connect(deadline)
 
     # -- server ----------------------------------------------------------
     def _start_server(self):
@@ -184,25 +188,36 @@ class TCPStore(Store):
         return (b"exc", f"bad op {op!r}".encode())
 
     # -- client ----------------------------------------------------------
-    def _connect_native(self):
-        deadline = time.time() + self._timeout
+    def _connect_native(self, deadline=None):
+        if deadline is None:
+            deadline = time.time() + self._timeout
         while time.time() < deadline:
+            remaining = max(deadline - time.time(), 0.05)
             h = self._nlib.pd_store_client_connect(
                 self._host.encode(), self._port,
-                ctypes.c_double(self._timeout))
+                ctypes.c_double(remaining))
             if h:
                 self._ncli = h
                 return
             time.sleep(0.1)
         # fall through to the python client's own retry/raise
 
-    def _connect(self):
-        deadline = time.time() + self._timeout
+    def _connect(self, deadline=None):
+        if deadline is None:
+            deadline = time.time() + self._timeout
         last = None
-        while time.time() < deadline:
+        first = True
+        while True:
+            remaining = deadline - time.time()
+            # even with the shared deadline exhausted by the native
+            # client, the python fallback gets ONE attempt — a server
+            # that just came up should connect, not raise '...: None'
+            if remaining <= 0 and not first:
+                break
+            first = False
             try:
                 s = socket.create_connection((self._host, self._port),
-                                             timeout=self._timeout)
+                                             timeout=max(remaining, 0.5))
                 self._sock = s
                 return
             except OSError as e:

@@ -453,6 +453,8 @@ class DataLoader:
             buf = {}
             for want in range(len(batches)):
                 remaining = timeout   # PER-BATCH wait (ref semantics)
+                waited = 0.0
+                warned_at = 0.0
                 while want not in buf:
                     try:
                         ep, bidx, out, err = result_q.get(timeout=1.0)
@@ -466,12 +468,26 @@ class DataLoader:
                                 f"worker stderr for the traceback; if it "
                                 f"mentions fork/threads, set "
                                 f"PADDLE_WORKER_START_METHOD=forkserver")
+                        waited += 1.0
                         if remaining is not None:
                             remaining -= 1.0
                             if remaining <= 0:
                                 raise RuntimeError(
                                     f"DataLoader batch timed out after "
                                     f"{self.timeout}s")
+                        elif waited - warned_at >= 60.0:
+                            # timeout=0 waits forever — a worker that
+                            # DEADLOCKED (alive but silent) would hang
+                            # the parent with no signal; surface it
+                            warned_at = waited
+                            import warnings
+                            warnings.warn(
+                                f"DataLoader batch {want} has produced "
+                                f"no result for {int(waited)}s (workers "
+                                f"alive but silent — possible deadlock "
+                                f"in a fork-started worker; consider "
+                                f"PADDLE_WORKER_START_METHOD=forkserver "
+                                f"or a nonzero timeout)", RuntimeWarning)
                         continue
                     if err is not None and (ep is None or ep == epoch):
                         # current-epoch failure, or a worker-init error

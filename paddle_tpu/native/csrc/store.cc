@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -108,10 +109,14 @@ struct Server {
   std::mutex mu;
   std::map<std::string, std::string> data;
   std::mutex conn_mu;
-  std::vector<std::thread> handlers;
+  struct Handler {
+    std::thread t;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+  std::vector<Handler> handlers;
   std::vector<int> conn_fds;
 
-  void handle(int fd) {
+  void handle(int fd, std::shared_ptr<std::atomic<bool>> done) {
     std::vector<std::string> parts;
     while (!stopping.load() && recv_msg(fd, &parts)) {
       std::vector<std::string> reply;
@@ -178,6 +183,7 @@ struct Server {
         }
     }
     ::close(fd);
+    done->store(true);
   }
 
   void accept_loop() {
@@ -190,8 +196,21 @@ struct Server {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> g(conn_mu);
+      // reap finished handlers: a joinable thread keeps its stack
+      // mapping until join, so connection churn (elastic relaunches)
+      // would otherwise leak a stack per past connection
+      for (auto it = handlers.begin(); it != handlers.end();) {
+        if (it->done->load()) {
+          if (it->t.joinable()) it->t.join();
+          it = handlers.erase(it);
+        } else {
+          ++it;
+        }
+      }
       conn_fds.push_back(fd);
-      handlers.emplace_back(&Server::handle, this, fd);
+      auto done = std::make_shared<std::atomic<bool>>(false);
+      handlers.push_back(
+          Handler{std::thread(&Server::handle, this, fd, done), done});
     }
   }
 };
@@ -260,8 +279,8 @@ void pd_store_server_stop(void* handle) {
     std::lock_guard<std::mutex> g(srv->conn_mu);
     for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
   }
-  for (auto& t : srv->handlers)
-    if (t.joinable()) t.join();
+  for (auto& h : srv->handlers)
+    if (h.t.joinable()) h.t.join();
   delete srv;
 }
 

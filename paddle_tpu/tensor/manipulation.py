@@ -421,7 +421,8 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         counts = np.zeros((0,), dtype=np.int64)
         inverse = np.zeros((0,), dtype=np.int64)
     else:
-        sl = [slice(None)] * arr.ndim
+        import builtins
+        sl = [builtins.slice(None)] * arr.ndim
         first = np.ones(arr.shape[ax], dtype=bool)
         if arr.shape[ax] > 1:
             a1 = np.take(arr, range(1, arr.shape[ax]), axis=ax)

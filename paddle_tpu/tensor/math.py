@@ -174,19 +174,32 @@ def cumprod(x, dim=None, dtype=None, name=None):
                    {}, op_name="cumprod")
 
 
+def _cum_argextreme(vv, ax, better):
+    """(running extreme, running arg) via one (value, index) scan —
+    ties keep the EARLIEST index (the reference's convention).  The old
+    formulation (min-scan over self-equal positions) was wrong: a value
+    equal to ITS OWN running max need not equal the CURRENT one."""
+    n = vv.shape[ax]
+    ar = jnp.broadcast_to(
+        jnp.arange(n).reshape([-1 if i == ax else 1
+                               for i in range(vv.ndim)]), vv.shape)
+
+    def comb(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = better(bv, av)          # strict: ties keep the earlier
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    return jax.lax.associative_scan(comb, (vv, ar), axis=ax)
+
+
 def cummax(x, axis=None, dtype="int64", name=None):
     x = ensure_tensor(x)
 
     def f(v):
-        ax = 0 if axis is None else int(axis)
         vv = v.reshape(-1) if axis is None else v
-        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
-        # index = first position achieving the running max
-        n = vv.shape[ax]
-        ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
-        eq = (vv == vals)
-        first = jnp.where(eq, ar, n)
-        idxs = jax.lax.associative_scan(jnp.minimum, first, axis=ax)
+        ax = 0 if axis is None else int(axis) % vv.ndim
+        vals, idxs = _cum_argextreme(vv, ax, lambda b, a: b > a)
         return vals, idxs.astype(dtypes.to_jax(dtype))
     return call_op(f, (x,), {}, multi_out=True, op_name="cummax")
 
@@ -195,14 +208,9 @@ def cummin(x, axis=None, dtype="int64", name=None):
     x = ensure_tensor(x)
 
     def f(v):
-        ax = 0 if axis is None else int(axis)
         vv = v.reshape(-1) if axis is None else v
-        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
-        n = vv.shape[ax]
-        ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(vv.ndim)])
-        eq = (vv == vals)
-        first = jnp.where(eq, ar, n)
-        idxs = jax.lax.associative_scan(jnp.minimum, first, axis=ax)
+        ax = 0 if axis is None else int(axis) % vv.ndim
+        vals, idxs = _cum_argextreme(vv, ax, lambda b, a: b < a)
         return vals, idxs.astype(dtypes.to_jax(dtype))
     return call_op(f, (x,), {}, multi_out=True, op_name="cummin")
 
@@ -438,7 +446,12 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 def add_n(inputs, name=None):
     tensors = [ensure_tensor(t) for t in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
-    return call_op(lambda *xs: sum(xs[1:], xs[0]), tensors, {}, op_name="add_n")
+    # NB: `sum` here is the paddle reduction op (module shadowing), not
+    # the builtin — accumulate explicitly
+    import functools as _ft
+    import operator as _op
+    return call_op(lambda *xs: _ft.reduce(_op.add, xs), tensors, {},
+                   op_name="add_n")
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):

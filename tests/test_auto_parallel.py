@@ -163,5 +163,5 @@ def test_engine_cost_returns_estimates():
     eng.fit(DS(), batch_size=None, epochs=1)
     cost = eng.cost()
     assert cost is not None
-    mem_bytes, time_s = cost
-    assert mem_bytes > 0 and time_s >= 0
+    time_ms, mem_bytes = cost     # the reference's (time, memory) order
+    assert mem_bytes > 0 and time_ms >= 0

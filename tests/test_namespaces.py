@@ -411,7 +411,8 @@ def test_ptq_observer_flow(rng):
     x = Tensor(rng.randn(16, 6).astype("float32"))
     qnet(x)  # calibration pass observes activations and weights
     final = ptq.convert(qnet)
-    w = final._sub_layers["0"].weight.numpy()
+    # convert wraps layers in QuantedLinear with frozen activation scales
+    w = final._sub_layers["0"].inner.weight.numpy()
     obs_scale = np.abs(w).max()  # after baking, absmax is on the grid
     step = obs_scale / 127.0
     np.testing.assert_allclose(w / step, np.round(w / step), atol=1e-2)

@@ -50,14 +50,16 @@ def _as_np(out):
 
 def test_registry_is_the_index():
     """The registry is the single queryable index of the op surface."""
-    assert len(REGISTRY) >= 600, len(REGISTRY)
+    # 583 after round 4's absorption filter dropped typing/dataclasses
+    # re-exports that had inflated the index (they were never ops)
+    assert len(REGISTRY) >= 575, len(REGISTRY)
     # every row resolves to a callable
     unresolved = [n for n, r in REGISTRY.items()
                   if r.paddle_fn is None and r.source == "absorbed"]
     assert not unresolved, unresolved
     # the parity subset is materially large, not a token sample
-    assert len(_PARITY_ROWS) >= 200, len(_PARITY_ROWS)
-    assert len(_GRAD_ROWS) >= 70, len(_GRAD_ROWS)
+    assert len(_PARITY_ROWS) >= 320, len(_PARITY_ROWS)
+    assert len(_GRAD_ROWS) >= 90, len(_GRAD_ROWS)
 
 
 @pytest.mark.parametrize("name", _PARITY_ROWS)
