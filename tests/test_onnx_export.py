@@ -1,0 +1,149 @@
+"""paddle.onnx.export — direct ONNX emission (ref: onnx/export.py).
+
+No onnx package ships in this environment, so validation decodes the
+emitted protobuf with the minimal wire-format reader and checks the
+graph structure + initializer payloads byte-for-byte.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import _proto as pb
+from paddle_tpu.onnx import export
+
+
+def _fields(data, field):
+    return [v for f, _, v in pb.read_fields(data) if f == field]
+
+
+def _decode_model(path):
+    blob = open(path, "rb").read()
+    top = pb.read_fields(blob)
+    ir = [v for f, _, v in top if f == 1][0]
+    graph = [v for f, _, v in top if f == 7][0]
+    opset = [v for f, _, v in top if f == 8][0]
+    g = pb.read_fields(graph)
+    nodes = [v for f, _, v in g if f == 1]
+    inits = [v for f, _, v in g if f == 5]
+    g_in = [v for f, _, v in g if f == 11]
+    g_out = [v for f, _, v in g if f == 12]
+    return ir, opset, nodes, inits, g_in, g_out
+
+
+def _node_op(node_bytes):
+    return _fields(node_bytes, 4)[0].decode()
+
+
+def test_export_mlp_roundtrip(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3),
+                      nn.Softmax())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4).astype(np.float32))
+    out_path = export(m, str(tmp_path / "mlp"), input_spec=[x])
+    assert out_path.endswith(".onnx")
+
+    ir, opset, nodes, inits, g_in, g_out = _decode_model(out_path)
+    assert ir == 8
+    ops = [_node_op(n) for n in nodes]
+    # Linear → MatMul+Add; stack: MM,Add,Relu,MM,Add,Softmax
+    assert ops == ["MatMul", "Add", "Relu", "MatMul", "Add", "Softmax"]
+    assert len(g_in) == 1 and len(g_out) == 1
+    # initializers carry the exact weight bytes
+    assert len(inits) == 4      # 2 weights + 2 biases
+    w0 = m[0].weight.numpy()
+    raw = {tuple(_fields(i, 1)): _fields(i, 9)[0] for i in inits}
+    assert any(v == w0.astype(np.float32).tobytes()
+               for v in raw.values())
+
+
+def test_export_embedding_and_eval_dropout(tmp_path):
+    paddle.seed(1)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(10, 6)
+            self.drop = nn.Dropout(0.5)
+            self.fc = nn.Linear(6, 2)
+
+        def forward(self, ids):
+            return self.fc(self.drop(self.emb(ids)))
+
+    m = M()
+    ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+    out_path = export(m, str(tmp_path / "emb"), input_spec=[ids])
+    _, _, nodes, inits, _, _ = _decode_model(out_path)
+    ops = [_node_op(n) for n in nodes]
+    # eval-mode dropout short-circuits before dispatch — no node at all
+    assert ops == ["Gather", "MatMul", "Add"]
+
+
+def test_export_unsupported_op_raises(tmp_path):
+    class M(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x)
+
+    with pytest.raises(NotImplementedError, match="cumsum"):
+        export(M(), str(tmp_path / "bad"),
+               input_spec=[paddle.to_tensor(np.ones((2, 3), np.float32))])
+
+
+def test_export_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        export(nn.Linear(2, 2), str(tmp_path / "x"))
+
+
+def test_attr_recovery_softmax_axis_and_transpose(tmp_path):
+    """Attributes live in closures, not op.kwargs — the exporter must
+    recover them numerically from the recorded outputs."""
+    class M(nn.Layer):
+        def forward(self, x):
+            h = paddle.transpose(x, perm=[0, 2, 1])
+            return paddle.nn.functional.softmax(h, axis=1)
+
+    x = paddle.to_tensor(np.random.RandomState(3)
+                         .randn(2, 3, 4).astype(np.float32))
+    p = export(M(), str(tmp_path / "attr"), input_spec=[x])
+    _, _, nodes, _, _, _ = _decode_model(p)
+    ops = [_node_op(n) for n in nodes]
+    assert ops == ["Transpose", "Softmax"]
+    # transpose perm recovered as (0, 2, 1)
+    t_attrs = [pb.read_fields(a) for a in _fields(nodes[0], 5)]
+    perm = [v for f, _, v in t_attrs[0] if f == 8]
+    assert perm == [0, 2, 1]
+    # softmax axis recovered as 1 - ndim = -2
+    s_attrs = [pb.read_fields(a) for a in _fields(nodes[1], 5)]
+    ax = [v for f, _, v in s_attrs[0] if f == 3][0]
+    assert ax - (1 << 64) == -2 or ax == (1 << 64) - 2
+
+
+def test_concat_axis_recovered(tmp_path):
+    class M(nn.Layer):
+        def forward(self, x):
+            return paddle.concat([x, x * 2.0], axis=1)
+
+    x = paddle.to_tensor(np.random.RandomState(4)
+                         .randn(2, 3).astype(np.float32))
+    p = export(M(), str(tmp_path / "cat"), input_spec=[x])
+    _, _, nodes, _, _, _ = _decode_model(p)
+    cat = next(n for n in nodes if _node_op(n) == "Concat")
+    attrs = pb.read_fields(_fields(cat, 5)[0])
+    assert [v for f, _, v in attrs if f == 3] == [1]
+
+
+def test_padding_idx_embedding_refused(tmp_path):
+    """nn.Embedding zeroes the weight row itself (Gather stays exact);
+    F.embedding with padding_idx over a NONZERO weight masks rows at
+    lookup time, which Gather can't express — must refuse."""
+    w = paddle.to_tensor(np.random.RandomState(5)
+                         .randn(6, 4).astype(np.float32))
+
+    class M(nn.Layer):
+        def forward(self, ids):
+            return paddle.nn.functional.embedding(ids, w, padding_idx=0)
+
+    ids = paddle.to_tensor(np.array([[0, 1, 2]], np.int64))
+    with pytest.raises(NotImplementedError, match="padding_idx"):
+        export(M(), str(tmp_path / "padidx"), input_spec=[ids])
