@@ -43,8 +43,7 @@ import numpy as np
 
 from ..core.dispatch import call_op
 from ..core.tensor import Tensor
-from ..static.capture import Program, pop_program, push_program, \
-    in_static_capture
+from ..static.capture import Program, in_static_capture
 
 # max specializations (distinct guard paths) per input signature
 MAX_TRACES_PER_SIG = 8
@@ -109,26 +108,21 @@ def record(fn: Callable, args, kwargs):
         raise GraphBreakUnsupported(
             "nested SOT/static capture is not supported")
     rec = _Recording()
-    import paddle_tpu.core.dispatch as _dispatch
     import paddle_tpu.core.tensor as _tensor_mod
     import paddle_tpu.random_state as _rs
-    push_program(rec.program)
-    from ..static.capture import record_op
-    prev_observer = _dispatch._op_observer
+    from ..static.capture import capture_ops
     prev_hook = _tensor_mod._host_read_hook
     prev_rng = _rs._rng_draw_hook
-    _dispatch._op_observer = record_op
     _tensor_mod._host_read_hook = notify_host_read
     _rs._rng_draw_hook = rec.rng_drawn
     _active = rec
     try:
-        out = fn(*args, **kwargs)
+        with capture_ops(rec.program):
+            out = fn(*args, **kwargs)
     finally:
         _active = None
-        _dispatch._op_observer = prev_observer
         _tensor_mod._host_read_hook = prev_hook
         _rs._rng_draw_hook = prev_rng
-        pop_program()
     return rec, out
 
 

@@ -32,6 +32,7 @@ class _Emit:
         self.inits: List[bytes] = []
         self.names: Dict[int, str] = {}   # id(recorded Tensor) -> name
         self.counter = 0
+        self.dyn_batch = None   # example batch size of a symbolic dim 0
 
     def name_of(self, t) -> str:
         tid = id(t)
@@ -57,6 +58,25 @@ class _Emit:
 
 def _np(t):
     return np.asarray(t._data)
+
+
+def _unique_match(candidates, make_ref, want, what):
+    """Return the single candidate whose lowering reproduces ``want``;
+    raise on zero OR multiple matches (degenerate example data makes
+    attributes unrecoverable — silent wrong graphs are worse than an
+    error asking for better data)."""
+    hits = [c for c in candidates if np.allclose(make_ref(c), want,
+                                                atol=1e-5)]
+    if len(hits) == 1:
+        return hits[0]
+    if not hits:
+        raise NotImplementedError(
+            f"onnx export: could not recover the {what} from the "
+            "recorded output")
+    raise NotImplementedError(
+        f"onnx export: {what} is ambiguous on the example data "
+        f"({len(hits)} candidates match) — export with non-degenerate "
+        "(e.g. random) example tensors")
 
 
 def _emit_op(e: _Emit, op) -> None:
@@ -99,24 +119,33 @@ def _emit_op(e: _Emit, op) -> None:
         return
     if name in ("softmax", "log_softmax"):
         x = _np(op.inputs[0])
-        want = _np(out_t)
-        axis = None
-        for cand in range(x.ndim):
+
+        def ref(cand):
             m = x - x.max(axis=cand, keepdims=True)
             sm = np.exp(m) / np.exp(m).sum(axis=cand, keepdims=True)
-            ref = np.log(sm) if name == "log_softmax" else sm
-            if np.allclose(ref, want, atol=1e-5):
-                axis = cand - x.ndim        # canonical negative form
-                break
-        if axis is None:
-            raise NotImplementedError(
-                f"onnx export: could not recover the {name} axis from "
-                "the recorded output")
+            return np.log(sm) if name == "log_softmax" else sm
+
+        axis = _unique_match(range(x.ndim), ref, _np(out_t),
+                             f"{name} axis") - x.ndim
         e.add("Softmax" if name == "softmax" else "LogSoftmax", ins,
               out(name), [pb.attr_int("axis", axis)])
         return
     if name == "gelu":
-        e.add("Gelu", ins, out("gelu"))
+        # Gelu joined the default ONNX domain at opset 20 (export() pins
+        # opset accordingly); distinguish exact vs tanh-approx by
+        # matching the recorded output
+        import math
+        x = _np(op.inputs[0]).astype(np.float64)
+        want = _np(out_t)
+        exact = 0.5 * x * (1 + np.vectorize(math.erf)(x / np.sqrt(2.0)))
+        approx = 0.5 * x * (1 + np.tanh(
+            np.sqrt(2.0 / np.pi) * (x + 0.044715 * x ** 3)))
+        kind = _unique_match(
+            ["none", "tanh"],
+            lambda k: exact if k == "none" else approx, want,
+            "gelu approximation")
+        e.add("Gelu", ins, out("gelu"),
+              [pb.attr_str("approximate", kind)])
         return
     if name in ("silu", "swish"):
         sg = f"sg_{e.counter}"
@@ -125,10 +154,12 @@ def _emit_op(e: _Emit, op) -> None:
         e.add("Mul", [ins[0], sg], out("silu"))
         return
     if name in ("flatten", "reshape"):
-        shape = np.asarray(out_t._data.shape, np.int64)
+        shape = list(out_t._data.shape)
+        if e.dyn_batch is not None and shape and shape[0] == e.dyn_batch:
+            shape[0] = -1      # keep the graph batch-polymorphic
         sh = f"shape_{e.counter}"
         e.counter += 1
-        e.inits.append(pb.tensor_proto(sh, shape))
+        e.inits.append(pb.tensor_proto(sh, np.asarray(shape, np.int64)))
         e.add("Reshape", [ins[0], sh], out("reshape"))
         return
     if name == "transpose":
@@ -138,17 +169,10 @@ def _emit_op(e: _Emit, op) -> None:
         if x.ndim > 6:
             raise NotImplementedError(
                 "onnx export: transpose beyond 6-D not supported")
-        perm = None
-        for cand in itertools.permutations(range(x.ndim)):
-            if x.transpose(cand).shape != want.shape:
-                continue
-            if np.array_equal(x.transpose(cand), want):
-                perm = cand
-                break
-        if perm is None:
-            raise NotImplementedError(
-                "onnx export: could not recover the transpose perm from "
-                "the recorded output")
+        cands = [c for c in itertools.permutations(range(x.ndim))
+                 if x.transpose(c).shape == want.shape]
+        perm = _unique_match(cands, lambda c: x.transpose(c), want,
+                             "transpose perm")
         e.add("Transpose", ins, out("transpose"),
               [pb.attr_ints("perm", list(perm))])
         return
@@ -197,8 +221,49 @@ def _emit_op(e: _Emit, op) -> None:
             "onnx export: dropout output matches neither identity nor a "
             "constant rescale of its input")
     if name == "layer_norm":
-        e.add("LayerNormalization", ins, out("layernorm"),
-              [pb.attr_int("axis", -1)])
+        # ship only what LayerNormalization(axis=-1) can express — and
+        # verify it numerically like every other recovered lowering
+        x = _np(op.inputs[0]).astype(np.float64)
+        want = _np(out_t)
+        rest = [_np(t) for t in op.inputs[1:]]
+        d = x.shape[-1]
+        scale = rest[0] if rest and rest[0].shape == (d,) else None
+        bias = (rest[1] if len(rest) > 1 and rest[1].shape == (d,)
+                else None)
+
+        def ref(eps):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            y = (x - mu) / np.sqrt(var + eps)
+            if scale is not None:
+                y = y * scale
+            if bias is not None:
+                y = y + bias
+            return y
+
+        # eps candidates can ALL match within tolerance (their outputs
+        # differ by <1e-5) — first match is fine, unlike axis/perm where
+        # ambiguity means a semantically different graph
+        eps = next((c for c in (1e-5, 1e-6, 1e-12, 1e-3)
+                    if np.allclose(ref(c), want, atol=1e-5)), None)
+        if eps is None:
+            raise NotImplementedError(
+                "onnx export: layer_norm does not match last-axis "
+                "LayerNormalization semantics (multi-dim "
+                "normalized_shape?)")
+        ln_ins = [ins[0]]
+        if scale is not None:
+            ln_ins.append(ins[1])
+        else:
+            # LayerNormalization requires a Scale input — synthesize ones
+            nm = f"ln_scale_{e.counter}"
+            e.counter += 1
+            e.inits.append(pb.tensor_proto(nm, np.ones(d, np.float32)))
+            ln_ins.append(nm)
+        if bias is not None:
+            ln_ins.append(ins[2] if scale is not None else ins[1])
+        e.add("LayerNormalization", ln_ins, out("layernorm"),
+              [pb.attr_int("axis", -1), pb.attr_float("epsilon", eps)])
         return
     raise NotImplementedError(
         f"paddle.onnx.export: op {name!r} has no ONNX lowering in this "
@@ -207,27 +272,33 @@ def _emit_op(e: _Emit, op) -> None:
         "(StableHLO) for arbitrary programs.")
 
 
-def export(layer, path, input_spec=None, opset_version=17, **configs):
+def export(layer, path, input_spec=None, opset_version=20, **configs):
     """ref: paddle.onnx.export — trace ``layer`` on ``input_spec``
     (InputSpec shapes or example Tensors) and write ``path + '.onnx'``.
 
-    Returns the output file path."""
+    InputSpec dims of None/-1 export as symbolic ``N`` dims (dynamic
+    batch); Reshape shape constants touching a dynamic leading dim use
+    -1 so the graph stays batch-polymorphic.  Returns the output path.
+    Default opset 20 (Gelu joined the default domain there)."""
     from ..core.tensor import Tensor
     from ..jit.to_static import InputSpec
-    from ..static.capture import Program, push_program, pop_program, \
-        record_op
-    import paddle_tpu.core.dispatch as _dispatch
+    from ..static.capture import Program, capture_ops
 
     if input_spec is None:
         raise ValueError("paddle.onnx.export requires input_spec "
                          "(InputSpec list or example Tensors)")
     examples = []
+    dyn_dims = []           # per input: set of dynamic dim positions
     for spec in input_spec:
         if isinstance(spec, Tensor):
             examples.append(spec)
+            dyn_dims.append(set())
         elif isinstance(spec, InputSpec):
-            shape = [1 if (d is None or (isinstance(d, int) and d < 0))
-                     else d for d in spec.shape]
+            dyn = {i for i, d in enumerate(spec.shape)
+                   if d is None or (isinstance(d, int) and d < 0)}
+            shape = [2 if i in dyn else d
+                     for i, d in enumerate(spec.shape)]
+            dyn_dims.append(dyn)
             # random example data: attribute recovery matches candidate
             # lowerings numerically, which degenerates on all-zeros
             rs = np.random.RandomState(0)
@@ -239,42 +310,50 @@ def export(layer, path, input_spec=None, opset_version=17, **configs):
                     rs.randn(*shape).astype("float32")))
         else:
             examples.append(Tensor(np.asarray(spec)))
+            dyn_dims.append(set())
 
     fwd = layer.forward if hasattr(layer, "forward") else layer
     was_training = getattr(layer, "training", False)
     if hasattr(layer, "eval"):
         layer.eval()
     prog = Program()
-    prev = _dispatch._op_observer
-    push_program(prog)
-    _dispatch._op_observer = record_op
     try:
-        out = fwd(*examples)
+        with capture_ops(prog):
+            out = fwd(*examples)
     finally:
-        _dispatch._op_observer = prev
-        pop_program()
         if was_training and hasattr(layer, "train"):
             layer.train()
     outs = out if isinstance(out, (list, tuple)) else [out]
 
+    # dynamic batch: if any input's dim 0 is symbolic, Reshape shape
+    # constants whose leading entry equals the example batch become -1
+    dyn_batch = (next((np.asarray(t._data).shape[0]
+                       for t, ds in zip(examples, dyn_dims) if 0 in ds),
+                      None))
     e = _Emit()
+    e.dyn_batch = dyn_batch
     for i, t in enumerate(examples):
         e.names[id(t)] = f"input_{i}"
     for op in prog.ops:
         _emit_op(e, op)
 
-    g_inputs = [pb.value_info(f"input_{i}",
-                              np.asarray(t._data).dtype,
-                              list(t.shape))
-                for i, t in enumerate(examples)]
+    g_inputs = []
+    for i, (t, ds) in enumerate(zip(examples, dyn_dims)):
+        shape = [None if j in ds else d
+                 for j, d in enumerate(t.shape)]
+        g_inputs.append(pb.value_info(f"input_{i}",
+                                      np.asarray(t._data).dtype, shape))
     g_outputs = []
     for t in outs:
         nm = e.names.get(id(t))
         if nm is None:
             raise ValueError("onnx export: an output tensor was not "
                              "produced by any recorded op")
+        oshape = list(t.shape)
+        if dyn_batch is not None and oshape and oshape[0] == dyn_batch:
+            oshape[0] = None
         g_outputs.append(pb.value_info(nm, np.asarray(t._data).dtype,
-                                       list(t.shape)))
+                                       oshape))
 
     gbody = pb.graph(e.nodes, "paddle_tpu_graph", e.inits, g_inputs,
                      g_outputs)

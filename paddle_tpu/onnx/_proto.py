@@ -107,6 +107,11 @@ def attr_ints(name: str, vs: Sequence[int]) -> bytes:
             + f_varint(20, A_INTS))
 
 
+def attr_str(name: str, v: str) -> bytes:
+    return (f_str(1, name) + f_bytes(4, v.encode("utf-8"))
+            + f_varint(20, A_STRING))
+
+
 def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
          name: str = "", attrs: Sequence[bytes] = ()) -> bytes:
     body = b"".join(f_str(1, i) for i in inputs)

@@ -179,3 +179,22 @@ def record_op(fn, kwargs, in_tensors, out_tensors, multi_out, name):
     p = current_program()
     if p is not None:
         p._record(fn, kwargs, in_tensors, out_tensors, multi_out, name)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def capture_ops(program: Program):
+    """Record every dispatched op into ``program`` for the duration of
+    the block — the shared observer-swap used by the static Program
+    build, SOT-lite recording, and the ONNX exporter."""
+    import paddle_tpu.core.dispatch as _dispatch
+    push_program(program)
+    prev = _dispatch._op_observer
+    _dispatch._op_observer = record_op
+    try:
+        yield program
+    finally:
+        _dispatch._op_observer = prev
+        pop_program()

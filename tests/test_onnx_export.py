@@ -147,3 +147,51 @@ def test_padding_idx_embedding_refused(tmp_path):
     ids = paddle.to_tensor(np.array([[0, 1, 2]], np.int64))
     with pytest.raises(NotImplementedError, match="padding_idx"):
         export(M(), str(tmp_path / "padidx"), input_spec=[ids])
+
+
+def test_dynamic_batch_and_gelu_layernorm(tmp_path):
+    """InputSpec None dims export symbolic; gelu lands with the right
+    approximate attr at opset 20; layer_norm verifies numerically."""
+    from paddle_tpu.jit.to_static import InputSpec
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln = nn.LayerNorm(8)
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            h = paddle.nn.functional.gelu(self.ln(x), approximate=True)
+            return paddle.flatten(self.fc(h), start_axis=1)
+
+    p = export(M(), str(tmp_path / "dyn"),
+               input_spec=[InputSpec([None, 8], "float32")])
+    ir, opset, nodes, inits, g_in, g_out = _decode_model(p)
+    assert [v for f, _, v in pb.read_fields(opset) if f == 2] == [20]
+    ops = [_node_op(n) for n in nodes]
+    assert "LayerNormalization" in ops and "Gelu" in ops
+    # gelu approximate attr recovered as "tanh"
+    gelu = next(n for n in nodes if _node_op(n) == "Gelu")
+    attrs = pb.read_fields(_fields(gelu, 5)[0])
+    assert [v for f, _, v in attrs if f == 4] == [b"tanh"]
+    # the graph input's dim 0 is symbolic (dim_param), not baked to 2
+    tin = pb.read_fields(_fields(g_in[0], 2)[0])          # TypeProto
+    tt = pb.read_fields([v for f, _, v in tin if f == 1][0])
+    shp = pb.read_fields([v for f, _, v in tt if f == 2][0])
+    dim0 = pb.read_fields([v for f, _, v in shp if f == 1][0])
+    assert any(f == 2 for f, _, _ in dim0)    # dim_param, not dim_value
+    # the flatten Reshape constant uses -1 for the dynamic batch
+    raw = [r for i in inits for _, _, r in pb.read_fields(i)
+           if isinstance(r, bytes) and len(r) == 16]
+    shapes = [np.frombuffer(r, np.int64) for r in raw]
+    assert any(s[0] == -1 for s in shapes), shapes
+
+
+def test_ambiguous_attr_recovery_refused(tmp_path):
+    class M(nn.Layer):
+        def forward(self, x):
+            return paddle.nn.functional.softmax(x, axis=0)
+
+    ones = paddle.to_tensor(np.ones((3, 3), np.float32))
+    with pytest.raises(NotImplementedError, match="ambiguous"):
+        export(M(), str(tmp_path / "amb"), input_spec=[ones])
