@@ -35,7 +35,12 @@ def _np(t) -> np.ndarray:
             # is applied at the jnp cast anyway)
             t = t.float()
         t = t.numpy()
-    return np.asarray(t)
+    # MUST copy: torch .numpy() shares the parameter's buffer, and on the
+    # CPU backend jnp.asarray is zero-copy too — without this, weights
+    # converted WITHOUT a transpose (embeddings, norms) silently alias
+    # the live torch parameters, and training the torch model afterwards
+    # mutates the converted model
+    return np.array(t, copy=True)
 
 
 def _interleave_rope_rows(w: np.ndarray, n_heads: int) -> np.ndarray:

@@ -27,12 +27,13 @@ __all__ = ["export"]
 
 
 class _Emit:
-    def __init__(self):
+    def __init__(self, opset: int = 20):
         self.nodes: List[bytes] = []
         self.inits: List[bytes] = []
         self.names: Dict[int, str] = {}   # id(recorded Tensor) -> name
         self.counter = 0
         self.dyn_batch = None   # example batch size of a symbolic dim 0
+        self.opset = opset
 
     def name_of(self, t) -> str:
         tid = id(t)
@@ -131,9 +132,12 @@ def _emit_op(e: _Emit, op) -> None:
               out(name), [pb.attr_int("axis", axis)])
         return
     if name == "gelu":
-        # Gelu joined the default ONNX domain at opset 20 (export() pins
-        # opset accordingly); distinguish exact vs tanh-approx by
-        # matching the recorded output
+        # Gelu joined the default ONNX domain at opset 20; emitting it
+        # under an older requested opset would write an invalid file
+        if e.opset < 20:
+            raise NotImplementedError(
+                f"onnx export: Gelu needs opset >= 20 (requested "
+                f"{e.opset})")
         import math
         x = _np(op.inputs[0]).astype(np.float64)
         want = _np(out_t)
@@ -170,9 +174,24 @@ def _emit_op(e: _Emit, op) -> None:
             raise NotImplementedError(
                 "onnx export: transpose beyond 6-D not supported")
         cands = [c for c in itertools.permutations(range(x.ndim))
-                 if x.transpose(c).shape == want.shape]
-        perm = _unique_match(cands, lambda c: x.transpose(c), want,
-                             "transpose perm")
+                 if x.transpose(c).shape == want.shape
+                 and np.array_equal(x.transpose(c), want)]
+        # perms that differ only in how they shuffle size-1 axes are
+        # semantically identical — dedupe by their action on real axes
+        def _sig(c):
+            return tuple((i, c[i]) for i in range(len(c))
+                         if x.shape[c[i]] > 1)
+        sigs = {_sig(c) for c in cands}
+        if not cands:
+            raise NotImplementedError(
+                "onnx export: could not recover the transpose perm from "
+                "the recorded output")
+        if len(sigs) > 1:
+            raise NotImplementedError(
+                "onnx export: transpose perm is ambiguous on the "
+                "example data — export with non-degenerate (e.g. "
+                "random) example tensors")
+        perm = cands[0]
         e.add("Transpose", ins, out("transpose"),
               [pb.attr_ints("perm", list(perm))])
         return
@@ -296,7 +315,16 @@ def export(layer, path, input_spec=None, opset_version=20, **configs):
         elif isinstance(spec, InputSpec):
             dyn = {i for i, d in enumerate(spec.shape)
                    if d is None or (isinstance(d, int) and d < 0)}
-            shape = [2 if i in dyn else d
+            if dyn - {0}:
+                raise NotImplementedError(
+                    "paddle.onnx.export: only leading-dim (batch) "
+                    "dynamism is supported — shape constants for other "
+                    "dims would bake the example value while the graph "
+                    f"claimed them symbolic (got dynamic dims {sorted(dyn)})")
+            # collision-proof example batch: the Reshape dynamic-batch
+            # rewrite matches shape entries equal to this value, so it
+            # must never collide with a real static dim
+            shape = [1739 if i in dyn else d
                      for i, d in enumerate(spec.shape)]
             dyn_dims.append(dyn)
             # random example data: attribute recovery matches candidate
@@ -330,7 +358,7 @@ def export(layer, path, input_spec=None, opset_version=20, **configs):
     dyn_batch = (next((np.asarray(t._data).shape[0]
                        for t, ds in zip(examples, dyn_dims) if 0 in ds),
                       None))
-    e = _Emit()
+    e = _Emit(opset=int(opset_version))
     e.dyn_batch = dyn_batch
     for i, t in enumerate(examples):
         e.names[id(t)] = f"input_{i}"

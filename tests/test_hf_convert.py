@@ -71,3 +71,32 @@ def test_bert_hidden_states_match_transformers():
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(pooled.numpy()), want_pool,
                                rtol=2e-3, atol=2e-3)
+
+
+def test_converted_weights_do_not_alias_torch():
+    """torch .numpy() shares buffers and CPU jnp.asarray is zero-copy:
+    conversion must deep-copy, or training the torch model afterwards
+    silently mutates the converted one (caught by the training-dynamics
+    parity oracle)."""
+    torch.manual_seed(3)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=16, tie_word_embeddings=False,
+        attn_implementation="eager")
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    ours = llama_from_hf(hf)
+    before = {
+        "embed": ours.llama.embed_tokens.weight.numpy().copy(),
+        "norm": ours.llama.norm.weight.numpy().copy(),
+        "q": ours.llama.layers[0].self_attn.q_proj.weight.numpy().copy(),
+    }
+    with torch.no_grad():
+        for p in hf.parameters():
+            p.add_(1.0)     # in-place torch mutation
+    np.testing.assert_array_equal(
+        ours.llama.embed_tokens.weight.numpy(), before["embed"])
+    np.testing.assert_array_equal(
+        ours.llama.norm.weight.numpy(), before["norm"])
+    np.testing.assert_array_equal(
+        ours.llama.layers[0].self_attn.q_proj.weight.numpy(), before["q"])

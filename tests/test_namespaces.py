@@ -509,5 +509,7 @@ def test_version_and_onnx(capsys):
     assert v.full_version
     v.show()
     assert "full_version" in capsys.readouterr().out
-    with pytest.raises((ImportError, NotImplementedError)):
+    # export is real now (see test_onnx_export.py); the namespace
+    # contract here is just that it validates its inputs loudly
+    with pytest.raises(ValueError, match="input_spec"):
         paddle.onnx.export(paddle.nn.Linear(2, 2), "m")
