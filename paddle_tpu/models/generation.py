@@ -1,0 +1,109 @@
+"""Autoregressive generation (ref: PaddleNLP GenerationMixin.generate —
+the reference ecosystem's decode API).
+
+TPU-native decode: the prefill runs once over the prompt, then each
+step feeds ONE new token with the layer KV caches carried forward —
+attention runs at sq=1 against the cached sk, the decode shape the
+Pallas flash kernel's bottom-right causal alignment (q_offset) was
+built for.  Sampling draws from the framework RNG (``paddle.seed``
+deterministic).
+
+Models without cache plumbing fall back to full-prefix recompute per
+step (``use_cache=False``) — identical tokens, O(n^2) instead of O(n).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..random_state import default_generator
+
+__all__ = ["generate"]
+
+
+def _sample(logits_row, decode_strategy, temperature, top_k, top_p):
+    """One next-token choice from [B, V] logits."""
+    if decode_strategy in ("greedy_search", "greedy"):
+        return jnp.argmax(logits_row, axis=-1)
+    logits = logits_row.astype(jnp.float32)
+    if temperature and temperature != 1.0:
+        logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -int(top_k)][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set of tokens whose mass reaches top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    key = default_generator.next_key()
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens: int = 20,
+             max_length: Optional[int] = None,
+             decode_strategy: str = "greedy_search",
+             temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: Optional[int] = None,
+             use_cache: bool = True, **unused):
+    """Returns a Tensor [B, S_prompt + n_generated] of token ids."""
+    import inspect
+    ids = input_ids if isinstance(input_ids, Tensor) else Tensor(
+        np.asarray(input_ids))
+    if max_length is not None:
+        max_new_tokens = max(int(max_length) - ids.shape[1], 0)
+    # bound by the model's position table: rope/position embeddings have
+    # nothing past max_position_embeddings
+    max_pos = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+    if max_pos is not None:
+        room = int(max_pos) - ids.shape[1]
+        if room <= 0:
+            raise ValueError(
+                f"prompt length {ids.shape[1]} already reaches "
+                f"max_position_embeddings {max_pos}")
+        max_new_tokens = min(int(max_new_tokens), room)
+    # cache support is a SIGNATURE property — probing with try/except
+    # TypeError would swallow genuine bugs inside the cache path
+    fwd = model.forward if hasattr(model, "forward") else model
+    params = inspect.signature(fwd).parameters
+    supports_cache = use_cache and "use_cache" in params
+    last_only = supports_cache and "last_logits_only" in params
+    was_training = getattr(model, "training", False)
+    if hasattr(model, "eval"):
+        model.eval()
+    try:
+        arr = jnp.asarray(ids._data)
+        finished = jnp.zeros((arr.shape[0],), bool)
+        past = None
+        if supports_cache:
+            kw = {"last_logits_only": True} if last_only else {}
+            logits, past = model(Tensor(arr), use_cache=True, **kw)
+        else:
+            logits = model(Tensor(arr))
+        for _ in range(int(max_new_tokens)):
+            nxt = _sample(jnp.asarray(logits._data)[:, -1, :],
+                          decode_strategy, temperature, top_k, top_p)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            arr = jnp.concatenate([arr, nxt[:, None].astype(arr.dtype)],
+                                  axis=1)
+            if eos_token_id is not None and bool(finished.all()):
+                break
+            if supports_cache:
+                logits, past = model(Tensor(arr[:, -1:]), past=past,
+                                     use_cache=True)
+            else:
+                logits = model(Tensor(arr))
+    finally:
+        if was_training and hasattr(model, "train"):
+            model.train()
+    return Tensor(arr)

@@ -225,6 +225,12 @@ class GPTForPretraining(nn.Layer):
         logits = paddle.matmul(h, w, transpose_y=True)  # [B, S, V]
         return sharding_constraint(logits, ("dp", "sharding"), None, "mp")
 
+    def generate(self, input_ids, **kwargs):
+        """ref: PaddleNLP GenerationMixin.generate (full-prefix decode —
+        GPT carries no KV-cache plumbing; see models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, **kwargs)
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """Next-token cross entropy (vocab-parallel safe)."""

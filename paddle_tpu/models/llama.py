@@ -140,16 +140,30 @@ class LlamaAttention(nn.Layer):
                                c.rope_theta)
         self._cos, self._sin = jnp.asarray(cos), jnp.asarray(sin)
 
-    def forward(self, x):
+    def forward(self, x, past=None, use_cache: bool = False):
+        """``past``: optional (k, v) cache of shape [B, S_past, Hkv, D]
+        (kv heads UN-broadcast — the decode-shape flash kernel and the
+        XLA bottom-right causal mask both consume sq < sk directly).
+        With ``use_cache`` returns (out, (k_full, v_full))."""
         from ..incubate.nn.functional import fused_rotary_position_embedding
         B, S, H = x.shape
+        pos0 = past[0].shape[1] if past is not None else 0
+        if pos0 + S > self._cos.shape[0]:
+            raise ValueError(
+                f"sequence position {pos0 + S} exceeds "
+                f"max_position_embeddings {self._cos.shape[0]} — the "
+                "rope table has no entries past that point")
         q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([B, S, self.num_kv, self.head_dim])
         v = self.v_proj(x).reshape([B, S, self.num_kv, self.head_dim])
-        cos = Tensor(self._cos[:S])
-        sin = Tensor(self._sin[:S])
+        cos = Tensor(self._cos[pos0:pos0 + S])
+        sin = Tensor(self._sin[pos0:pos0 + S])
         q, k, _ = fused_rotary_position_embedding(
             q, k, sin=sin, cos=cos, use_neox_rotary_style=False)
+        if past is not None:
+            k = paddle.concat([past[0], k], axis=1)
+            v = paddle.concat([past[1], v], axis=1)
+        new_past = (k, v) if use_cache else None
         # GQA kv heads stay un-broadcast: sdpa repeats only for paths
         # that need it (the Pallas kernel broadcasts in its index maps)
         q = sharding_constraint(q, None, None, "mp", None)
@@ -159,7 +173,8 @@ class LlamaAttention(nn.Layer):
                                              training=self.training)
         out = out.reshape([B, S, self.num_heads * self.head_dim])
         out = sharding_constraint(out, None, None, "mp")
-        return self.o_proj(out)
+        out = self.o_proj(out)
+        return (out, new_past) if use_cache else out
 
 
 class LlamaMLP(nn.Layer):
@@ -193,8 +208,14 @@ class LlamaDecoderLayer(nn.Layer):
                                                      config.rms_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def forward(self, x, past=None, use_cache: bool = False):
+        if use_cache:
+            h, new_past = self.self_attn(self.input_layernorm(x),
+                                         past=past, use_cache=True)
+            x = x + h
+            return x + self.mlp(self.post_attention_layernorm(x)), \
+                new_past
+        x = x + self.self_attn(self.input_layernorm(x), past=past)
         return x + self.mlp(self.post_attention_layernorm(x))
 
 
@@ -211,7 +232,7 @@ class LlamaModel(nn.Layer):
                                     for _ in range(c.num_layers)])
         self.norm = LlamaRMSNorm(c.hidden_size, c.rms_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, past=None, use_cache: bool = False):
         c = self.config
         x = self.embed_tokens(input_ids)
         from ..distributed.fleet.meta_parallel.segment_parallel import (
@@ -224,8 +245,20 @@ class LlamaModel(nn.Layer):
             x = sharding_constraint(x, ("dp", "sharding"), "mp", None)
         else:
             x = sharding_constraint(x, ("dp", "sharding"), None, None)
-        for layer in self.layers:
-            if c.use_recompute and self.training:
+        if use_cache:
+            new_pasts = []
+            for i, layer in enumerate(self.layers):
+                x, p = layer(x, past=past[i] if past is not None else None,
+                             use_cache=True)
+                new_pasts.append(p)
+            return self.norm(x), new_pasts
+        for i, layer in enumerate(self.layers):
+            if past is not None:
+                # a provided cache must be consumed even when the caller
+                # doesn't want a new one — dropping it would score the
+                # tokens with no history
+                x = layer(x, past=past[i])
+            elif c.use_recompute and self.training:
                 x = recompute(layer, x)
             else:
                 x = layer(x)
@@ -246,12 +279,28 @@ class LlamaForCausalLM(nn.Layer):
                     std=config.initializer_range)))
         self.loss_fn = LlamaPretrainingCriterion()
 
-    def forward(self, input_ids):
-        h = self.llama(input_ids)
+    def forward(self, input_ids, past=None, use_cache: bool = False,
+                last_logits_only: bool = False):
+        if use_cache:
+            h, new_past = self.llama(input_ids, past=past, use_cache=True)
+        else:
+            h = self.llama(input_ids, past=past)
+        if last_logits_only:
+            # decode only samples the last position — skip the [S, V]
+            # lm_head matmul for the rest of the prompt
+            h = h[:, -1:]
         w = (self.llama.embed_tokens.weight
              if self.config.tie_word_embeddings else self.lm_head_weight)
         logits = paddle.matmul(h, w, transpose_y=True)
-        return sharding_constraint(logits, ("dp", "sharding"), None, "mp")
+        logits = sharding_constraint(logits, ("dp", "sharding"), None,
+                                     "mp")
+        return (logits, new_past) if use_cache else logits
+
+    def generate(self, input_ids, **kwargs):
+        """ref: PaddleNLP GenerationMixin.generate — greedy / sampling
+        decode with the KV cache (see models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, **kwargs)
 
 
 class LlamaPretrainingCriterion(nn.Layer):
