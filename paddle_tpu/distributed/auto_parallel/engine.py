@@ -37,26 +37,170 @@ class Engine:
             from ...jit.train_step import TrainStep
             pm = get_mesh()
             mesh = pm.jax_mesh if pm is not None else None
-
-            def step_fn(model, *batch):
-                inputs, labels = batch[0], batch[1:]
-                out = model(inputs)
-                if callable(self._loss):
-                    return self._loss(out, *labels)
-                raise ValueError("Engine needs a callable loss")
-
             self._train_step = TrainStep(self._model, None, self._optimizer,
-                                         mesh=mesh, step_fn=step_fn)
+                                         mesh=mesh,
+                                         step_fn=self._step_fn())
         return self._train_step
 
     # -- reference API ----------------------------------------------------
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         self._ensure_step()
 
+    def tune(self, sample_inputs, sample_labels=None, candidates=None,
+             profile: Optional[bool] = None):
+        """Search mesh factorizations for the fastest step (ref:
+        auto_parallel/static/tuner/ — the rule-based + profile search).
+
+        Candidates are (dp, sharding, mp) factorizations of the device
+        count; the model's GSPMD placement annotations name AXES, so the
+        same annotated model lowers under each candidate mesh without
+        re-annotation.  Scoring: the XLA cost model (``Engine.cost``
+        time_ms) by default, or measured wall time with ``profile=True``.
+        Parameters and optimizer state are snapshotted around each
+        candidate's trial step and restored, the winning mesh is
+        installed, and a report lands in ``self.tuning_report``."""
+        import time as _time
+        import jax
+        from ..mesh import build_mesh, set_mesh, get_mesh as _get_raw
+        from ...jit.train_step import TrainStep
+
+        if profile is None:
+            profile = bool(self._strategy.tuning.profile)
+        n = len(jax.devices())
+        if candidates is None:
+            candidates = self._strategy.tuning.candidates
+        if candidates is None:
+            candidates = []
+            for mp in (d for d in range(1, n + 1) if n % d == 0):
+                rest = n // mp
+                for sh in (d for d in range(1, rest + 1) if rest % d == 0):
+                    candidates.append((rest // sh, sh, mp))
+
+        batch = [np.asarray(sample_inputs)]
+        if sample_labels is not None:
+            if isinstance(sample_labels, (list, tuple)):
+                batch.extend(np.asarray(l) for l in sample_labels)
+            else:
+                batch.append(np.asarray(sample_labels))
+
+        from ...random_state import default_generator
+
+        def snapshot():
+            params = [p._data for p in self._model.parameters()]
+            bufs = [b._data for b in self._model.buffers()]
+            rng = default_generator.get_state()
+            opt = None
+            if self._optimizer is not None:
+                opt = ({k: dict(v) for k, v in
+                        self._optimizer._accumulators.items()},
+                       dict(self._optimizer._master_weights))
+            return params, bufs, rng, opt
+
+        def restore(snap):
+            params, bufs, rng, opt = snap
+            for p, v in zip(self._model.parameters(), params):
+                p._data = v
+            # trial steps advance buffers (BN running stats) and the
+            # global RNG — both must roll back or tuning skews training
+            for b, v in zip(self._model.buffers(), bufs):
+                b._data = v
+            default_generator.set_state(rng)
+            if opt is not None and self._optimizer is not None:
+                from collections import defaultdict
+                self._optimizer._accumulators = defaultdict(
+                    dict, {k: dict(v) for k, v in opt[0].items()})
+                self._optimizer._master_weights = dict(opt[1])
+
+        prev_mesh = _get_raw()
+        snap = snapshot()
+        report = []
+        best = None
+        for dp, sh, mp in candidates:
+            entry = {"dp": dp, "sharding": sh, "mp": mp}
+            try:
+                mesh = build_mesh({"dp": dp, "pp": 1, "sharding": sh,
+                                   "sep": 1, "cp": 1, "ep": 1, "mp": mp})
+                set_mesh(mesh)
+                step = TrainStep(self._model, None, self._optimizer,
+                                 mesh=mesh, step_fn=self._step_fn(),
+                                 donate=False)
+                t0 = _time.perf_counter()
+                loss = step(*batch)
+                float(loss)                       # force execution
+                entry["compile_plus_step_s"] = round(
+                    _time.perf_counter() - t0, 3)
+                if profile:
+                    t0 = _time.perf_counter()
+                    float(step(*batch))
+                    entry["step_s"] = _time.perf_counter() - t0
+                    score = entry["step_s"]
+                else:
+                    self._train_step = step
+                    c = self.cost()
+                    entry["time_ms"], entry["memory_bytes"] = (
+                        c if c is not None else (None, None))
+                    score = entry["time_ms"] if c is not None else \
+                        entry["compile_plus_step_s"] * 1e3
+                entry["score"] = score
+                if best is None or score < best[0]:
+                    best = (score, (dp, sh, mp), mesh)
+            except Exception as e:  # noqa: BLE001 — a candidate that
+                entry["error"] = str(e)[-200:]    # can't lower is skipped
+            finally:
+                restore(snap)
+                self._train_step = None
+            report.append(entry)
+        self.tuning_report = report
+        if best is None:
+            set_mesh(prev_mesh)
+            raise RuntimeError(
+                f"Engine.tune: no candidate compiled; report: {report}")
+        _, (dp, sh, mp), mesh = best
+        set_mesh(mesh)
+        # a previously installed ProcessMesh would override the winner in
+        # _ensure_step (api.get_mesh is consulted first) — clear it so
+        # the tuned raw mesh governs
+        from . import api as _api
+        _api._auto_mesh = None
+        self._train_step = None       # rebuilt lazily under the winner
+        return {"dp": dp, "sharding": sh, "mp": mp, "report": report}
+
+    def _step_fn(self):
+        def step_fn(model, *batch):
+            inputs, labels = batch[0], batch[1:]
+            out = model(inputs)
+            if callable(self._loss):
+                return self._loss(out, *labels)
+            raise ValueError("Engine needs a callable loss")
+        return step_fn
+
     def fit(self, train_data, train_sample_split=None, batch_size=1,
             epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
             **kwargs):
         from ...io import DataLoader
+        if getattr(self._strategy.tuning, "enable", False) and \
+                self._train_step is None:
+            if not hasattr(train_data, "__getitem__"):
+                import warnings
+                warnings.warn(
+                    "strategy.tuning.enable is set but fit() received an "
+                    "iterable dataset (no __getitem__) — skipping the "
+                    "mesh search; call engine.tune(sample) explicitly",
+                    RuntimeWarning)
+            else:
+                # strategy.tuning.enable: search the mesh before training
+                # (ref: Engine._tune on the first fit).  Samples are
+                # UNBATCHED dataset items — always stack batch_size of
+                # them (no shape heuristics: a 1-d feature equal in
+                # length to batch_size is still a single sample)
+                sample = train_data[0]
+                sample = sample if isinstance(sample, (list, tuple)) \
+                    else [sample]
+                xs = [np.asarray(getattr(s, "numpy", lambda: s)())
+                      for s in sample]
+                batched = [np.stack([x] * max(int(batch_size), 1))
+                           for x in xs]
+                self.tune(batched[0], batched[1:] or None)
         step = self._ensure_step()
         loader = train_data if hasattr(train_data, "__iter__") and \
             not hasattr(train_data, "__getitem__") else DataLoader(

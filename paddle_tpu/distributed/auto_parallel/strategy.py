@@ -45,6 +45,13 @@ class MPOptimizationConfig(_Config):
         super().__init__(enable=False, replace_with_parallel_cross_entropy=False)
 
 
+class TuningConfig(_Config):
+    """ref: tuner/ config — rule-based + profile search knobs."""
+
+    def __init__(self):
+        super().__init__(enable=False, profile=False, candidates=None)
+
+
 class Strategy(_Config):
     def __init__(self, config=None):
         super().__init__()
@@ -54,6 +61,7 @@ class Strategy(_Config):
         self.pipeline = PipelineConfig()
         self.gradient_merge = GradientMergeConfig()
         self.mp_optimization = MPOptimizationConfig()
+        self.tuning = TuningConfig()
         self.split_data = True
         self.seed = None
         if config:

@@ -155,3 +155,36 @@ def test_pipeline_layer_segmentation():
     x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
     y = pl(x)
     assert y.shape == [2, 8]
+
+
+def test_engine_tuner_selects_a_mesh():
+    """Engine.tune (ref: auto_parallel tuner): search (dp, sharding, mp)
+    factorizations, score with the XLA cost model, install the winner —
+    params restored between trials."""
+    _fresh()
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.strategy import Strategy
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    w0 = model[0].weight.numpy().copy()
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    eng = Engine(model, loss=lambda out, y: ((out - y) ** 2).mean(),
+                 optimizer=o, strategy=Strategy())
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 16).astype(np.float32)
+    y = rs.randn(8, 8).astype(np.float32)
+    got = eng.tune(x, y, candidates=[(8, 1, 1), (2, 2, 2), (1, 1, 8)])
+    assert {"dp", "sharding", "mp", "report"} <= set(got)
+    assert got["dp"] * got["sharding"] * got["mp"] == 8
+    assert len(eng.tuning_report) == 3
+    scored = [e for e in eng.tuning_report if "score" in e]
+    assert scored, eng.tuning_report
+    # trial steps must not have trained the model
+    np.testing.assert_array_equal(model[0].weight.numpy(), w0)
+    # and the engine trains under the winning mesh afterwards
+    from paddle_tpu.io import TensorDataset
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    hist = eng.fit(ds, batch_size=8, epochs=1)
+    assert np.isfinite(hist["loss"]).all()
