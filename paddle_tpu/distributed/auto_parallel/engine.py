@@ -65,7 +65,8 @@ class Engine:
         from ...jit.train_step import TrainStep
 
         if profile is None:
-            profile = bool(self._strategy.tuning.profile)
+            profile = bool(getattr(self._strategy.tuning, "profile",
+                                   False))
         n = len(jax.devices())
         if candidates is None:
             candidates = self._strategy.tuning.candidates
@@ -129,21 +130,22 @@ class Engine:
                 float(loss)                       # force execution
                 entry["compile_plus_step_s"] = round(
                     _time.perf_counter() - t0, 3)
-                if profile:
+                # ONE scoring basis for every candidate: wall time of
+                # post-compile steps (the executable is cached, so this
+                # is cheap and comparable; the cost model can report
+                # flops=0 on some backends, which would make every
+                # candidate tie at 0)
+                reps = 3 if profile else 1
+                times = []
+                for _ in range(reps):
                     t0 = _time.perf_counter()
                     float(step(*batch))
-                    entry["step_s"] = _time.perf_counter() - t0
-                    score = entry["step_s"]
-                else:
-                    self._train_step = step
-                    c = self.cost()
-                    entry["time_ms"], entry["memory_bytes"] = (
-                        c if c is not None else (None, None))
-                    score = entry["time_ms"] if c is not None else \
-                        entry["compile_plus_step_s"] * 1e3
+                    times.append(_time.perf_counter() - t0)
+                entry["step_s"] = sorted(times)[reps // 2]
+                score = entry["step_s"]
                 entry["score"] = score
                 if best is None or score < best[0]:
-                    best = (score, (dp, sh, mp), mesh)
+                    best = (score, (dp, sh, mp), mesh, step)
             except Exception as e:  # noqa: BLE001 — a candidate that
                 entry["error"] = str(e)[-200:]    # can't lower is skipped
             finally:
@@ -155,14 +157,16 @@ class Engine:
             set_mesh(prev_mesh)
             raise RuntimeError(
                 f"Engine.tune: no candidate compiled; report: {report}")
-        _, (dp, sh, mp), mesh = best
+        _, (dp, sh, mp), mesh, win_step = best
         set_mesh(mesh)
         # a previously installed ProcessMesh would override the winner in
         # _ensure_step (api.get_mesh is consulted first) — clear it so
         # the tuned raw mesh governs
         from . import api as _api
         _api._auto_mesh = None
-        self._train_step = None       # rebuilt lazily under the winner
+        # reuse the winner's already-compiled step — rebuilding would pay
+        # a third compile of the same program
+        self._train_step = win_step
         return {"dp": dp, "sharding": sh, "mp": mp, "report": report}
 
     def _step_fn(self):
@@ -200,7 +204,13 @@ class Engine:
                       for s in sample]
                 batched = [np.stack([x] * max(int(batch_size), 1))
                            for x in xs]
-                self.tune(batched[0], batched[1:] or None)
+                try:
+                    self.tune(batched[0], batched[1:] or None)
+                except Exception as e:  # noqa: BLE001
+                    import warnings
+                    warnings.warn(
+                        f"mesh tuning failed ({e}); training continues "
+                        "under the current mesh", RuntimeWarning)
         step = self._ensure_step()
         loader = train_data if hasattr(train_data, "__iter__") and \
             not hasattr(train_data, "__getitem__") else DataLoader(

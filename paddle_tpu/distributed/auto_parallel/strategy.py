@@ -66,4 +66,11 @@ class Strategy(_Config):
         self.seed = None
         if config:
             for k, v in dict(config).items():
-                setattr(self, k, v)
+                cur = getattr(self, k, None)
+                if isinstance(cur, _Config) and isinstance(v, dict):
+                    # the reference's dict-config shape merges into the
+                    # typed sub-config, it doesn't replace it
+                    for kk, vv in v.items():
+                        setattr(cur, kk, vv)
+                else:
+                    setattr(self, k, v)

@@ -1,0 +1,148 @@
+"""Inference serving — the deployment wrapper over Predictor.
+
+ref role: the reference deploys AnalysisPredictor behind Paddle
+Serving / FastDeploy HTTP endpoints (separate repos; SURVEY.md L8 plans
+"jit.save artifact + serving wrapper" for this framework).
+
+TPU-native: a threaded stdlib HTTP server over a :class:`Predictor`.
+The wire format is npz both ways (dense arrays, zero deps):
+
+- ``POST /predict`` — body: ``np.savez`` of named inputs (or positional
+  ``input_0..``); response: npz of ``output_i`` arrays.
+- ``GET /health`` — JSON with the model's input names and a serving
+  counter.
+
+The predictor executes under a lock (jit executables are thread-safe
+but the handle-feed API is stateful); batching across requests is the
+caller's concern.  ``warmup()`` pre-compiles the executable for given
+shapes so the first request doesn't pay compile latency (the AOT
+contract).
+"""
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import Config, Predictor, create_predictor
+
+__all__ = ["InferenceServer", "serve", "predict_http"]
+
+
+class InferenceServer:
+    """Serve one Predictor over HTTP."""
+
+    def __init__(self, predictor, host: str = "127.0.0.1", port: int = 0):
+        if isinstance(predictor, Config):
+            predictor = create_predictor(predictor)
+        self.predictor = predictor
+        self._lock = threading.Lock()
+        self._served = 0
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):        # quiet
+                pass
+
+            def _reply(self, code, body, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/health":
+                    self._reply(404, b'{"error": "unknown path"}')
+                    return
+                info = {"status": "ok",
+                        "inputs": outer.predictor.get_input_names(),
+                        "served": outer._served}
+                self._reply(200, json.dumps(info).encode())
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, b'{"error": "unknown path"}')
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = np.load(io.BytesIO(self.rfile.read(n)),
+                                      allow_pickle=False)
+                    names = outer.predictor.get_input_names()
+                    inputs = [payload[k] if k in payload.files
+                              else payload[payload.files[i]]
+                              for i, k in enumerate(names)]
+                    with outer._lock:
+                        outs = outer.predictor.run(inputs)
+                        outer._served += 1
+                    buf = io.BytesIO()
+                    np.savez(buf, **{f"output_{i}": o
+                                     for i, o in enumerate(outs)})
+                    self._reply(200, buf.getvalue(),
+                                "application/octet-stream")
+                except Exception as e:  # noqa: BLE001 — a bad request
+                    # must answer the client, not kill the server thread
+                    self._reply(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def warmup(self, example_inputs: Sequence[np.ndarray]):
+        """Pre-compile for these input shapes (AOT: the first real
+        request pays no compile)."""
+        with self._lock:
+            self.predictor.run([np.asarray(a) for a in example_inputs])
+        return self
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 0,
+          **config_kw) -> InferenceServer:
+    """One-call server over a ``paddle.jit.save`` artifact."""
+    cfg = Config(model_prefix + ".pdmodel", model_prefix + ".pdiparams")
+    for k, v in config_kw.items():
+        setattr(cfg, k, v)
+    return InferenceServer(cfg, host=host, port=port).start()
+
+
+def predict_http(url: str, *inputs: np.ndarray,
+                 timeout: float = 30.0):
+    """Minimal client for :class:`InferenceServer` (npz wire format)."""
+    import urllib.request
+    buf = io.BytesIO()
+    np.savez(buf, **{f"input_{i}": np.asarray(a)
+                     for i, a in enumerate(inputs)})
+    req = urllib.request.Request(url.rstrip("/") + "/predict",
+                                 data=buf.getvalue(), method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        if resp.status != 200:
+            raise RuntimeError(f"server error {resp.status}")
+        payload = np.load(io.BytesIO(resp.read()), allow_pickle=False)
+        return [payload[k] for k in sorted(payload.files)]

@@ -188,3 +188,12 @@ def test_engine_tuner_selects_a_mesh():
     ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
     hist = eng.fit(ds, batch_size=8, epochs=1)
     assert np.isfinite(hist["loss"]).all()
+
+
+def test_strategy_dict_config_merges_tuning():
+    from paddle_tpu.distributed.auto_parallel.strategy import (
+        Strategy, TuningConfig)
+    s = Strategy({"tuning": {"enable": True, "profile": True}})
+    assert isinstance(s.tuning, TuningConfig)
+    assert s.tuning.enable and s.tuning.profile
+    assert s.tuning.candidates is None     # unspecified keys keep defaults

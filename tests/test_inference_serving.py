@@ -1,0 +1,71 @@
+"""Inference serving wrapper (SURVEY L8: jit.save artifact + serving
+path) — save, serve over HTTP, predict from a client, parity vs eager."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference.serving import (InferenceServer, predict_http,
+                                          serve)
+from paddle_tpu.jit import save as jit_save
+from paddle_tpu.jit.to_static import InputSpec
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    m.eval()
+    prefix = str(tmp_path_factory.mktemp("srv") / "model")
+    jit_save(m, prefix, input_spec=[InputSpec([None, 8], "float32")])
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    want = m(paddle.to_tensor(x)).numpy()
+    return prefix, x, want
+
+
+def test_serve_predict_roundtrip(artifact):
+    prefix, x, want = artifact
+    srv = serve(prefix)
+    try:
+        # health endpoint
+        with urllib.request.urlopen(srv.url + "/health", timeout=10) as r:
+            info = json.loads(r.read())
+        assert info["status"] == "ok"
+        assert info["inputs"] == ["input_0"]
+        # npz predict roundtrip — parity with the eager model
+        outs = predict_http(srv.url, x)
+        np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+        # counter advanced
+        with urllib.request.urlopen(srv.url + "/health", timeout=10) as r:
+            assert json.loads(r.read())["served"] == 1
+    finally:
+        srv.stop()
+
+
+def test_warmup_and_context_manager(artifact):
+    prefix, x, want = artifact
+    from paddle_tpu.inference import Config
+    cfg = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    with InferenceServer(cfg) as srv:
+        srv.warmup([x])                 # AOT: compile before serving
+        outs = predict_http(srv.url, x)
+        np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_bad_request_answers_400(artifact):
+    prefix, _, _ = artifact
+    srv = serve(prefix)
+    try:
+        req = urllib.request.Request(srv.url + "/predict",
+                                     data=b"not-an-npz", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        # the server thread survives a bad request
+        with urllib.request.urlopen(srv.url + "/health", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+    finally:
+        srv.stop()
