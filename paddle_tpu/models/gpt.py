@@ -96,12 +96,17 @@ class MultiHeadAttention(nn.Layer):
             c.hidden_size, c.hidden_size, weight_attr=init, has_bias=True,
             input_is_parallel=True)
 
-    def forward(self, x, training: bool = True):
+    def forward(self, x, training: bool = True, past=None,
+                use_cache: bool = False):
         B, S, H = x.shape
         qkv = self.qkv_proj(x)                     # [B, S, 3H] (mp-sharded)
         # flash layout [B, S, nh, hd]; heads are the mp-sharded dim
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if past is not None:
+            k = paddle.concat([past[0], k], axis=1)
+            v = paddle.concat([past[1], v], axis=1)
+        new_past = (k, v) if use_cache else None
         q = sharding_constraint(q, None, None, "mp", None)
         k = sharding_constraint(k, None, None, "mp", None)
         v = sharding_constraint(v, None, None, "mp", None)
@@ -110,7 +115,8 @@ class MultiHeadAttention(nn.Layer):
             is_causal=True, training=training)     # [B, S, nh, hd]
         out = out.reshape([B, S, H])
         out = sharding_constraint(out, None, None, "mp")
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        return (out, new_past) if use_cache else out
 
 
 class GPTMLP(nn.Layer):
@@ -141,13 +147,18 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(c)
         self.drop_p = c.hidden_dropout_prob
 
-    def forward(self, x):
-        h = self.attn(self.ln1(x), training=self.training)
+    def forward(self, x, past=None, use_cache: bool = False):
+        if use_cache:
+            h, new_past = self.attn(self.ln1(x), training=self.training,
+                                    past=past, use_cache=True)
+        else:
+            h = self.attn(self.ln1(x), training=self.training, past=past)
         h = F.dropout(h, self.drop_p, training=self.training)
         x = x + h
         h = self.mlp(self.ln2(x))
         h = F.dropout(h, self.drop_p, training=self.training)
-        return x + h
+        x = x + h
+        return (x, new_past) if use_cache else x
 
 
 class GPTEmbeddings(nn.Layer):
@@ -162,9 +173,14 @@ class GPTEmbeddings(nn.Layer):
             weight_attr=ParamAttr(initializer=Normal(std=c.initializer_range)))
         self.drop_p = c.hidden_dropout_prob
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, pos_offset: int = 0):
         S = input_ids.shape[-1]
-        pos = paddle.arange(0, S, dtype="int64")
+        if pos_offset + S > self.position_embeddings.weight.shape[0]:
+            raise ValueError(
+                f"sequence position {pos_offset + S} exceeds "
+                "max_position_embeddings "
+                f"{self.position_embeddings.weight.shape[0]}")
+        pos = paddle.arange(pos_offset, pos_offset + S, dtype="int64")
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         return F.dropout(x, self.drop_p, training=self.training)
 
@@ -180,9 +196,10 @@ class GPTModel(nn.Layer):
                                     for _ in range(config.num_layers)])
         self.final_ln = nn.LayerNorm(config.hidden_size, epsilon=1e-5)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, past=None, use_cache: bool = False):
         c = self.config
-        x = self.embeddings(input_ids)
+        pos0 = past[0][0].shape[1] if past is not None else 0
+        x = self.embeddings(input_ids, pos_offset=pos0)
         # dp over batch; the sequence dim is sharded between blocks by
         # whichever long-context mechanism is live: sep/cp axis from the
         # fleet topology (Ulysses/ring — attention itself runs sharded),
@@ -197,8 +214,17 @@ class GPTModel(nn.Layer):
             x = sharding_constraint(x, ("dp", "sharding"), "mp", None)
         else:
             x = sharding_constraint(x, ("dp", "sharding"), None, None)
-        for block in self.layers:
-            if c.use_recompute and self.training:
+        if use_cache:
+            new_pasts = []
+            for i, block in enumerate(self.layers):
+                x, p = block(x, past=past[i] if past is not None
+                             else None, use_cache=True)
+                new_pasts.append(p)
+            return self.final_ln(x), new_pasts
+        for i, block in enumerate(self.layers):
+            if past is not None:
+                x = block(x, past=past[i])
+            elif c.use_recompute and self.training:
                 x = recompute(block, x)
             else:
                 x = block(x)
@@ -218,16 +244,24 @@ class GPTForPretraining(nn.Layer):
                 attr=ParamAttr(initializer=Normal(std=config.initializer_range)))
         self.loss_fn = GPTPretrainingCriterion()
 
-    def forward(self, input_ids):
-        h = self.gpt(input_ids)                       # [B, S, H]
+    def forward(self, input_ids, past=None, use_cache: bool = False,
+                last_logits_only: bool = False):
+        if use_cache:
+            h, new_past = self.gpt(input_ids, past=past, use_cache=True)
+        else:
+            h = self.gpt(input_ids, past=past)        # [B, S, H]
+        if last_logits_only:
+            h = h[:, -1:]
         w = (self.gpt.embeddings.word_embeddings.weight
              if self.config.tie_word_embeddings else self.lm_head_weight)
         logits = paddle.matmul(h, w, transpose_y=True)  # [B, S, V]
-        return sharding_constraint(logits, ("dp", "sharding"), None, "mp")
+        logits = sharding_constraint(logits, ("dp", "sharding"), None,
+                                     "mp")
+        return (logits, new_past) if use_cache else logits
 
     def generate(self, input_ids, **kwargs):
-        """ref: PaddleNLP GenerationMixin.generate (full-prefix decode —
-        GPT carries no KV-cache plumbing; see models/generation.py)."""
+        """ref: PaddleNLP GenerationMixin.generate — KV-cache decode
+        (see models/generation.py)."""
         from .generation import generate
         return generate(self, input_ids, **kwargs)
 

@@ -92,13 +92,31 @@ def test_sampling_respects_seed_and_eos():
         assert (row[hits[0]:] == 5).all()
 
 
-def test_gpt_generate_no_cache_path():
+def test_gpt_cached_decode_matches_full_recompute():
     paddle.seed(3)
-    m = GPTForPretraining(gpt_config("tiny"))
+    m = GPTForPretraining(gpt_config("tiny", hidden_dropout_prob=0.0,
+                                     attention_dropout_prob=0.0))
     ids = np.array([[4, 8, 15]], np.int64)
-    out = m.generate(Tensor(ids), max_new_tokens=5).numpy()
-    assert out.shape == (1, 8)
-    np.testing.assert_array_equal(out[:, :3], ids)
+    cached = m.generate(Tensor(ids), max_new_tokens=5,
+                        use_cache=True).numpy()
+    full = m.generate(Tensor(ids), max_new_tokens=5,
+                      use_cache=False).numpy()
+    np.testing.assert_array_equal(cached, full)
+    assert cached.shape == (1, 8)
+    np.testing.assert_array_equal(cached[:, :3], ids)
+
+
+def test_gpt_cache_logits_match_full_forward():
+    paddle.seed(7)
+    m = GPTForPretraining(gpt_config("tiny", hidden_dropout_prob=0.0,
+                                     attention_dropout_prob=0.0))
+    m.eval()
+    ids = np.array([[4, 8, 15, 16, 23]], np.int64)
+    _, past = m(Tensor(ids[:, :4]), use_cache=True)
+    step_logits, _ = m(Tensor(ids[:, 4:5]), past=past, use_cache=True)
+    full = m(Tensor(ids)).numpy()
+    np.testing.assert_allclose(step_logits.numpy()[:, 0], full[:, -1],
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_max_length_alias():
@@ -122,6 +140,21 @@ def test_past_without_use_cache_is_consumed():
     """Scoring a final token with a cache but no new cache must still
     attend over the history."""
     m = _tiny_llama(6)
+    m.eval()
+    ids = np.array([[5, 9, 2, 30]], np.int64)
+    _, past = m(Tensor(ids[:, :3]), use_cache=True)
+    scored = m(Tensor(ids[:, 3:4]), past=past)
+    full = m(Tensor(ids)).numpy()
+    np.testing.assert_allclose(scored.numpy()[:, 0], full[:, -1],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_past_without_use_cache_is_consumed():
+    """Mirror of the llama coverage: GPT scoring a token with a cache
+    but no new cache must still attend over the history."""
+    paddle.seed(9)
+    m = GPTForPretraining(gpt_config("tiny", hidden_dropout_prob=0.0,
+                                     attention_dropout_prob=0.0))
     m.eval()
     ids = np.array([[5, 9, 2, 30]], np.int64)
     _, past = m(Tensor(ids[:, :3]), use_cache=True)
