@@ -223,7 +223,8 @@ def local_response_norm(x, size: int, alpha: float = 1e-4, beta: float = 0.75,
         for i in range(size):
             acc = acc + jax.lax.slice_in_dim(padded, i, i + v.shape[ch_axis],
                                              axis=ch_axis)
-        div = jnp.power(k + alpha * acc, beta)
+        # the reference (like torch) scales the window sum by alpha/size
+        div = jnp.power(k + (alpha / size) * acc, beta)
         return v / div
     return call_op(f, (x,), {}, op_name="local_response_norm")
 

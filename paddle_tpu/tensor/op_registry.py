@@ -1972,6 +1972,192 @@ _PARITY += [
 ]
 
 
+def _np_conv2d_transpose(x, w, stride=1):
+    b, cin, h, wd = x.shape
+    _, cout, kh, kw = w.shape
+    oh = (h - 1) * stride + kh
+    ow = (wd - 1) * stride + kw
+    out = np.zeros((b, cout, oh, ow), "float32")
+    for i in range(h):
+        for j in range(wd):
+            patch = np.einsum("bc,cokl->bokl", x[:, :, i, j], w)
+            out[:, :, i * stride:i * stride + kh,
+                j * stride:j * stride + kw] += patch
+    return out
+
+
+def _np_conv1d_transpose(x, w):
+    b, cin, l = x.shape
+    _, cout, k = w.shape
+    out = np.zeros((b, cout, l - 1 + k), "float32")
+    for i in range(l):
+        out[:, :, i:i + k] += np.einsum("bc,cok->bok", x[:, :, i], w)
+    return out
+
+
+def _np_conv3d(x, w):
+    b, cin, d, h, wd = x.shape
+    cout, _, kd, kh, kw = w.shape
+    od, oh, ow = d - kd + 1, h - kh + 1, wd - kw + 1
+    out = np.zeros((b, cout, od, oh, ow), "float32")
+    for a in range(od):
+        for i in range(oh):
+            for j in range(ow):
+                patch = x[:, :, a:a + kd, i:i + kh, j:j + kw]
+                out[:, :, a, i, j] = np.einsum("bcxyz,ocxyz->bo",
+                                               patch, w)
+    return out
+
+
+def _np_fold(cols, hw, k):
+    b, ckk, n = cols.shape
+    c = ckk // (k * k)
+    h, w = hw
+    oh, ow = h - k + 1, w - k + 1
+    out = np.zeros((b, c, h, w), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i:i + k, j:j + k] += \
+                cols[:, :, i * ow + j].reshape(b, c, k, k)
+    return out
+
+
+def _np_lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    b, c, h, w = x.shape
+    sq = x ** 2
+    acc = np.zeros_like(x)
+    half = size // 2
+    for ch in range(c):
+        # window [ch - half, ch - half + size) — the impl/torch extent
+        lo, hi = max(0, ch - half), min(c, ch - half + size)
+        acc[:, ch] = sq[:, lo:hi].sum(axis=1)
+    return x / (k + alpha / size * acc) ** beta
+
+
+def _np_lp_pool2d(x, p, kk):
+    b, c, h, w = x.shape
+    xr = x.reshape(b, c, h // kk, kk, w // kk, kk)
+    return (np.abs(xr) ** p).sum(axis=(3, 5)) ** (1.0 / p)
+
+
+def _np_lp_pool1d(x, p, kk):
+    b, c, l = x.shape
+    xr = x.reshape(b, c, l // kk, kk)
+    return (np.abs(xr) ** p).sum(axis=3) ** (1.0 / p)
+
+
+def _ce_case():
+    def gen():
+        rs = np.random.RandomState(33)
+        return [(rs.randn(6, 10).astype("float32"),
+                 rs.randint(0, 10, (6,)).astype("int64"))]
+    return gen
+
+
+def _np_ce(logits, labels):
+    m = logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(logits - m).sum(-1)) + m[:, 0]
+    return np.mean(lse - logits[np.arange(len(labels)), labels])
+
+
+def _sdpa_case():
+    def gen():
+        rs = np.random.RandomState(34)
+        return [tuple(rs.randn(2, 8, 2, 16).astype("float32")
+                      for _ in range(3))]
+    return gen
+
+
+def _np_sdpa(q, k, v):
+    qt = np.swapaxes(q, 1, 2)
+    kt = np.swapaxes(k, 1, 2)
+    vt = np.swapaxes(v, 1, 2)
+    s = np.einsum("bhsd,bhtd->bhst", qt, kt) / np.sqrt(q.shape[-1])
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s) / np.exp(s).sum(-1, keepdims=True)
+    return np.swapaxes(np.einsum("bhst,bhtd->bhsd", p, vt), 1, 2)
+
+
+def _complex_pair_case():
+    def gen():
+        rs = np.random.RandomState(35)
+        return [(rs.randn(3, 4, 2).astype("float32"),)]
+    return gen
+
+
+_PARITY += [
+    # ---- wave 7: stats, attention, conv/pool breadth ----
+    P("quantile", _f((3, 8), seed=60),
+      lambda x: np.quantile(x, 0.5, axis=1).astype("float32"),
+      kwargs={"q": 0.5, "axis": 1}, np_kwargs={}, tol=1e-5),
+    P("nanquantile", _special(),
+      lambda x: np.nanquantile(x, 0.5, axis=1).astype("float32"),
+      kwargs={"q": 0.5, "axis": 1}, np_kwargs={}, tol=1e-5),
+    P("nanmedian", _special(),
+      lambda x: np.nanmedian(x, axis=1).astype("float32"),
+      kwargs={"axis": 1}, np_kwargs={}, tol=1e-5),
+    P("take", _gather_case(),
+      lambda x, i: np.take(x.reshape(-1), np.clip(i, 0, x.size - 1))),
+    P("polar", _fpos((3, 4), (3, 4), seed=61),
+      lambda r, t: (r * np.exp(1j * t)).astype("complex64"), tol=1e-5),
+    P("as_complex", _complex_pair_case(),
+      lambda x: (x[..., 0] + 1j * x[..., 1]).astype("complex64")),
+    P("atleast_1d", _f((3,), seed=62), np.atleast_1d),
+    P("atleast_2d", _f((3,), seed=63), np.atleast_2d),
+    P("atleast_3d", _f((3, 4), seed=64), np.atleast_3d),
+    P("slice", _f((4, 6), seed=65),
+      lambda x: x[1:3, 2:5],
+      kwargs={"axes": [0, 1], "starts": [1, 2], "ends": [3, 5]},
+      np_kwargs={}),
+    P("crop", _f((4, 6), seed=66),
+      lambda x: x[1:3, 2:5],
+      kwargs={"shape": [2, 3], "offsets": [1, 2]}, np_kwargs={}),
+    P("unique", lambda: [(np.asarray([3.0, 1.0, 2.0, 1.0, 3.0],
+                                     "float32"),)],
+      lambda x: np.unique(x)),
+    P("broadcast_tensors", _f((3, 1), (1, 4), seed=67),
+      lambda *a: tuple(np.broadcast_arrays(*a)), list_input=True),
+    P("is_empty", _f((3, 4), seed=68), lambda x: False),
+    P("accuracy", lambda: [(np.asarray([[0.9, 0.1], [0.2, 0.8],
+                                        [0.7, 0.3]], "float32"),
+                            np.asarray([[0], [1], [1]], "int64"))],
+      lambda p, l: np.float32(2.0 / 3.0), tol=1e-6),
+    P("eigvalsh", _spd4(), np.linalg.eigvalsh, tol=1e-3),
+    P("svdvals", _f((4, 3), seed=69),
+      lambda a: np.linalg.svd(a, compute_uv=False), tol=1e-3),
+    P("nn.functional.cross_entropy", _ce_case(), _np_ce, grad=True,
+      tol=1e-4),
+    P("nn.functional.scaled_dot_product_attention", _sdpa_case(),
+      _np_sdpa, grad=True, tol=1e-4),
+    P("nn.functional.flash_attention", _sdpa_case(),
+      lambda q, k, v: (_np_sdpa(q, k, v),), tol=1e-4),
+    P("nn.functional.conv3d", _f((1, 2, 4, 4, 4), (3, 2, 2, 2, 2),
+                                 seed=70),
+      _np_conv3d, tol=1e-3),
+    P("nn.functional.conv2d_transpose",
+      _f((1, 3, 4, 4), (3, 2, 2, 2), seed=71),
+      lambda x, w: _np_conv2d_transpose(x, w, 1), tol=1e-3),
+    P("nn.functional.conv1d_transpose",
+      _f((1, 3, 5), (3, 2, 3), seed=72),
+      _np_conv1d_transpose, tol=1e-3),
+    P("nn.functional.fold", lambda: [(np.random.RandomState(73)
+                                      .randn(1, 12, 9).astype("float32"),)],
+      lambda c: _np_fold(c, (4, 4), 2),
+      kwargs={"output_sizes": [4, 4], "kernel_sizes": 2}, np_kwargs={},
+      tol=1e-4),
+    P("nn.functional.local_response_norm", _f((2, 6, 3, 3), seed=74),
+      lambda x: _np_lrn(x), kwargs={"size": 5}, np_kwargs={}, tol=1e-4),
+    P("nn.functional.lp_pool2d", _f((2, 3, 4, 4), seed=75),
+      lambda x: _np_lp_pool2d(x, 2.0, 2),
+      kwargs={"norm_type": 2.0, "kernel_size": 2}, np_kwargs={},
+      tol=1e-4),
+    P("nn.functional.lp_pool1d", _f((2, 3, 6), seed=76),
+      lambda x: _np_lp_pool1d(x, 2.0, 2),
+      kwargs={"norm_type": 2.0, "kernel_size": 2}, np_kwargs={},
+      tol=1e-4),
+]
+
+
 def _np_erase(x):
     out = x.copy()
     out[:, 1:3, 1:3] = 0.0
