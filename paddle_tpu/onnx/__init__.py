@@ -284,11 +284,14 @@ def _emit_op(e: _Emit, op) -> None:
         e.add("LayerNormalization", ln_ins, out("layernorm"),
               [pb.attr_int("axis", -1), pb.attr_float("epsilon", eps)])
         return
+    from . import _cnn
+    if _cnn.emit(e, op, ins):
+        return
     raise NotImplementedError(
         f"paddle.onnx.export: op {name!r} has no ONNX lowering in this "
         "build (supported: linear/matmul/elementwise/activations/"
-        "reshape/concat/embedding/layer_norm). Use paddle.jit.save "
-        "(StableHLO) for arbitrary programs.")
+        "reshape/concat/embedding/layer_norm/conv/pool/batch_norm). "
+        "Use paddle.jit.save (StableHLO) for arbitrary programs.")
 
 
 def export(layer, path, input_spec=None, opset_version=20, **configs):

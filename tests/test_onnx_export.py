@@ -195,3 +195,104 @@ def test_ambiguous_attr_recovery_refused(tmp_path):
     ones = paddle.to_tensor(np.ones((3, 3), np.float32))
     with pytest.raises(NotImplementedError, match="ambiguous"):
         export(M(), str(tmp_path / "amb"), input_spec=[ones])
+
+
+# ---------------------------------------------------------------------------
+# CNN op set (conv / pool / batch_norm — _cnn.py numeric attr recovery)
+# ---------------------------------------------------------------------------
+
+def _node_attrs(node_bytes):
+    """AttributeProto: name=1, f=2, i=3, ints=8 (repeated varint)."""
+    import struct
+    out = {}
+    for attr in _fields(node_bytes, 5):
+        fields = pb.read_fields(attr)
+        name = next(v for f, _, v in fields if f == 1).decode()
+        ints = [v for f, w, v in fields if f == 8 and w == 0]
+        if ints:
+            out[name] = ints
+            continue
+        i_val = next((v for f, w, v in fields if f == 3 and w == 0), None)
+        if i_val is not None:
+            out[name] = i_val
+            continue
+        f_val = next((v for f, w, v in fields if f == 2 and w == 5), None)
+        if f_val is not None:
+            out[name] = struct.unpack("<f", f_val)[0]
+    return out
+
+
+def test_export_lenet_conv_pool(tmp_path):
+    paddle.seed(2)
+    m = nn.Sequential(
+        nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(16 * 5 * 5, 10))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 1, 28, 28).astype(np.float32))
+    out_path = export(m, str(tmp_path / "lenet"), input_spec=[x])
+    _, _, nodes, _, _, _ = _decode_model(out_path)
+    ops = [_node_op(n) for n in nodes]
+    assert ops == ["Conv", "Relu", "MaxPool", "Conv", "Relu", "MaxPool",
+                   "Reshape", "MatMul", "Add"]
+    a0 = _node_attrs(nodes[0])
+    assert a0["kernel_shape"] == [5, 5]
+    assert a0["strides"] == [1, 1]
+    assert a0["pads"] == [2, 2, 2, 2]
+    assert a0["group"] == 1
+    p0 = _node_attrs(nodes[2])
+    assert p0["kernel_shape"] == [2, 2]
+    assert p0["strides"] == [2, 2]
+    a1 = _node_attrs(nodes[3])
+    assert a1["pads"] == [0, 0, 0, 0]
+
+
+def test_export_bn_block_and_strided_conv(tmp_path):
+    paddle.seed(3)
+    m = nn.Sequential(
+        nn.Conv2D(3, 8, 3, stride=2, padding=1, bias_attr=False),
+        nn.BatchNorm2D(8), nn.ReLU6(),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(8, 4))
+    m.eval()
+    # non-trivial BN stats so recovery can't mistake mean/var for 0/1
+    with paddle.no_grad():
+        m[1].weight.set_value(
+            np.random.RandomState(1).rand(8).astype(np.float32) + 0.5)
+        m[1]._mean.set_value(
+            np.random.RandomState(2).randn(8).astype(np.float32))
+        m[1]._variance.set_value(
+            np.random.RandomState(3).rand(8).astype(np.float32) + 0.5)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 16, 16).astype(np.float32))
+    ref = m(x).numpy()
+    out_path = export(m, str(tmp_path / "bnblock"), input_spec=[x])
+    _, _, nodes, _, _, _ = _decode_model(out_path)
+    ops = [_node_op(n) for n in nodes]
+    assert ops == ["Conv", "BatchNormalization", "Clip",
+                   "GlobalAveragePool", "Reshape", "MatMul", "Add"]
+    a0 = _node_attrs(nodes[0])
+    assert a0["strides"] == [2, 2]
+    assert a0["pads"] == [1, 1, 1, 1]
+    bn = _node_attrs(nodes[1])
+    assert abs(bn["epsilon"] - 1e-5) < 1e-7
+    # eval path must be unchanged by export
+    np.testing.assert_allclose(m(x).numpy(), ref, rtol=1e-6)
+
+
+def test_export_depthwise_and_avgpool(tmp_path):
+    paddle.seed(4)
+    m = nn.Sequential(
+        nn.Conv2D(4, 4, 3, padding=1, groups=4),
+        nn.Hardswish(),
+        nn.AvgPool2D(3, stride=2, padding=1))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 4, 12, 12).astype(np.float32))
+    out_path = export(m, str(tmp_path / "dw"), input_spec=[x])
+    _, _, nodes, _, _, _ = _decode_model(out_path)
+    ops = [_node_op(n) for n in nodes]
+    assert ops == ["Conv", "HardSwish", "AveragePool"]
+    assert _node_attrs(nodes[0])["group"] == 4
+    ap = _node_attrs(nodes[2])
+    assert ap["kernel_shape"] == [3, 3]
+    assert ap["strides"] == [2, 2]
+    assert ap["pads"] == [1, 1, 1, 1]
