@@ -219,6 +219,15 @@ class Tensor:
             raise TypeError("len() of a 0-D tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        # without this, python falls back to __getitem__ with growing
+        # indices — and jnp indexing CLAMPS out-of-range, so the loop
+        # never raises IndexError and iteration is infinite
+        if not self._data.shape:
+            raise TypeError("iteration over a 0-D tensor")
+        for i in range(self._data.shape[0]):
+            yield self[i]
+
     # ------------------------------------------------------------------
     # autograd
     # ------------------------------------------------------------------

@@ -286,7 +286,55 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def ormqr(x, tau, other, left=True, transpose=False, name=None):
-    raise NotImplementedError("ormqr: pending (low-priority LAPACK op)")
+    """ref: paddle.linalg.ormqr — multiply ``other`` by the implicit Q
+    of a geqrf factorization (Householder reflectors in ``x``'s lower
+    triangle, scales in ``tau``) without materializing Q.
+
+    Q = H_1 H_2 ... H_k with H_i = I - tau_i v_i v_i^T; applied as a
+    static loop over k (k is a trace-time constant, so XLA unrolls and
+    fuses the rank-1 updates)."""
+    x, tau, other = ensure_tensor(x), ensure_tensor(tau), ensure_tensor(other)
+
+    def core(a, t, c):
+        """2-D core; batch dims handled by vmap below."""
+        m = a.shape[-2]
+        k = t.shape[-1]
+
+        def reflect(i, mat, from_left, ti):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[:, i])
+            v = v.at[i].set(1.0)
+            if from_left:
+                # (I - t v v^H) @ mat
+                return mat - ti * jnp.outer(v, v.conj() @ mat)
+            # mat @ (I - t v v^H)
+            return mat - ti * jnp.outer(mat @ v, v.conj())
+
+        order = range(k)
+        # Q = H_1..H_k.  transpose applies Q^H: reversed factor order
+        # with conjugated tau (H_i^H = I - conj(t_i) v v^H; for real
+        # inputs conj is a no-op and Q^H = Q^T)
+        tc = t.conj() if transpose else t
+        if left:
+            idx = order if transpose else reversed(order)
+            out = c
+            for i in idx:
+                out = reflect(i, out, True, tc[i])
+            return out
+        idx = reversed(order) if transpose else order
+        out = c
+        for i in idx:
+            out = reflect(i, out, False, tc[i])
+        return out
+
+    def f(a, t, c):
+        if a.ndim == 2:
+            return core(a, t, c)
+        batch = a.shape[:-2]
+        fn = core
+        for _ in batch:
+            fn = jax.vmap(fn)
+        return fn(a, t, c)
+    return call_op(f, (x, tau, other), {}, op_name="ormqr")
 
 
 def householder_product(x, tau, name=None):

@@ -56,7 +56,12 @@ def swapaxes(x, axis0, axis1, name=None):
                    op_name="swapaxes")
 
 
-transpose_ = swapaxes
+def transpose_(x, perm, name=None):
+    """ref: paddle.Tensor.transpose_ — inplace transpose(x, perm)
+    (was wrongly aliased to swapaxes: different signature, not inplace)."""
+    return _inplace_op(x, transpose, perm)
+
+
 t_api = None
 
 

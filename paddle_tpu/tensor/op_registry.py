@@ -408,9 +408,14 @@ def vecdot(x, y, axis=-1, name=None):
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):
-    """ref: paddle.histogramdd."""
+    """ref: paddle.histogramdd — ``ranges`` is the reference's FLAT
+    sequence of 2*D floats (leftmost/rightmost edge per dim), converted
+    here to the per-dim pairs jnp.histogramdd expects."""
     x = ensure_tensor(x)
     w = ensure_tensor(weights) if weights is not None else None
+    if ranges is not None:
+        flat = [float(r) for r in np.asarray(ranges).reshape(-1)]
+        ranges = [tuple(flat[i:i + 2]) for i in range(0, len(flat), 2)]
 
     def impl(a, *rest):
         wa = rest[0] if rest else None
@@ -2279,6 +2284,347 @@ _EXTRA_GRAD = {
     "vision.transforms.normalize", "masked_select", "inverse", "solve",
     "cholesky", "norm", "mv", "multi_dot", "cov",
 }
+
+
+# ---------------------------------------------------------------------------
+# wave 8: linalg decompositions, special functions, inplace variants,
+# creation ops, fused incubate ops, audio/signal formulas.
+# References: scipy.special / scipy.linalg / LAPACK (via scipy) / numpy —
+# validated row-by-row against the live impls before inclusion
+# (ref: test/legacy_test/op_test.py breadth push, VERDICT r3 item 7).
+# ---------------------------------------------------------------------------
+
+def _scsp():
+    import scipy.special as s
+    return s
+
+
+def _np_qr(a):
+    q, r = np.linalg.qr(a)
+    return q.astype("float32"), r.astype("float32")
+
+
+def _np_svd(a):
+    u, s, vh = np.linalg.svd(a, full_matrices=False)
+    return u, s, vh
+
+
+def _spdg(n=4, seed=0):
+    def gen():
+        rs = np.random.RandomState(seed)
+        a = rs.randn(n, n).astype("float32")
+        return [(a @ a.T + n * np.eye(n, dtype="float32"),)]
+    return gen
+
+
+def _np_lu(a):
+    import scipy.linalg as sla
+    lu, piv = sla.lu_factor(a)
+    return lu.astype("float32"), (piv + 1).astype("int32")
+
+
+def _np_lu_unpack(lu, piv):
+    n = lu.shape[0]
+    L = np.tril(lu, -1) + np.eye(n, dtype=lu.dtype)
+    U = np.triu(lu)
+    perm = np.arange(n)
+    for i, p in enumerate(np.asarray(piv) - 1):
+        perm[i], perm[p] = perm[p], perm[i]
+    P = np.zeros((n, n), lu.dtype)
+    P[perm, np.arange(n)] = 1
+    return P, L, U
+
+
+def _lu_case(seed=84):
+    def gen():
+        import scipy.linalg as sla
+        rs = np.random.RandomState(seed)
+        a = (rs.randn(4, 4) + 4 * np.eye(4)).astype("float32")
+        lu, piv = sla.lu_factor(a)
+        return [(lu.astype("float32"), (piv + 1).astype("int32"))]
+    return gen
+
+
+def _geqrf(seed, m=4, n=3):
+    import scipy.linalg as sla
+    rs = np.random.RandomState(seed)
+    a = rs.randn(m, n).astype("float32")
+    geqrf, = sla.get_lapack_funcs(("geqrf",), (a,))
+    h, tau, _, _ = geqrf(a)
+    return h.astype("float32"), tau.astype("float32"), rs
+
+
+def _hh_case(seed=85):
+    def gen():
+        h, tau, _ = _geqrf(seed)
+        return [(h, tau)]
+    return gen
+
+
+def _np_orgqr(h, tau):
+    import scipy.linalg as sla
+    orgqr, = sla.get_lapack_funcs(("orgqr",), (h,))
+    res = orgqr(h.copy(), tau)
+    return np.asarray(res[0], "float32")
+
+
+def _ormqr_case(seed=86):
+    def gen():
+        h, tau, rs = _geqrf(seed)
+        c = rs.randn(4, 3).astype("float32")
+        return [(h, tau, c)]
+    return gen
+
+
+def _np_ormqr(h, tau, c):
+    import scipy.linalg as sla
+    ormqr_, = sla.get_lapack_funcs(("ormqr",), (h,))
+    res = ormqr_("L", "N", h.copy(), tau, c.copy(),
+                 max(1, 64 * c.shape[1]))
+    return np.asarray(res[0], "float32")
+
+
+def _np_renorm(x, p=2.0, axis=1, max_norm=1.0):
+    xs = np.moveaxis(x, axis, 0)
+    flat = xs.reshape(xs.shape[0], -1)
+    norms = (np.abs(flat) ** p).sum(1) ** (1.0 / p)
+    factor = np.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = xs * factor.reshape(-1, *([1] * (xs.ndim - 1)))
+    return np.moveaxis(out, 0, axis).astype("float32")
+
+
+def _np_stft64(x, n_fft=64, hop_length=16):
+    pad = n_fft // 2
+    xp = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode="reflect")
+    frames = [xp[..., s:s + n_fft]
+              for s in range(0, xp.shape[-1] - n_fft + 1, hop_length)]
+    spec = np.fft.rfft(np.stack(frames, axis=-2), axis=-1)
+    return np.swapaxes(spec, -1, -2).astype("complex64")
+
+
+def _if_case(seed=134):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [(rs.randn(4, 4).astype("float32"),
+                 np.asarray([0, 2], "int64"))]
+    return gen
+
+
+def _np_index_fill(x, i):
+    y = x.copy()
+    y[np.asarray(i)] = 9.0
+    return y
+
+
+def _mask_case(seed=135):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [(rs.randn(3, 4).astype("float32"),
+                 rs.rand(3, 4) > 0.5)]
+    return gen
+
+
+def _scalar_pair(a, b):
+    def gen():
+        return [(np.asarray(a, "float32"), np.asarray(b, "float32"))]
+    return gen
+
+
+_PARITY += [
+    # ---- special functions (scipy oracle) ----
+    P("erfinv", _fsym((3, 4), seed=70),
+      lambda x: _scsp().erfinv(x).astype("float32"), grad=True, tol=1e-4),
+    P("i0e", _f((3, 4), seed=71), lambda x: _scsp().i0e(x)),
+    P("i1", _f((3, 4), seed=72), lambda x: _scsp().i1(x)),
+    P("i1e", _f((3, 4), seed=73), lambda x: _scsp().i1e(x)),
+    P("gammainc", _fpos((3, 4), (3, 4), seed=74),
+      lambda a, x: _scsp().gammainc(a, x), tol=1e-4),
+    P("gammaincc", _fpos((3, 4), (3, 4), seed=75),
+      lambda a, x: _scsp().gammaincc(a, x), tol=1e-4),
+    P("matrix_exp", _f((3, 3), seed=76, scale=0.3),
+      lambda a: __import__("scipy.linalg", fromlist=["expm"]).expm(a),
+      tol=1e-4),
+    P("xlogy", _fpos((3, 4), (3, 4), seed=77),
+      lambda x, y: _scsp().xlogy(x, y), grad=True),
+    P("logcumsumexp", _f((3, 4), seed=78),
+      lambda x: np.logaddexp.accumulate(x, axis=1),
+      kwargs={"axis": 1}, np_kwargs={}, grad=True, tol=1e-4),
+    # ---- linalg decompositions (LAPACK-deterministic on CPU) ----
+    P("qr", _f((4, 3), seed=80), _np_qr, tol=1e-4),
+    P("linalg.qr", _f((4, 3), seed=80), _np_qr, tol=1e-4),
+    P("svd", _f((4, 3), seed=81), _np_svd, tol=1e-4),
+    P("linalg.svd", _f((4, 3), seed=81), _np_svd, tol=1e-4),
+    P("eigh", _spdg(4, 82), lambda a: tuple(np.linalg.eigh(a)),
+      tol=1e-3),
+    P("linalg.eigh", _spdg(4, 82), lambda a: tuple(np.linalg.eigh(a)),
+      tol=1e-3),
+    P("lu", _spdg(4, 83), _np_lu, tol=1e-3),
+    P("linalg.lu", _spdg(4, 83), _np_lu, tol=1e-3),
+    P("lu_unpack", _lu_case(), _np_lu_unpack, tol=1e-4),
+    P("linalg.lu_unpack", _lu_case(), _np_lu_unpack, tol=1e-4),
+    P("householder_product", _hh_case(), _np_orgqr, tol=1e-4),
+    P("linalg.householder_product", _hh_case(), _np_orgqr, tol=1e-4),
+    P("ormqr", _ormqr_case(), _np_ormqr, tol=1e-4),
+    P("linalg.ormqr", _ormqr_case(), _np_ormqr, tol=1e-4),
+    P("matrix_rank", _spdg(4, 87),
+      lambda a: np.asarray(np.linalg.matrix_rank(a), "int64"), tol=0.1),
+    P("cond", _spdg(4, 88),
+      lambda a: np.asarray(np.linalg.cond(a), "float32"), tol=1e-3),
+    P("linalg.histogram_bin_edges", _f((10,), seed=89),
+      lambda x: np.histogram_bin_edges(x, bins=5).astype("float32"),
+      kwargs={"bins": 5}, np_kwargs={}),
+    # ---- misc tensor ops ----
+    P("stanh", _f((3, 4), seed=90),
+      lambda x: (1.7159 * np.tanh(0.67 * x)).astype("float32"),
+      grad=True),
+    P("renorm", _f((3, 4, 2), seed=91), _np_renorm,
+      kwargs={"p": 2.0, "axis": 1, "max_norm": 1.0}, np_kwargs={},
+      grad=True),
+    P("increment", _f((1,), seed=92), lambda x: x + 1.0),
+    P("clip_by_norm", _f((3, 4), seed=93),
+      lambda x: x * min(1.0, 1.0 / np.sqrt((x ** 2).sum())),
+      kwargs={"max_norm": 1.0}, np_kwargs={}),
+    P("unbind", _f((3, 4), seed=94),
+      lambda x: tuple(x[i] for i in range(3))),
+    P("multiplex", _f((3, 4), (3, 4), seed=95),
+      lambda a, b: np.stack([a, b], 0)[np.asarray([0, 1, 0]),
+                                       np.arange(3)],
+      kwargs={"index": np.asarray([[0], [1], [0]], "int64")},
+      np_kwargs={}, list_input=True),
+    P("addmv", _f((4,), (4, 3), (3,), seed=96),
+      lambda i, x, y: i + x @ y, grad=True),
+    P("baddbmm", _f((2, 3, 5), (2, 3, 4), (2, 4, 5), seed=97),
+      lambda i, x, y: i + np.einsum("bij,bjk->bik", x, y), grad=True,
+      tol=1e-4),
+    P("block_diag", _f((2, 2), (3, 1), seed=98),
+      lambda *a: __import__("scipy.linalg", fromlist=["block_diag"])
+      .block_diag(*a), list_input=True, grad=True, tol=1e-6),
+    P("unflatten", _f((3, 8), seed=99), lambda x: x.reshape(3, 2, 4),
+      kwargs={"axis": 1, "shape": [2, 4]}, np_kwargs={}, grad=True),
+    P("index_fill", _if_case(), _np_index_fill,
+      kwargs={"axis": 0, "value": 9.0}, np_kwargs={}),
+    P("diagonal_scatter", _f((4, 4), (4,), seed=100),
+      lambda x, y: x - np.diag(np.diag(x)) + np.diag(y), grad=True,
+      tol=1e-6),
+    P("select_scatter", _f((3, 4), (3,), seed=101),
+      lambda x, v: np.concatenate(
+          [x[:, :2], v[:, None], x[:, 3:]], 1),
+      kwargs={"axis": 1, "index": 2}, np_kwargs={}, grad=True),
+    P("slice_scatter", _f((4, 6), (4, 2), seed=102),
+      lambda x, v: np.concatenate([x[:, :2], v, x[:, 4:]], 1),
+      kwargs={"axes": [1], "starts": [2], "ends": [4], "strides": [1]},
+      np_kwargs={}, grad=True),
+    P("combinations",
+      lambda: [(np.asarray([1.0, 2.0, 3.0, 4.0], "float32"),)],
+      lambda x: np.asarray([[a, b] for i, a in enumerate(x)
+                            for b in x[i + 1:]], "float32")),
+    P("view", _f((3, 4), seed=103), lambda x: x.reshape(4, 3),
+      kwargs={"shape": [4, 3]}, np_kwargs={}),
+    P("view_as", _f((3, 4), (4, 3), seed=104),
+      lambda x, o: x.reshape(o.shape)),
+    P("shard_index", lambda: [(np.asarray([[1], [5], [9]], "int64"),)],
+      lambda x: np.where(x // 4 == 1, x % 4, -1),
+      kwargs={"index_num": 12, "nshards": 3, "shard_id": 1},
+      np_kwargs={}),
+    P("histogramdd",
+      lambda: [(np.random.RandomState(137).rand(20, 2)
+                .astype("float32"),)],
+      lambda x: np.histogramdd(x, bins=4,
+                               range=[(0, 1), (0, 1)])[0]
+      .astype("float32"),
+      kwargs={"bins": 4, "ranges": (0.0, 1.0, 0.0, 1.0)},
+      np_kwargs={}, tol=1e-6),
+    # ---- inplace variants (fresh tensors per harness call) ----
+    P("zero_", _f((3, 4), seed=110), np.zeros_like),
+    P("fill_", _f((3, 4), seed=111), lambda x: np.full_like(x, 2.5),
+      kwargs={"value": 2.5}, np_kwargs={}),
+    P("floor_mod", _fpos((3, 4), (3, 4), seed=112), np.mod),
+    P("fill_diagonal_", _f((4, 4), seed=113),
+      lambda x: x - np.diag(np.diag(x)) + np.diag(
+          np.full(4, 7.0, x.dtype)),
+      kwargs={"value": 7.0}, np_kwargs={}, tol=1e-6),
+    P("masked_fill_", _mask_case(),
+      lambda x, m: np.where(m, 8.0, x).astype("float32"),
+      kwargs={"value": 8.0}, np_kwargs={}),
+    P("flip_", _f((3, 4), seed=114), lambda x: x[:, ::-1].copy(),
+      kwargs={"axis": 1}, np_kwargs={}),
+    P("squeeze_", _f((3, 1, 4), seed=115), lambda x: x.reshape(3, 4),
+      kwargs={"axis": 1}, np_kwargs={}),
+    P("unsqueeze_", _f((3, 4), seed=116), lambda x: x.reshape(3, 1, 4),
+      kwargs={"axis": 1}, np_kwargs={}),
+    P("flatten_", _f((3, 2, 4), seed=117), lambda x: x.reshape(3, 8),
+      kwargs={"start_axis": 1, "stop_axis": 2}, np_kwargs={}),
+    P("reshape_", _f((3, 4), seed=118), lambda x: x.reshape(2, 6),
+      kwargs={"shape": [2, 6]}, np_kwargs={}),
+    P("transpose_", _f((3, 4), seed=119), lambda x: x.T.copy(),
+      kwargs={"perm": [1, 0]}, np_kwargs={}),
+    P("nn.functional.elu_", _f((3, 4), seed=120),
+      lambda x: np.where(x > 0, x,
+                         np.exp(np.minimum(x, 0)) - 1)
+      .astype("float32")),
+    P("softmax_", _f((3, 4), seed=121),
+      lambda x: np.exp(x - x.max(-1, keepdims=True))
+      / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+    # ---- creation ----
+    P("arange", lambda: [(np.asarray(0.0, "float32"),
+                          np.asarray(5.0, "float32"),
+                          np.asarray(0.5, "float32"))],
+      lambda s, e, st: np.arange(0.0, 5.0, 0.5, "float32")),
+    P("eye", lambda: [()], lambda: np.eye(4, 3, dtype="float32"),
+      kwargs={"num_rows": 4, "num_columns": 3}, np_kwargs={}),
+    P("linspace", _scalar_pair(0.0, 1.0),
+      lambda s, e: np.linspace(0.0, 1.0, 7, dtype="float32"),
+      kwargs={"num": 7}, np_kwargs={}),
+    P("logspace", _scalar_pair(0.0, 3.0),
+      lambda s, e: np.logspace(0.0, 3.0, 4, dtype="float32"),
+      kwargs={"num": 4}, np_kwargs={}, tol=1e-3),
+    P("full", lambda: [()], lambda: np.full((2, 3), 3.5, "float32"),
+      kwargs={"shape": [2, 3], "fill_value": 3.5}, np_kwargs={}),
+    P("full_like", _f((2, 3), seed=122),
+      lambda x: np.full_like(x, 1.5),
+      kwargs={"fill_value": 1.5}, np_kwargs={}),
+    P("ones", lambda: [()], lambda: np.ones((2, 3), "float32"),
+      kwargs={"shape": [2, 3]}, np_kwargs={}),
+    P("zeros", lambda: [()], lambda: np.zeros((2, 3), "float32"),
+      kwargs={"shape": [2, 3]}, np_kwargs={}),
+    P("tril_indices", lambda: [()],
+      lambda: np.stack(np.tril_indices(4, 0, 5)).astype("int64"),
+      kwargs={"row": 4, "col": 5, "offset": 0}, np_kwargs={}),
+    P("triu_indices", lambda: [()],
+      lambda: np.stack(np.triu_indices(4, 0, 5)).astype("int64"),
+      kwargs={"row": 4, "col": 5, "offset": 0}, np_kwargs={}),
+    P("fft.fftfreq", lambda: [()],
+      lambda: np.fft.fftfreq(8, 0.5).astype("float32"),
+      kwargs={"n": 8, "d": 0.5}, np_kwargs={}),
+    P("fft.rfftfreq", lambda: [()],
+      lambda: np.fft.rfftfreq(8, 0.5).astype("float32"),
+      kwargs={"n": 8, "d": 0.5}, np_kwargs={}),
+    # ---- incubate fused (vs unfused composition) ----
+    P("incubate.nn.functional.fused_linear",
+      _f((3, 4), (4, 5), (5,), seed=130),
+      lambda x, w, b: x @ w + b, grad=True),
+    P("incubate.nn.functional.swiglu", _f((3, 4), (3, 4), seed=131),
+      lambda x, y: x / (1 + np.exp(-x)) * y, grad=True),
+    # ---- audio / signal formulas ----
+    P("audio.functional.hz_to_mel",
+      lambda: [(np.asarray([0.0, 440.0, 1000.0, 4000.0], "float32"),)],
+      lambda f: (2595.0 * np.log10(1 + f / 700.0)).astype("float32"),
+      kwargs={"htk": True}, np_kwargs={}, tol=1e-4),
+    P("audio.functional.mel_to_hz",
+      lambda: [(np.asarray([0.0, 500.0, 1000.0], "float32"),)],
+      lambda m: (700.0 * (10.0 ** (m / 2595.0) - 1)).astype("float32"),
+      kwargs={"htk": True}, np_kwargs={}, tol=1e-3),
+    P("audio.functional.power_to_db", _fpos((3, 4), seed=132),
+      lambda s: np.maximum(10 * np.log10(np.maximum(s, 1e-10)),
+                           (10 * np.log10(np.maximum(s, 1e-10))).max()
+                           - 80.0).astype("float32"), tol=1e-4),
+    P("signal.stft",
+      lambda: [(np.random.RandomState(133).randn(2, 256)
+                .astype("float32"),)],
+      _np_stft64, kwargs={"n_fft": 64, "hop_length": 16},
+      np_kwargs={}, tol=1e-4),
+]
+
 
 _FULL_BUILT = False
 
