@@ -51,9 +51,20 @@ class SparseCooTensor:
         return Tensor(jnp.asarray(self._bcoo.indices).T)
 
     def values(self):
+        # ops built from taped dense computations (sparse conv/pool)
+        # stash their tape-connected value Tensor here — returning it
+        # keeps .values() differentiable instead of silently detached
+        vt = getattr(self, "_values_tensor", None)
+        if vt is not None:
+            return vt
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
+        vt = getattr(self, "_values_tensor", None)
+        if vt is not None:
+            from ..tensor.manipulation import scatter_nd
+            idx = Tensor(jnp.asarray(self._bcoo.indices))
+            return scatter_nd(idx, vt, list(self.shape))
         return Tensor(self._bcoo.todense())
 
     def is_sparse_coo(self):
@@ -293,14 +304,15 @@ def coalesce(x, name=None):
     return SparseCooTensor(_coo(x).sum_duplicates())
 
 
-# paddle.sparse.nn namespace (layers operating on sparse tensors)
-class _SparseNNFunctional:
-    relu = staticmethod(relu)
+# value-wise op family + reductions (f(0)=0 ops over the value buffer)
+from .unary import (  # noqa: E402,F401
+    sin, tan, asin, atan, sinh, tanh, asinh, atanh, sqrt, square, log1p,
+    abs, expm1, neg, deg2rad, rad2deg, sign, pow, scale, cast, sum)
 
+__all__ += ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+            "atanh", "sqrt", "square", "log1p", "abs", "expm1", "neg",
+            "deg2rad", "rad2deg", "sign", "pow", "scale", "cast", "sum",
+            "nn"]
 
-class nn:
-    functional = _SparseNNFunctional
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
+# paddle.sparse.nn subpackage (layers + functional over sparse tensors)
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,176 @@
+"""paddle.sparse.nn — sparse layers (ref: python/paddle/sparse/nn/).
+
+Layers wrap the functional lowerings in ``functional.py``; parameters
+are ordinary dense ``Parameter``s registered on ``Layer``, so they train
+through the standard tape/optimizer path while activations stay sparse.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv3D",
+           "SubmConv3D", "BatchNorm", "SyncBatchNorm", "MaxPool3D",
+           "functional"]
+
+functional = F
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        from ...nn.initializer import Uniform
+        k = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._subm = subm
+        fan_in = (in_channels // groups) * int(np.prod(k))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            list(k) + [in_channels // groups, out_channels],
+            attr=weight_attr, default_initializer=Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fn = F.subm_conv3d if self._subm else F.conv3d
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class Conv3D(_ConvBase):
+    """ref: paddle.sparse.nn.Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_ConvBase):
+    """ref: paddle.sparse.nn.SubmConv3D (submanifold: sites preserved)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class BatchNorm(Layer):
+    """ref: paddle.sparse.nn.BatchNorm — normalizes the value buffer
+    per channel (active sites only, matching the reference: zeros do
+    not participate in the statistics)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        self._use_global_stats = use_global_stats
+        from ...nn.initializer import Constant
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean",
+                             Tensor(np.zeros(num_features, "float32")))
+        self.register_buffer("_variance",
+                             Tensor(np.ones(num_features, "float32")))
+
+    def forward(self, x):
+        from ...nn import functional as dF
+        from jax.experimental import sparse as jsparse
+        from .. import SparseCooTensor, _coo, _rewrap
+        c = _coo(x)
+        vals = Tensor(c.data)          # [nnz, C]
+        out = dF.batch_norm(vals, self._mean, self._variance,
+                            self.weight, self.bias,
+                            training=self.training,
+                            momentum=self._momentum,
+                            epsilon=self._epsilon, data_format="NC",
+                            use_global_stats=self._use_global_stats)
+        return _rewrap(jsparse.BCOO((out._data, c.indices),
+                                    shape=c.shape), x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """ref: paddle.sparse.nn.SyncBatchNorm — on TPU the jitted SPMD
+    step computes batch stats over the global batch via GSPMD, so the
+    sync is the compiler's job; eager single-process behavior matches
+    BatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            new = cls(layer._mean.shape[0], layer._momentum,
+                      layer._epsilon,
+                      use_global_stats=layer._use_global_stats)
+            new.weight.set_value(layer.weight.numpy())
+            new.bias.set_value(layer.bias.numpy())
+            new.weight.trainable = layer.weight.trainable
+            new.bias.trainable = layer.bias.trainable
+            new._mean.set_value(layer._mean.numpy())
+            new._variance.set_value(layer._variance.numpy())
+            new.training = layer.training
+            return new
+        for name, sub in list(getattr(layer, "_sub_layers",
+                                      {}).items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class MaxPool3D(Layer):
+    """ref: paddle.sparse.nn.MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._kernel, self._stride = kernel_size, stride
+        self._padding = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel, self._stride,
+                            self._padding)

@@ -1,0 +1,245 @@
+"""paddle.sparse.nn.functional (ref: python/paddle/sparse/nn/functional/).
+
+TPU-native lowering of the sparse 3-D conv family: instead of the
+reference's gather-scatter "rulebook" CUDA kernels
+(paddle/phi/kernels/sparse/gpu/conv_kernel.cu), the point cloud is
+scattered onto its dense voxel grid, the convolution runs on the MXU via
+``lax.conv_general_dilated`` (through the recorded ``F.conv3d`` op, so
+weight/bias gradients flow through the eager tape), and the result is
+gathered back at the output's active sites:
+
+  * ``subm_conv3d`` — submanifold convolution: output sites == input
+    sites (the dominant op in point-cloud backbones; keeps sparsity).
+  * ``conv3d`` — output sites = every voxel whose receptive field
+    touches an input site (the reference rulebook's output-site rule —
+    including sites whose accumulated value happens to be zero).
+
+Site computation inspects concrete coordinates, so these ops run
+eagerly (the reference builds its rulebook on host, same stance);
+shapes entering the MXU are the dense grid, which is static.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import SparseCooTensor, _coo
+
+
+def _triple(v):
+    from ...nn.functional.conv import _tuple
+    return _tuple(v, 3)
+
+
+def relu(x, name=None):
+    from .. import relu as _relu
+    return _relu(x)
+
+
+def relu6(x, name=None):
+    from ..unary import _value_op
+    return _value_op(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from ..unary import _value_op
+    return _value_op(x, lambda v: jnp.where(v >= 0, v,
+                                            negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """ref: paddle.sparse.nn.functional.softmax — softmax over the
+    stored entries of each row (last axis); absent entries are NOT
+    treated as zeros (reference semantics)."""
+    if axis not in (-1, len(x.shape) - 1):
+        raise NotImplementedError("sparse softmax supports the last "
+                                  "axis only (reference parity)")
+    c = _coo(x).sum_duplicates()
+    rows = c.indices[:, :-1]
+    # dense scratch keyed by row id: max/sum per row of the stored values
+    row_key = jnp.zeros((c.indices.shape[0],), jnp.int32)
+    mult = 1
+    for d in range(rows.shape[1] - 1, -1, -1):
+        row_key = row_key + rows[:, d].astype(jnp.int32) * mult
+        mult *= int(c.shape[d])
+    n_rows = max(mult, 1)
+    neg = jnp.full((n_rows,), -jnp.inf, c.data.dtype)
+    row_max = neg.at[row_key].max(c.data)
+    ex = jnp.exp(c.data - row_max[row_key])
+    row_sum = jnp.zeros((n_rows,), c.data.dtype).at[row_key].add(ex)
+    out = ex / row_sum[row_key]
+    from jax.experimental import sparse as jsparse
+    from .. import _rewrap
+    return _rewrap(jsparse.BCOO((out, c.indices), shape=c.shape), x)
+
+
+def _dense_input(x):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse conv expects a SparseCooTensor "
+                        "(NDHWC indices + [nnz, C] values)")
+    c = x._bcoo.sum_duplicates()
+    if c.indices.shape[1] != 4 or c.data.ndim != 2:
+        raise ValueError("sparse conv3d input must have 4 sparse dims "
+                         "(N, D, H, W) and channel values [nnz, C]")
+    return c
+
+
+def _coverage_sites(c, shape_out, kernel, stride, padding, dilation):
+    """Output sites whose receptive field touches >= 1 input site —
+    computed with a ones-conv on the occupancy grid (host/eager)."""
+    import jax
+    occ = jnp.zeros((c.shape[0], 1) + tuple(c.shape[1:4]), jnp.float32)
+    idx = c.indices
+    occ = occ.at[idx[:, 0], 0, idx[:, 1], idx[:, 2], idx[:, 3]].set(1.0)
+    ones = jnp.ones((1, 1) + kernel, jnp.float32)
+    cov = jax.lax.conv_general_dilated(
+        occ, ones, window_strides=stride,
+        padding=[(p, p) for p in padding], rhs_dilation=dilation,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    got = (cov.shape[0],) + tuple(cov.shape[2:])
+    want = (shape_out[0],) + tuple(shape_out[1:4])
+    if got != want:
+        raise AssertionError(
+            f"coverage grid {got} disagrees with conv output {want}")
+    sites = np.argwhere(np.asarray(cov[:, 0]) > 0.5)
+    return jnp.asarray(sites, jnp.int32)
+
+
+def _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm, name):
+    from ...nn import functional as F
+    c = _dense_input(x)
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    if w._data.ndim != 5:
+        raise ValueError("sparse conv3d weight must be "
+                         "[kd, kh, kw, C_in/groups, C_out]")
+    kernel = tuple(int(k) for k in w._data.shape[:3])
+
+    dense = Tensor(c.todense())                      # [N, D, H, W, C]
+    # recorded dense conv (NDHWC): tape handles weight/bias grads.
+    # paddle sparse weights are [kd,kh,kw,I,O]; F.conv3d stores OIDHW —
+    # transpose once here (cheap, fused by XLA).
+    w_oidhw = w.transpose([4, 3, 0, 1, 2])
+    out_dense = F.conv3d(dense, w_oidhw,
+                         bias if bias is None or isinstance(bias, Tensor)
+                         else Tensor(jnp.asarray(bias)),
+                         stride=list(stride), padding=list(padding),
+                         dilation=list(dilation), groups=groups,
+                         data_format="NDHWC")
+    if subm:
+        if tuple(stride) != (1, 1, 1):
+            raise ValueError("subm_conv3d requires stride 1 "
+                             "(submanifold convs preserve sites)")
+        if tuple(out_dense.shape[1:4]) != tuple(c.shape[1:4]):
+            # gathering input sites from a smaller grid would CLAMP
+            # (jnp indexing) and silently corrupt border values
+            raise ValueError(
+                "subm_conv3d requires shape-preserving padding "
+                f"(input spatial {tuple(c.shape[1:4])} vs output "
+                f"{tuple(out_dense.shape[1:4])}); use padding="
+                "dilation*(kernel-1)//2")
+        sites = c.indices
+    else:
+        sites = _coverage_sites(c, out_dense.shape, kernel, stride,
+                                padding, dilation)
+    vals = out_dense[Tensor(sites[:, 0]), Tensor(sites[:, 1]),
+                     Tensor(sites[:, 2]), Tensor(sites[:, 3])]
+    from jax.experimental import sparse as jsparse
+    out = SparseCooTensor(jsparse.BCOO(
+        (vals._data, sites), shape=tuple(out_dense.shape)))
+    out._values_tensor = vals        # tape-connected values (grads flow)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """ref: paddle.sparse.nn.functional.conv3d."""
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse conv3d supports NDHWC only "
+                                  "(reference layout)")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        groups, False, name)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """ref: paddle.sparse.nn.functional.subm_conv3d."""
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse subm_conv3d supports NDHWC "
+                                  "only (reference layout)")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        groups, True, name)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """ref: paddle.sparse.nn.functional.max_pool3d — pools over ACTIVE
+    sites only (inactive voxels contribute -inf, and every output site
+    has at least one active input by the coverage rule)."""
+    import jax
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse max_pool3d supports NDHWC only")
+    c = _dense_input(x)
+    kernel = _triple(kernel_size)
+    stride = _triple(stride if stride is not None else kernel_size)
+    padding = _triple(padding)
+    neg = jnp.asarray(-jnp.inf, c.data.dtype)
+    dense = jnp.full(c.shape, neg)
+    idx = c.indices
+    dense = dense.at[idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]].max(
+        c.data)
+    pooled = jax.lax.reduce_window(
+        dense, neg, jax.lax.max,
+        (1,) + kernel + (1,), (1,) + stride + (1,),
+        [(0, 0)] + [(p, p) for p in padding] + [(0, 0)])
+    sites = _coverage_sites(c, pooled.shape, kernel, stride, padding,
+                            (1, 1, 1))
+    vals = pooled[sites[:, 0], sites[:, 1], sites[:, 2], sites[:, 3]]
+    from jax.experimental import sparse as jsparse
+    return SparseCooTensor(jsparse.BCOO((vals, sites),
+                                        shape=tuple(pooled.shape)))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """ref: paddle.sparse.nn.functional.attention — SDPA whose score
+    matrix is evaluated only at ``sparse_mask``'s pattern (CSR).  On
+    TPU the dense-with-mask formulation IS the fast path (MXU + XLA
+    fusion); the CSR pattern supplies the mask."""
+    from ...nn import functional as F
+    q = query if isinstance(query, Tensor) else Tensor(jnp.asarray(query))
+    k = key if isinstance(key, Tensor) else Tensor(jnp.asarray(key))
+    v = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+    dense_mask = sparse_mask.to_dense() if hasattr(sparse_mask,
+                                                   "to_dense") \
+        else Tensor(jnp.asarray(sparse_mask))
+    m = dense_mask._data
+    # CSR pattern [B*H, S, S] → [B, H, S, S]
+    b, h = q.shape[0], q.shape[1]
+    m = m.reshape((b, h) + tuple(m.shape[1:]))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = Tensor(jnp.einsum("bhqd,bhkd->bhqk",
+                               q._data.astype(jnp.float32),
+                               k._data.astype(jnp.float32)) * scale)
+    bias = jnp.where(m != 0, 0.0, -jnp.inf).astype(jnp.float32)
+    if key_padding_mask is not None:
+        kp = (key_padding_mask._data if isinstance(key_padding_mask,
+                                                   Tensor)
+              else jnp.asarray(key_padding_mask))
+        bias = bias + kp[:, None, None, :].astype(jnp.float32)
+    if attn_mask is not None:
+        am = (attn_mask._data if isinstance(attn_mask, Tensor)
+              else jnp.asarray(attn_mask))
+        bias = bias + am[None, None, :, :].astype(jnp.float32)
+    import jax
+    p = jax.nn.softmax(scores._data + bias, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)      # fully-masked rows → 0
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v._data.astype(jnp.float32))
+    return Tensor(out.astype(v._data.dtype))

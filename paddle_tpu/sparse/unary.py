@@ -1,0 +1,119 @@
+"""paddle.sparse value-wise ops (ref: python/paddle/sparse/unary.py).
+
+All of these preserve the sparsity pattern: f(0)=0 for every op in the
+family, so they act on the COO value buffer only — O(nnz), never
+densified.  ``cast``/``scale``/``pow`` mirror the reference's extra
+arguments; ``sum`` reduces via jax.experimental.sparse's native BCOO
+reduction.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+
+def _lazy():
+    from . import _coo, _rewrap
+    return _coo, _rewrap
+
+
+def _value_op(x, fn):
+    _coo, _rewrap = _lazy()
+    c = _coo(x)
+    return _rewrap(jsparse.BCOO((fn(c.data), c.indices), shape=c.shape),
+                   x)
+
+
+def _make_unary(name, jfn):
+    def op(x, name=None):
+        return _value_op(x, jfn)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"ref: paddle.sparse.{name} — value-wise (f(0)=0)."
+    return op
+
+
+_UNARY_TABLE = {
+    "sin": jnp.sin, "tan": jnp.tan, "asin": jnp.arcsin,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "atanh": jnp.arctanh, "sqrt": jnp.sqrt,
+    "square": jnp.square, "log1p": jnp.log1p, "abs": jnp.abs,
+    "expm1": jnp.expm1, "neg": jnp.negative,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+    "sign": jnp.sign,
+}
+
+for _n, _f in _UNARY_TABLE.items():
+    globals()[_n] = _make_unary(_n, _f)
+
+
+def pow(x, factor, name=None):
+    """ref: paddle.sparse.pow (factor > 0 keeps f(0)=0)."""
+    return _value_op(x, lambda v: jnp.power(v, factor))
+
+
+def scale(x, scale, bias=0.0, bias_after_scale=True, name=None):
+    """ref: paddle.sparse.scale — affine on the VALUES only (the
+    reference applies bias to stored values; zeros stay zero)."""
+    def f(v):
+        if bias_after_scale:
+            return v * scale + bias
+        return (v + bias) * scale
+    return _value_op(x, f)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """ref: paddle.sparse.cast."""
+    _coo, _rewrap = _lazy()
+    from .. import dtype as dtypes
+    c = _coo(x)
+    data, indices = c.data, c.indices
+    if value_dtype is not None:
+        data = data.astype(dtypes.to_jax(value_dtype))
+    if index_dtype is not None:
+        indices = indices.astype(dtypes.to_jax(index_dtype))
+    return _rewrap(jsparse.BCOO((data, indices), shape=c.shape), x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """ref: paddle.sparse.sum — reduce by DROPPING the reduced index
+    columns and merging duplicates (O(nnz), sparsity-native); reduced
+    dense (feature) dims sum the value buffer directly.  Full reduction
+    returns a dense scalar Tensor like the reference."""
+    from ..core.tensor import Tensor
+    _coo, _rewrap = _lazy()
+    c = _coo(x)
+    data, idx = c.data, c.indices
+    if dtype is not None:
+        from .. import dtype as dtypes
+        data = data.astype(dtypes.to_jax(dtype))
+    if axis is None:
+        return Tensor(data.sum())
+    nd = len(c.shape)
+    ns = idx.shape[1]                       # leading sparse dims
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a % nd for a in axes)
+    # dense (trailing value) dims reduce on the buffer
+    dense_axes = tuple(a - ns + 1 for a in axes if a >= ns)
+    if dense_axes:
+        data = data.sum(axis=dense_axes, keepdims=keepdim)
+    sp_axes = [a for a in axes if a < ns]
+    kept = [a for a in range(ns) if a not in sp_axes]
+    if keepdim:
+        shape = tuple(1 if a in axes else s
+                      for a, s in enumerate(c.shape))
+    else:
+        shape = tuple(s for a, s in enumerate(c.shape) if a not in axes)
+    if keepdim:
+        cols = [jnp.zeros((idx.shape[0],), idx.dtype) if a in sp_axes
+                else idx[:, a] for a in range(ns)]
+        new_idx = jnp.stack(cols, 1) if cols else idx[:, :0]
+    else:
+        new_idx = idx[:, kept]
+    out = jsparse.BCOO((data, new_idx), shape=shape).sum_duplicates()
+    from . import SparseCooTensor
+    res = SparseCooTensor(out)
+    from . import SparseCsrTensor
+    if isinstance(x, SparseCsrTensor) and len(shape) == 2:
+        return res.to_sparse_csr()
+    return res

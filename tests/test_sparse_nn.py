@@ -1,0 +1,239 @@
+"""paddle.sparse value-wise ops + sparse.nn layers
+(ref: python/paddle/sparse/ + test/legacy_test/test_sparse_*_op.py).
+
+Oracle: densify and compare against the dense formulation (conv via
+lax dense conv at active sites, stats over active values only).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+
+def _coo2d():
+    d = np.zeros((3, 4), "float32")
+    d[0, 1] = 2.0
+    d[2, 3] = -1.5
+    d[1, 0] = 0.5
+    return d, sp.sparse_coo_tensor(np.argwhere(d).T, d[d != 0],
+                                   shape=d.shape)
+
+
+def _cloud(seed=0, shape=(1, 4, 5, 6, 3), n=10):
+    rs = np.random.RandomState(seed)
+    dense = np.zeros(shape, "float32")
+    flat = np.prod(shape[1:4])
+    pts = rs.choice(flat, n, replace=False)
+    for p in pts:
+        di, hi, wi = np.unravel_index(p, shape[1:4])
+        dense[0, di, hi, wi] = rs.randn(shape[-1])
+    idx = np.argwhere(dense.any(-1)).T
+    vals = dense[tuple(idx)]
+    return dense, sp.sparse_coo_tensor(idx, vals, shape=dense.shape)
+
+
+# ---------------------------------------------------------------------------
+# value-wise family
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("sin", np.sin), ("tanh", np.tanh), ("sqrt", None),
+    ("square", np.square), ("log1p", np.log1p), ("abs", np.abs),
+    ("expm1", np.expm1), ("neg", np.negative), ("sign", np.sign),
+])
+def test_sparse_unary_matches_dense(name, np_fn):
+    d, coo = _coo2d()
+    if name in ("sqrt", "log1p"):
+        d = np.abs(d)
+        coo = sp.sparse_coo_tensor(np.argwhere(d).T, d[d != 0],
+                                   shape=d.shape)
+        np_fn = {"sqrt": np.sqrt, "log1p": np.log1p}[name]
+    got = getattr(sp, name)(coo)
+    assert got.is_sparse_coo()
+    want = np.where(d != 0, np_fn(d), 0.0)
+    np.testing.assert_allclose(got.to_dense().numpy(), want, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_sparse_pow_scale_cast():
+    d, coo = _coo2d()
+    np.testing.assert_allclose(sp.pow(coo, 2).to_dense().numpy(), d * d,
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        sp.scale(coo, 3.0, 1.0).to_dense().numpy(),
+        np.where(d != 0, d * 3 + 1, 0.0), atol=1e-6)
+    c = sp.cast(coo, value_dtype="float64")
+    assert "float64" in str(c.dtype)
+
+
+def test_sparse_sum_axes_and_keepdim():
+    d, coo = _coo2d()
+    np.testing.assert_allclose(sp.sum(coo, axis=1).to_dense().numpy(),
+                               d.sum(1), atol=1e-6)
+    np.testing.assert_allclose(
+        sp.sum(coo, axis=0, keepdim=True).to_dense().numpy(),
+        d.sum(0, keepdims=True), atol=1e-6)
+    assert abs(float(sp.sum(coo).numpy()) - d.sum()) < 1e-6
+
+
+def test_sparse_softmax_rows():
+    d, coo = _coo2d()
+    out = sp.nn.functional.softmax(coo)
+    got = out.to_dense().numpy()
+    # softmax over STORED entries per row (absent entries excluded)
+    for r in range(d.shape[0]):
+        nz = d[r] != 0
+        if nz.any():
+            e = np.exp(d[r][nz] - d[r][nz].max())
+            np.testing.assert_allclose(got[r][nz], e / e.sum(),
+                                       rtol=1e-5)
+            assert (got[r][~nz] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# sparse conv / pool / norm layers
+# ---------------------------------------------------------------------------
+
+def test_subm_conv3d_matches_dense_at_sites():
+    dense, x = _cloud()
+    conv = sp.nn.SubmConv3D(3, 8, 3, padding=1)
+    out = conv(x)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(conv.weight.numpy()),
+        (1, 1, 1), [(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    ref = np.asarray(ref) + conv.bias.numpy()
+    got = out.to_dense().numpy()
+    mask = dense.any(-1)
+    np.testing.assert_allclose(got[mask], ref[mask], atol=1e-4)
+    # submanifold contract: sites preserved, nothing dilates
+    assert (got[~mask] == 0).all()
+    assert out.nnz == x.nnz
+
+
+def test_subm_conv3d_rejects_stride():
+    _, x = _cloud()
+    conv = sp.nn.SubmConv3D(3, 4, 3, stride=2, padding=1)
+    with pytest.raises(ValueError):
+        conv(x)
+
+
+def test_conv3d_coverage_sites_and_values():
+    dense, x = _cloud(seed=1)
+    conv = sp.nn.Conv3D(3, 4, 2, stride=2, bias_attr=False)
+    out = conv(x)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(conv.weight.numpy()),
+        (2, 2, 2), [(0, 0)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    got = out.to_dense().numpy()
+    # active output sites carry the dense conv values
+    occ = dense.any(-1).astype("float32")[:, None]
+    cov = jax.lax.conv_general_dilated(
+        jnp.asarray(occ), jnp.ones((1, 1, 2, 2, 2), "float32"),
+        (2, 2, 2), [(0, 0)] * 3,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    sites = np.asarray(cov[:, 0]) > 0.5
+    np.testing.assert_allclose(got[sites], np.asarray(ref)[sites],
+                               atol=1e-4)
+    assert (got[~sites] == 0).all()
+
+
+def test_sparse_max_pool3d_active_only():
+    dense, x = _cloud(seed=2)
+    out = sp.nn.MaxPool3D(2, 2)(x)
+    got = out.to_dense().numpy()
+    # oracle: -inf background max-pool, evaluated at coverage sites
+    bg = np.where(dense.any(-1, keepdims=True), dense, -np.inf)
+    ref = jax.lax.reduce_window(
+        jnp.asarray(bg), -jnp.inf, jax.lax.max,
+        (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), [(0, 0)] * 5)
+    active = got.any(-1)
+    np.testing.assert_allclose(got[active], np.asarray(ref)[active],
+                               atol=1e-6)
+    assert np.isfinite(got).all()
+
+
+def test_sparse_batch_norm_active_stats_and_training():
+    _, x = _cloud(seed=3)
+    bn = sp.nn.BatchNorm(3)
+    bn.train()
+    out = bn(x)
+    vals = np.asarray(out._bcoo.data)
+    # normalized over ACTIVE values only
+    assert np.abs(vals.mean(0)).max() < 1e-5
+    assert np.abs(vals.std(0) - 1).max() < 0.1
+    # running stats moved off init
+    assert np.abs(bn._mean.numpy()).max() > 0
+    bn.eval()
+    out2 = bn(x)
+    assert out2.to_dense().numpy().shape == tuple(x.shape)
+
+
+def test_sparse_conv_weight_grads_flow():
+    """The PUBLIC .values() must be tape-connected (a normal training
+    loop uses it; a detached buffer would silently train nothing)."""
+    _, x = _cloud(seed=4)
+    conv = sp.nn.SubmConv3D(3, 4, 3, padding=1)
+    out = conv(x)
+    loss = (out.values() ** 2).sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+    assert conv.bias.grad is not None
+
+
+def test_sparse_conv_to_dense_tape_connected():
+    _, x = _cloud(seed=6)
+    conv = sp.nn.SubmConv3D(3, 4, 3, padding=1)
+    out = conv(x)
+    loss = (out.to_dense() ** 2).sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+
+
+def test_subm_conv3d_rejects_shape_changing_padding():
+    """padding=0 with kernel 3 shrinks the grid; gathering input sites
+    from it would clamp (jnp) and silently corrupt border values."""
+    _, x = _cloud(seed=7)
+    conv = sp.nn.SubmConv3D(3, 4, 3)       # default padding=0
+    with pytest.raises(ValueError, match="shape-preserving"):
+        conv(x)
+
+
+def test_sparse_attention_masked_sdpa():
+    rs = np.random.RandomState(5)
+    b, h, s, d = 1, 2, 4, 8
+    q = rs.randn(b, h, s, d).astype("float32")
+    k = rs.randn(b, h, s, d).astype("float32")
+    v = rs.randn(b, h, s, d).astype("float32")
+    mask = np.tril(np.ones((s, s), "float32"))
+    dense_mask = np.broadcast_to(mask, (b * h, s, s)).copy()
+    sm = sp.sparse_coo_tensor(np.argwhere(dense_mask).T,
+                              dense_mask[dense_mask != 0],
+                              shape=dense_mask.shape)
+    out = sp.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        sm).numpy()
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    scores = np.where(mask[None, None] != 0, scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_sync_batch_norm_convert():
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = sp.nn.BatchNorm(3)
+
+    m = M()
+    m2 = sp.nn.SyncBatchNorm.convert_sync_batchnorm(m)
+    assert isinstance(m2.bn, sp.nn.SyncBatchNorm)
