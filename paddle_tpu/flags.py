@@ -106,6 +106,11 @@ define_flag("use_pallas_attention", True,
             "route attention through the Pallas flash kernel on TPU")
 define_flag("use_pallas_rms_norm", True,
             "route fused_rms_norm through the Pallas kernel on TPU")
+define_flag("pallas_gqa", False,
+            "allow the Pallas flash BACKWARD for GQA (n_rep>1) on real "
+            "TPU; default off — the GQA dkv Mosaic compile hung the "
+            "remote compiler on v5e (2026-07-30, see NOTES_r4); "
+            "interpret-mode tests cover it regardless")
 define_flag("pallas_interpret", False,
             "run Pallas kernels in interpreter mode (CPU tests)")
 define_flag("pallas_autotune", False,

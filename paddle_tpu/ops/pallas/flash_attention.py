@@ -48,6 +48,13 @@ def supports(sq: int, sk: int, d: int, causal: bool,
         return False
     if hq % hkv:
         return False
+    if hq != hkv and not get_flag("pallas_interpret") \
+            and not get_flag("pallas_gqa"):
+        # GQA forward compiled + passed parity on v5e, but the dkv
+        # backward hung Mosaic's remote compiler for 30+ min and wedged
+        # the tunnel (2026-07-30).  XLA attention handles GQA until the
+        # kernel is proven on hardware; FLAGS_pallas_gqa opts back in.
+        return False
     return d % 8 == 0
 
 

@@ -77,14 +77,25 @@ def flash():
     # ~4e-3 relative (measured on v5e, 2026-07-30). bf16 is the
     # training dtype and the tight oracle; f32 here checks plumbing,
     # not accumulation exactness (interpret-mode tests cover that).
+    # (tag, dtype, bh, sq, sk, d, causal, q_offset, n_rep, tol, do_bwd)
+    # GQA backward is OPT-IN (TPU_PARITY_GQA_BWD=1): its dkv Mosaic
+    # compile hung the remote compiler for 30+ min and wedged the axon
+    # tunnel on 2026-07-30 — do not re-submit it casually.
+    import os
+    gqa_bwd = os.environ.get("TPU_PARITY_GQA_BWD") == "1"
     cases = [
-        ("f32.causal", jnp.float32, 8, 512, 512, 128, True, 0, 1, 8e-3),
-        ("bf16.causal", jnp.bfloat16, 8, 512, 512, 128, True, 0, 1, 2e-2),
-        ("bf16.full", jnp.bfloat16, 8, 512, 512, 128, False, 0, 1, 2e-2),
-        ("bf16.gqa4", jnp.bfloat16, 16, 512, 512, 128, True, 0, 4, 2e-2),
-        ("bf16.decode", jnp.bfloat16, 8, 128, 512, 128, True, 384, 1, 2e-2),
+        ("f32.causal", jnp.float32, 8, 512, 512, 128, True, 0, 1, 8e-3,
+         True),
+        ("bf16.causal", jnp.bfloat16, 8, 512, 512, 128, True, 0, 1,
+         2e-2, True),
+        ("bf16.full", jnp.bfloat16, 8, 512, 512, 128, False, 0, 1,
+         2e-2, True),
+        ("bf16.decode", jnp.bfloat16, 8, 128, 512, 128, True, 384, 1,
+         2e-2, True),
+        ("bf16.gqa4", jnp.bfloat16, 16, 512, 512, 128, True, 0, 4,
+         2e-2, gqa_bwd),
     ]
-    for tag, dt, bh, sq, sk, d, causal, qoff, n_rep, tol in cases:
+    for tag, dt, bh, sq, sk, d, causal, qoff, n_rep, tol, do_bwd in cases:
         kq, kk, kv, kg = jax.random.split(jax.random.PRNGKey(3), 4)
         q = jax.random.normal(kq, (bh, sq, d), dt)
         k = jax.random.normal(kk, (bh // n_rep, sk, d), dt)
@@ -108,6 +119,11 @@ def flash():
         out = flash_attention_bhsd(q, k, v, scale, causal, 128, 128, False,
                                    qoff, n_rep)
         check(f"flash.fwd.{tag}", out, ref(q, k, v), tol)
+        if not do_bwd:
+            print(json.dumps({"skip": f"flash.bwd.{tag}",
+                              "reason": "GQA bwd opt-in only "
+                              "(TPU_PARITY_GQA_BWD=1)"}), flush=True)
+            continue
 
         def loss_p(q, k, v):
             o = flash_attention_bhsd(q, k, v, scale, causal, 128, 128,
