@@ -2626,6 +2626,157 @@ _PARITY += [
 ]
 
 
+
+# ---------------------------------------------------------------------------
+# wave 9: torch-oracle functional ops + vision/text refs
+# (grid_sample/affine_grid/ctc_loss/conv3d_transpose verified against
+# torch-CPU; viterbi against brute-force path enumeration; nms against
+# the O(n^2) numpy loop; eig against LAPACK geev via numpy)
+# ---------------------------------------------------------------------------
+
+def _tf():
+    import torch
+    import torch.nn.functional as F
+    return torch, F
+
+
+def _grid_case():
+    def gen():
+        rs = np.random.RandomState(140)
+        return [(rs.randn(1, 2, 4, 4).astype("float32"),
+                 (rs.rand(1, 3, 3, 2) * 2 - 1).astype("float32"))]
+    return gen
+
+
+def _np_grid_sample(x, g):
+    torch, F = _tf()
+    return F.grid_sample(torch.from_numpy(x), torch.from_numpy(g),
+                         mode="bilinear", padding_mode="zeros",
+                         align_corners=True).numpy()
+
+
+def _np_affine_grid(t):
+    torch, F = _tf()
+    return F.affine_grid(torch.from_numpy(t), (1, 2, 5, 5),
+                         align_corners=True).numpy()
+
+
+def _ctc_case():
+    def gen():
+        rs = np.random.RandomState(141)
+        return [(rs.randn(6, 2, 5).astype("float32"),
+                 rs.randint(1, 5, (2, 3)).astype("int32"),
+                 np.asarray([6, 6], "int64"),
+                 np.asarray([3, 3], "int64"))]
+    return gen
+
+
+def _np_ctc(lg, lb, il, ll):
+    torch, F = _tf()
+    lp = torch.from_numpy(lg).log_softmax(2)
+    return F.ctc_loss(lp, torch.from_numpy(lb.astype("int64")),
+                      torch.from_numpy(il), torch.from_numpy(ll),
+                      blank=0, reduction="mean").numpy()
+
+
+def _np_convt3d(x, w):
+    torch, F = _tf()
+    return F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                              stride=2).numpy()
+
+
+def _viterbi_case():
+    def gen():
+        rs = np.random.RandomState(142)
+        return [(rs.randn(2, 5, 3).astype("float32"),
+                 rs.randn(3, 3).astype("float32"),
+                 np.asarray([5, 5], "int64"))]
+    return gen
+
+
+def _np_viterbi(p, t, l):
+    import itertools
+    B, T, N = p.shape
+    scores, paths = [], []
+    for b in range(B):
+        bs, bp = -1e30, None
+        for path in itertools.product(range(N), repeat=T):
+            s = p[b, 0, path[0]]
+            for i in range(1, T):
+                s += t[path[i - 1], path[i]] + p[b, i, path[i]]
+            if s > bs:
+                bs, bp = s, path
+        scores.append(bs)
+        paths.append(bp)
+    return (np.asarray(scores, "float32"), np.asarray(paths, "int64"))
+
+
+_NMS_SCORES = np.asarray([0.9, 0.8, 0.7], "float32")
+
+
+def _np_nms(b):
+    s = _NMS_SCORES
+    keep, idx = [], np.argsort(-s)
+    while len(idx):
+        i = idx[0]
+        keep.append(i)
+        rest = idx[1:]
+        xx1 = np.maximum(b[i, 0], b[rest, 0])
+        yy1 = np.maximum(b[i, 1], b[rest, 1])
+        xx2 = np.minimum(b[i, 2], b[rest, 2])
+        yy2 = np.minimum(b[i, 3], b[rest, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        a1 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        a2 = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+        idx = rest[inter / (a1 + a2 - inter) <= 0.3]
+    return np.asarray(keep, "int64")
+
+
+def _np_adjust_contrast(im):
+    gray = 0.299 * im[0] + 0.587 * im[1] + 0.114 * im[2]
+    return np.clip(0.5 * im + 0.5 * gray.mean(), 0, 1).astype("float32")
+
+
+_PARITY += [
+    P("nn.functional.grid_sample", _grid_case(), _np_grid_sample,
+      grad=True, tol=1e-4),
+    P("nn.functional.affine_grid", _f((1, 2, 3), seed=143),
+      _np_affine_grid, kwargs={"out_shape": [1, 2, 5, 5]},
+      np_kwargs={}, tol=1e-5),
+    P("nn.functional.ctc_loss", _ctc_case(), _np_ctc, tol=1e-4),
+    P("nn.functional.conv3d_transpose",
+      _f((1, 3, 4, 4, 4), (3, 4, 2, 2, 2), seed=144), _np_convt3d,
+      kwargs={"stride": 2}, np_kwargs={}, grad=True, tol=1e-4),
+    P("nn.functional.rrelu", _f((3, 4), seed=145),
+      lambda x: np.where(x >= 0, x,
+                         x * (0.125 + 1.0 / 3.0) / 2)
+      .astype("float32"),
+      kwargs={"training": False}, np_kwargs={}),
+    P("text.viterbi_decode", _viterbi_case(), _np_viterbi,
+      kwargs={"include_bos_eos_tag": False}, np_kwargs={}, tol=1e-4),
+    P("vision.ops.nms",
+      lambda: [(np.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                            [20, 20, 30, 30]], "float32"),)],
+      _np_nms, kwargs={"iou_threshold": 0.3, "scores": _NMS_SCORES},
+      np_kwargs={}, tol=0.1),
+    P("vision.transforms.adjust_contrast",
+      lambda: [(np.random.RandomState(146).rand(3, 8, 8)
+                .astype("float32"),)],
+      _np_adjust_contrast, kwargs={"contrast_factor": 0.5},
+      np_kwargs={}, tol=1e-2),
+    P("eigvals", _f((4, 4), seed=147),
+      lambda a: np.linalg.eigvals(a).astype("complex64"), tol=1e-3),
+    P("eig", _f((4, 4), seed=147),
+      lambda a: tuple(x.astype("complex64")
+                      for x in np.linalg.eig(a)), tol=1e-3),
+    P("linalg.eig", _f((4, 4), seed=147),
+      lambda a: tuple(x.astype("complex64")
+                      for x in np.linalg.eig(a)), tol=1e-3),
+    P("linalg.eigvals", _f((4, 4), seed=147),
+      lambda a: np.linalg.eigvals(a).astype("complex64"), tol=1e-3),
+]
+
+
 _FULL_BUILT = False
 
 
