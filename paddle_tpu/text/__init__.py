@@ -369,24 +369,185 @@ class Conll05st(_LocalFileDataset):
 
 
 class Movielens(_LocalFileDataset):
+    """ref: text/datasets/movielens.py — ml-1m ratings.  Each sample is
+    (user_id, gender_id, age_id, job_id, movie_id, category_ids,
+    title_ids, rating) with list fields padded to fixed length (the
+    reference yields ragged lists; fixed shapes are the TPU-friendly
+    form).  Accepts the ml-1m zip or a tar of the same layout."""
+
     _NAME = "Movielens"
+    _AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        super().__init__(data_file, mode)
+
+    def _read_member(self, name_suffix):
+        import zipfile
+        if zipfile.is_zipfile(self.data_file):
+            with zipfile.ZipFile(self.data_file) as zf:
+                for n in zf.namelist():
+                    if n.endswith(name_suffix):
+                        return zf.read(n).decode("latin-1").splitlines()
+        else:
+            with tarfile.open(self.data_file) as tf:
+                for member in tf.getmembers():
+                    if member.name.endswith(name_suffix):
+                        return tf.extractfile(member).read().decode(
+                            "latin-1").splitlines()
+        raise ValueError(f"{name_suffix} not found in {self.data_file}")
 
     def _load(self):
-        raise NotImplementedError(
-            "Movielens parsing not implemented; provide the ml-1m archive")
+        users = {}
+        for ln in self._read_member("users.dat"):
+            uid, gender, age, job = ln.split("::")[:4]
+            users[int(uid)] = (0 if gender == "M" else 1,
+                               self._AGES.index(int(age))
+                               if int(age) in self._AGES else 0,
+                               int(job))
+        categories, titles = {}, {}
+        movies = {}
+        for ln in self._read_member("movies.dat"):
+            mid, title, cats = ln.split("::")[:3]
+            cat_ids = []
+            for c in cats.split("|"):
+                cat_ids.append(categories.setdefault(c, len(categories)))
+            title_ids = []
+            for w in title.split():
+                title_ids.append(titles.setdefault(w, len(titles)))
+            movies[int(mid)] = (cat_ids, title_ids)
+        self.categories_dict = categories
+        self.movie_title_dict = titles
+
+        max_cat = max((len(c) for c, _ in movies.values()), default=1)
+        max_tit = max((len(t) for _, t in movies.values()), default=1)
+
+        samples = []
+        for ln in self._read_member("ratings.dat"):
+            uid, mid, rating = ln.split("::")[:3]
+            uid, mid = int(uid), int(mid)
+            if uid not in users or mid not in movies:
+                continue
+            g, a, j = users[uid]
+            cats, tits = movies[mid]
+            samples.append((
+                np.asarray([uid], "int64"), np.asarray([g], "int64"),
+                np.asarray([a], "int64"), np.asarray([j], "int64"),
+                np.asarray([mid], "int64"),
+                np.asarray(cats + [0] * (max_cat - len(cats)), "int64"),
+                np.asarray(tits + [0] * (max_tit - len(tits)), "int64"),
+                np.asarray([float(rating)], "float32")))
+        rs = np.random.RandomState(self.rand_seed)
+        is_test = rs.rand(len(samples)) < self.test_ratio
+        self.data = [s for s, t in zip(samples, is_test)
+                     if (t if self.mode == "test" else not t)]
 
 
 class WMT14(_LocalFileDataset):
+    """ref: text/datasets/wmt14.py — fr→en translation.  The archive
+    holds ``{train,test,gen}/...`` files of ``src\ttrg`` lines plus
+    ``src.dict``/``trg.dict`` (one word per line).  Samples are
+    (src_ids, trg_ids with <s>, trg_ids with <e>); ids 0/1/2 are
+    <s>/<e>/<unk> as in the reference."""
+
     _NAME = "WMT14"
 
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        self.dict_size = dict_size
+        super().__init__(data_file, mode)
+
+    def _read_dict(self, tf, suffix):
+        for member in tf.getmembers():
+            if member.name.endswith(suffix):
+                words = tf.extractfile(member).read().decode(
+                    "utf-8").split()
+                if self.dict_size > 0:
+                    words = words[:self.dict_size]
+                return {w: i for i, w in enumerate(words)}
+        raise ValueError(f"{suffix} not found in {self.data_file}")
+
     def _load(self):
-        raise NotImplementedError(
-            "WMT14 parsing not implemented; provide the archive locally")
+        split = {"train": "train", "test": "test", "gen": "gen"}[
+            self.mode]
+        with tarfile.open(self.data_file) as tf:
+            self.src_ids = self._read_dict(tf, "src.dict")
+            self.trg_ids = self._read_dict(tf, "trg.dict")
+            lines = []
+            for member in tf.getmembers():
+                if f"/{split}/" in member.name or \
+                        member.name.endswith(f"/{split}"):
+                    if member.isfile():
+                        lines += tf.extractfile(member).read().decode(
+                            "utf-8").splitlines()
+        unk_s = self.src_ids.get("<unk>", 2)
+        unk_t = self.trg_ids.get("<unk>", 2)
+        s_tok, e_tok = 0, 1
+        self.data = []
+        for ln in lines:
+            if "\t" not in ln:
+                continue
+            s, t = ln.split("\t")[:2]
+            sid = [self.src_ids.get(w, unk_s) for w in s.split()]
+            tid = [self.trg_ids.get(w, unk_t) for w in t.split()]
+            self.data.append((np.asarray(sid, "int64"),
+                              np.asarray([s_tok] + tid, "int64"),
+                              np.asarray(tid + [e_tok], "int64")))
 
 
 class WMT16(_LocalFileDataset):
+    """ref: text/datasets/wmt16.py — en↔de (Multi30k).  Archive layout:
+    ``wmt16/{train,val,test}`` files of ``src\ttrg`` lines plus
+    ``wmt16/en.vocab``/``wmt16/de.vocab``.  ``lang`` selects the source
+    side like the reference; ids 0/1/2 are <s>/<e>/<unk>."""
+
     _NAME = "WMT16"
 
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.lang = lang
+        super().__init__(data_file, mode)
+
+    def _read_vocab(self, tf, lang, size):
+        for member in tf.getmembers():
+            if member.name.endswith(f"{lang}.vocab"):
+                words = tf.extractfile(member).read().decode(
+                    "utf-8").split()
+                if size > 0:
+                    words = words[:size]
+                return {w: i for i, w in enumerate(words)}
+        raise ValueError(f"{lang}.vocab not found in {self.data_file}")
+
     def _load(self):
-        raise NotImplementedError(
-            "WMT16 parsing not implemented; provide the archive locally")
+        split = {"train": "train", "val": "val", "test": "test"}[
+            self.mode]
+        trg_lang = "de" if self.lang == "en" else "en"
+        with tarfile.open(self.data_file) as tf:
+            self.src_ids = self._read_vocab(tf, self.lang,
+                                            self.src_dict_size)
+            self.trg_ids = self._read_vocab(tf, trg_lang,
+                                            self.trg_dict_size)
+            lines = []
+            for member in tf.getmembers():
+                if member.isfile() and (
+                        member.name.endswith(f"/{split}")
+                        or f"/{split}." in member.name):
+                    lines += tf.extractfile(member).read().decode(
+                        "utf-8").splitlines()
+        unk_s = self.src_ids.get("<unk>", 2)
+        unk_t = self.trg_ids.get("<unk>", 2)
+        self.data = []
+        for ln in lines:
+            if "\t" not in ln:
+                continue
+            parts = ln.split("\t")
+            s, t = (parts[0], parts[1]) if self.lang == "en" \
+                else (parts[1], parts[0])
+            sid = [self.src_ids.get(w, unk_s) for w in s.split()]
+            tid = [self.trg_ids.get(w, unk_t) for w in t.split()]
+            self.data.append((np.asarray(sid, "int64"),
+                              np.asarray([0] + tid, "int64"),
+                              np.asarray(tid + [1], "int64")))
