@@ -135,6 +135,29 @@ def _apply_transfer_guard(val: str):
 define_flag("transfer_guard", "allow",
             "guard implicit host<->device transfers (allow|log|disallow)",
             on_change=_apply_transfer_guard)
+
+
+def _apply_jit_cache_dir(path: str):
+    """Persistent compiled-program cache (ref role: CINN/cuDNN kernel
+    caches + the executor's program cache surviving process restarts).
+    Every jit in the stack — TrainStep, SOT-lite segments, inference
+    predictor — hits it, so a fresh process skips XLA recompiles of
+    anything compiled before."""
+    import jax
+    if path:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache even sub-second compiles: SOT segments are many + small
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    else:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+define_flag("jit_cache_dir", "",
+            "directory for the persistent XLA compilation cache "
+            "(empty: disabled); survives process restarts",
+            on_change=_apply_jit_cache_dir)
 define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")
 define_flag("embedding_deterministic", 0, "deterministic embedding lookup")
 define_flag("log_level", 0, "framework VLOG level")
