@@ -100,9 +100,16 @@ def _measure(preset, seq, batch, steps, warmup, on_tpu, devices):
         model, optimizer = amp.decorate(models=model, optimizers=optimizer,
                                         level="O2", dtype="bfloat16")
 
-    step = train_step(model, None, optimizer,
-                      step_fn=lambda m, ids, labels:
-                      m.loss_fn(m(ids), labels))
+    def _step_fn(m, ids, labels):
+        # O2 is pure-half: the auto_cast hook must be live DURING the
+        # trace so every op (incl. post-LayerNorm matmuls) runs bf16 —
+        # decorate() alone only casts parameters
+        if on_tpu:
+            with amp.auto_cast(enable=True, level="O2", dtype="bfloat16"):
+                return m.loss_fn(m(ids), labels)
+        return m.loss_fn(m(ids), labels)
+
+    step = train_step(model, None, optimizer, step_fn=_step_fn)
 
     rs = np.random.RandomState(0)
     while True:
@@ -250,8 +257,16 @@ def _run_child(extra_env, budget, mode=None):
                  if ln.startswith("{")), None)
     if proc.returncode == 0 and line:
         return line, ""
-    err = (proc.stderr.strip().splitlines() or ["?"])[-1]
-    return None, f"rc={proc.returncode}: {err}"
+    # the LAST stderr line is often jax's traceback-filtering notice —
+    # prefer the actual exception line (the last one naming an
+    # Error/Exception), else the last few non-noise lines
+    lines = [ln for ln in proc.stderr.strip().splitlines() if ln.strip()]
+    exc = next((ln for ln in reversed(lines)
+                if ("Error" in ln or "Exception" in ln
+                    or "RESOURCE_EXHAUSTED" in ln)
+                and "JAX_TRACEBACK_FILTERING" not in ln), None)
+    err = exc or " | ".join(lines[-3:]) or "?"
+    return None, f"rc={proc.returncode}: {err[-400:]}"
 
 
 def main():

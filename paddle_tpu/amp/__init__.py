@@ -65,7 +65,13 @@ def _amp_cast_hook(op_name: str, arrays):
         if op_name in black:
             return [a.astype(jnp.float32) if _is_low(a) else a
                     for a in arrays]
-        return arrays
+        # pure-half mode (ref: amp_guard O2): every non-blacklist op runs
+        # in the low dtype.  Without the downcast, the f32 output of a
+        # kept-fp32 norm layer silently promotes every downstream matmul
+        # to f32 — observed on v5e as f32[8,2048,6144] FFN temps OOMing
+        # a 760M-model step that fits comfortably in bf16.
+        return [a.astype(_state.dtype) if _is_f32(a) else a
+                for a in arrays]
     # O1
     if op_name in white:
         return [a.astype(_state.dtype) if _is_f32(a) else a for a in arrays]

@@ -81,6 +81,11 @@ def _emit_conv(e, op, ins, n):
     b = (np.array(op.inputs[2]._data, np.float32)
          if len(op.inputs) > 2 else None)
     want = np.asarray(op.outputs[0]._data, np.float32)
+    # attributes are batch-invariant: evaluate candidates on a 2-row
+    # slice (symbolic-batch exports otherwise run every torch-oracle
+    # candidate at the full example batch)
+    if x.shape[0] > 2 and want.shape[0] == x.shape[0]:
+        x, want = x[:2], want[:2]
     if x.ndim != n + 2:
         raise NotImplementedError(
             "onnx export: conv with channel-last (NHWC) example data is "
@@ -150,6 +155,8 @@ def _emit_pool(e, op, ins, n, kind):
     torch, F = _torch()
     x = np.array(op.inputs[0]._data, np.float32)
     want = np.asarray(op.outputs[0]._data, np.float32)
+    if x.shape[0] > 2 and want.shape[0] == x.shape[0]:
+        x, want = x[:2], want[:2]     # attrs are batch-invariant
     if x.ndim != n + 2:
         raise NotImplementedError(
             "onnx export: pool with channel-last example data is not "
@@ -216,6 +223,8 @@ def _emit_pool(e, op, ins, n, kind):
 def _emit_adaptive(e, op, ins, n, kind):
     x = np.array(op.inputs[0]._data, np.float32)
     want = np.asarray(op.outputs[0]._data, np.float32)
+    if x.shape[0] > 2 and want.shape[0] == x.shape[0]:
+        x, want = x[:2], want[:2]     # attrs are batch-invariant
     in_sp = x.shape[2:]
     out_sp = want.shape[2:]
     red = np.max if kind == "max" else np.mean
@@ -252,6 +261,8 @@ def _emit_adaptive(e, op, ins, n, kind):
 def _emit_batch_norm(e, op, ins):
     x = np.asarray(op.inputs[0]._data, np.float64)
     want = np.asarray(op.outputs[0]._data)
+    if x.shape[0] > 2 and want.shape[0] == x.shape[0]:
+        x, want = x[:2], want[:2]     # attrs are batch-invariant
     mean = np.asarray(op.inputs[1]._data, np.float64)
     var = np.asarray(op.inputs[2]._data, np.float64)
     rest = [np.asarray(t._data, np.float64) for t in op.inputs[3:]]

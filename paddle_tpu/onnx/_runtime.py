@@ -1,0 +1,345 @@
+"""Numpy evaluator for the ONNX op set this package EMITS.
+
+The environment ships neither ``onnx`` nor ``onnxruntime``, so exported
+graphs could only be checked structurally (wire-format decode).  This
+module closes the loop: it decodes a ``.onnx`` file with ``_proto``'s
+reader and executes the graph with numpy, giving tests a true numeric
+round-trip oracle (export → decode → run → compare against the eager
+forward).  It doubles as a minimal CPU inference engine for artifacts
+produced by ``paddle.onnx.export`` (ref role: paddle2onnx +
+onnxruntime in the reference deployment story).
+
+Scope: exactly the ops ``export()``/``_cnn`` emit — unknown ops raise.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import _proto as pb
+
+_ONNX_DT = {pb.FLOAT: np.float32, pb.INT64: np.int64,
+            pb.INT32: np.int32, pb.BOOL: np.bool_}
+
+
+def _decode_tensor(body: bytes) -> (str, np.ndarray):
+    dims, dtype, name, raw = [], pb.FLOAT, "", b""
+    for f, w, v in pb.read_fields(body):
+        if f == 1:
+            # packed (wire 2) or unpacked (wire 0) dims
+            if w == 0:
+                dims.append(v)
+            else:
+                i = 0
+                while i < len(v):
+                    n, shift = 0, 0
+                    while True:
+                        b = v[i]
+                        i += 1
+                        n |= (b & 0x7F) << shift
+                        shift += 7
+                        if not b & 0x80:
+                            break
+                    dims.append(n)
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    arr = np.frombuffer(raw, dtype=_ONNX_DT[dtype]).reshape(dims).copy()
+    return name, arr
+
+
+class _Attr:
+    __slots__ = ("i", "f", "s", "ints", "floats")
+
+    def __init__(self):
+        self.i = None
+        self.f = None
+        self.s = None
+        self.ints: List[int] = []
+        self.floats: List[float] = []
+
+
+def _sint(v: int) -> int:
+    """protobuf int64 varints are two's-complement — map to signed."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _decode_attr(body: bytes) -> (str, _Attr):
+    name, a = "", _Attr()
+    for f, w, v in pb.read_fields(body):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            a.f = struct.unpack("<f", v)[0]
+        elif f == 3:
+            a.i = _sint(v)
+        elif f == 4:
+            a.s = v.decode()
+        elif f == 8:
+            a.ints.append(_sint(v))
+    return name, a
+
+
+class _Node:
+    __slots__ = ("op", "inputs", "outputs", "attrs")
+
+    def __init__(self, body: bytes):
+        self.inputs: List[str] = []
+        self.outputs: List[str] = []
+        self.attrs: Dict[str, _Attr] = {}
+        self.op = ""
+        for f, w, v in pb.read_fields(body):
+            if f == 1:
+                self.inputs.append(v.decode())
+            elif f == 2:
+                self.outputs.append(v.decode())
+            elif f == 4:
+                self.op = v.decode()
+            elif f == 5:
+                nm, a = _decode_attr(v)
+                self.attrs[nm] = a
+
+    def a_int(self, name, default=None):
+        a = self.attrs.get(name)
+        return default if a is None or a.i is None else a.i
+
+    def a_float(self, name, default=None):
+        a = self.attrs.get(name)
+        return default if a is None or a.f is None else a.f
+
+    def a_ints(self, name, default=()):
+        a = self.attrs.get(name)
+        return list(a.ints) if a is not None and a.ints else list(default)
+
+    def a_str(self, name, default=None):
+        a = self.attrs.get(name)
+        return default if a is None or a.s is None else a.s
+
+
+class OnnxModel:
+    """Decoded ONNX graph, executable with numpy via ``run``."""
+
+    def __init__(self, path: str):
+        blob = open(path, "rb").read()
+        top = pb.read_fields(blob)
+        graph = next(v for f, _, v in top if f == 7)
+        self.opset = next(
+            (fv for f, _, v in top if f == 8
+             for ff, _, fv in pb.read_fields(v) if ff == 2), 0)
+        g = pb.read_fields(graph)
+        self.nodes = [_Node(v) for f, _, v in g if f == 1]
+        self.inits: Dict[str, np.ndarray] = {}
+        for f, _, v in g:
+            if f == 5:
+                nm, arr = _decode_tensor(v)
+                self.inits[nm] = arr
+        self.input_names = [self._vi_name(v) for f, _, v in g if f == 11]
+        self.output_names = [self._vi_name(v) for f, _, v in g if f == 12]
+
+    @staticmethod
+    def _vi_name(body: bytes) -> str:
+        return next(v for f, _, v in pb.read_fields(body)
+                    if f == 1).decode()
+
+    def run(self, *inputs: np.ndarray) -> List[np.ndarray]:
+        env: Dict[str, np.ndarray] = dict(self.inits)
+        for nm, arr in zip(self.input_names, inputs):
+            env[nm] = np.asarray(arr)
+        for node in self.nodes:
+            outs = _eval_node(node, [env[i] for i in node.inputs if i])
+            for nm, arr in zip(node.outputs, outs):
+                env[nm] = arr
+        return [env[nm] for nm in self.output_names]
+
+
+def _softmax(x, axis):
+    m = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _conv2d(x, w, b, strides, pads, dilations, group):
+    # x [N,C,H,W]; w [M, C/g, kH, kW]; pads [t,l,b,r]
+    sh, sw = strides
+    dh, dw = dilations
+    pt, pl, pb_, pr = pads
+    x = np.pad(x, ((0, 0), (0, 0), (pt, pb_), (pl, pr)))
+    n, c, h, wd = x.shape
+    m, cg, kh, kw = w.shape
+    eh = (kh - 1) * dh + 1
+    ew = (kw - 1) * dw + 1
+    oh = (h - eh) // sh + 1
+    ow = (wd - ew) // sw + 1
+    out = np.zeros((n, m, oh, ow), np.float32)
+    mg = m // group
+    for g in range(group):
+        xs = x[:, g * cg:(g + 1) * cg]
+        ws = w[g * mg:(g + 1) * mg]
+        # im2col over the (small) test shapes
+        cols = np.empty((n, cg, kh, kw, oh, ow), np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                cols[:, :, i, j] = xs[
+                    :, :, i * dh:i * dh + oh * sh:sh,
+                    j * dw:j * dw + ow * sw:sw]
+        out[:, g * mg:(g + 1) * mg] = np.einsum(
+            "ncklij,mckl->nmij", cols, ws)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool2d(x, kind, kshape, strides, pads, ceil_mode=0,
+            count_include_pad=0):
+    kh, kw = kshape
+    sh, sw = strides
+    pt, pl, pb_, pr = pads
+    fill = -np.inf if kind == "max" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (pt, pb_), (pl, pr)),
+                constant_values=fill)
+    n, c, h, w = xp.shape
+
+    def _odim(size, k, s):
+        if ceil_mode:
+            return -(-(size - k) // s) + 1
+        return (size - k) // s + 1
+    oh = _odim(h, kh, sh)
+    ow = _odim(w, kw, sw)
+    out = np.empty((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if kind == "max":
+                out[:, :, i, j] = win.max((-2, -1))
+            elif count_include_pad:
+                out[:, :, i, j] = win.sum((-2, -1)) / (kh * kw)
+            else:
+                # average over the VALID (unpadded) window portion
+                hi0, wi0 = i * sh, j * sw
+                vh = min(hi0 + kh, h - pb_) - max(hi0, pt) \
+                    if (pt or pb_) else win.shape[-2]
+                vw = min(wi0 + kw, w - pr) - max(wi0, pl) \
+                    if (pl or pr) else win.shape[-1]
+                out[:, :, i, j] = win.sum((-2, -1)) / max(vh * vw, 1)
+    return out
+
+
+def _eval_node(node: _Node, xs: List[np.ndarray]) -> List[np.ndarray]:
+    op = node.op
+    unary = {
+        "Relu": lambda x: np.maximum(x, 0),
+        "Sigmoid": lambda x: 1.0 / (1.0 + np.exp(-x)),
+        "Tanh": np.tanh, "Exp": np.exp, "Sqrt": np.sqrt, "Abs": np.abs,
+        "Neg": np.negative, "Log": np.log, "Floor": np.floor,
+        "Ceil": np.ceil, "Identity": lambda x: x,
+        "Erf": lambda x: np.vectorize(__import__("math").erf)(
+            x.astype(np.float64)).astype(x.dtype),
+    }
+    if op in unary:
+        return [np.asarray(unary[op](xs[0]), dtype=xs[0].dtype)]
+    binary = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+              "Div": np.divide, "Pow": np.power, "MatMul": np.matmul,
+              "Max": np.maximum, "Min": np.minimum}
+    if op in binary:
+        return [binary[op](xs[0], xs[1])]
+    if op == "Softmax":
+        return [_softmax(xs[0], node.a_int("axis", -1))]
+    if op == "LogSoftmax":
+        return [np.log(_softmax(xs[0], node.a_int("axis", -1)))]
+    if op == "Gelu":
+        x = xs[0].astype(np.float64)
+        if node.a_str("approximate", "none") == "tanh":
+            y = 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                       * (x + 0.044715 * x ** 3)))
+        else:
+            import math
+            y = 0.5 * x * (1 + np.vectorize(math.erf)(x / np.sqrt(2)))
+        return [y.astype(xs[0].dtype)]
+    if op == "Reshape":
+        return [xs[0].reshape([int(d) for d in xs[1]])]
+    if op == "Transpose":
+        return [np.transpose(xs[0], node.a_ints("perm"))]
+    if op == "Concat":
+        return [np.concatenate(xs, axis=node.a_int("axis", 0))]
+    if op == "Gather":
+        return [np.take(xs[0], xs[1].astype(np.int64),
+                        axis=node.a_int("axis", 0))]
+    if op == "Where":
+        return [np.where(xs[0], xs[1], xs[2])]
+    if op == "Slice":
+        data, starts, ends, axes, steps = (
+            xs[0], xs[1], xs[2],
+            xs[3] if len(xs) > 3 else np.arange(len(xs[1])),
+            xs[4] if len(xs) > 4 else np.ones(len(xs[1]), np.int64))
+        idx = [slice(None)] * data.ndim
+        for st, en, ax, sp in zip(starts, ends, axes, steps):
+            st, en, sp = int(st), int(en), int(sp)
+            # INT64_MIN end sentinel = "past element 0" for negative step
+            idx[int(ax)] = slice(st, None if en <= -(2 ** 62) else en, sp)
+        return [data[tuple(idx)]]
+    if op == "Squeeze":
+        return [np.squeeze(xs[0], tuple(int(a) for a in xs[1]))]
+    if op == "Unsqueeze":
+        out = xs[0]
+        for a in sorted(int(a) for a in xs[1]):
+            out = np.expand_dims(out, a)
+        return [out]
+    if op == "LayerNormalization":
+        x, scale = xs[0], xs[1]
+        bias = xs[2] if len(xs) > 2 else None
+        eps = node.a_float("epsilon", 1e-5)
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + eps) * scale
+        if bias is not None:
+            y = y + bias
+        return [y.astype(x.dtype)]
+    if op == "BatchNormalization":
+        x, scale, b, mean, var = xs
+        eps = node.a_float("epsilon", 1e-5)
+        sh = [1, -1] + [1] * (x.ndim - 2)
+        return [((x - mean.reshape(sh)) / np.sqrt(var.reshape(sh) + eps)
+                 * scale.reshape(sh) + b.reshape(sh)).astype(x.dtype)]
+    if op == "Clip":
+        lo = xs[1] if len(xs) > 1 else -np.inf
+        hi = xs[2] if len(xs) > 2 else np.inf
+        return [np.clip(xs[0], lo, hi)]
+    if op == "HardSigmoid":
+        a = node.a_float("alpha", 0.2)
+        b = node.a_float("beta", 0.5)
+        return [np.clip(a * xs[0] + b, 0, 1).astype(xs[0].dtype)]
+    if op == "HardSwish":
+        return [(xs[0] * np.clip(xs[0] / 6.0 + 0.5, 0, 1))
+                .astype(xs[0].dtype)]
+    if op == "Conv":
+        x, w = xs[0], xs[1]
+        b = xs[2] if len(xs) > 2 else None
+        k = w.shape[2:]
+        return [_conv2d(
+            x, w, b, node.a_ints("strides", [1, 1]),
+            node.a_ints("pads", [0, 0, 0, 0]),
+            node.a_ints("dilations", [1, 1]), node.a_int("group", 1))]
+    if op in ("MaxPool", "AveragePool"):
+        kind = "max" if op == "MaxPool" else "avg"
+        k = node.a_ints("kernel_shape")
+        return [_pool2d(
+            xs[0], kind, k, node.a_ints("strides", k),
+            node.a_ints("pads", [0, 0, 0, 0]),
+            node.a_int("ceil_mode", 0),
+            node.a_int("count_include_pad", 0))]
+    if op == "GlobalMaxPool":
+        return [xs[0].max(axis=(-2, -1), keepdims=True)]
+    if op == "GlobalAveragePool":
+        return [xs[0].mean(axis=(-2, -1), keepdims=True)
+                .astype(xs[0].dtype)]
+    raise NotImplementedError(f"onnx runtime: op {op!r} not implemented")
+
+
+def run_model(path: str, *inputs: np.ndarray) -> List[np.ndarray]:
+    """Decode ``path`` and execute it on ``inputs`` with numpy."""
+    return OnnxModel(path).run(*inputs)

@@ -52,7 +52,11 @@ def _convert_index(item, shape):
 
 def _tensor_getitem(self, item):
     idx, eager = _convert_index(item, self.shape)
-    return call_op(lambda v: v[idx], (self,), {}, op_name="getitem")
+    # the index rides in kwargs (not a closure) so recorded programs —
+    # and the ONNX exporter — can see WHAT was sliced (_idx is static
+    # under jit; tensor indices appear as baked arrays, same as before)
+    return call_op(lambda v, _idx=None: v[_idx], (self,), {"_idx": idx},
+                   op_name="getitem")
 
 
 def _tensor_setitem(self, item, value):

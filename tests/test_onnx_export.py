@@ -296,3 +296,229 @@ def test_export_depthwise_and_avgpool(tmp_path):
     assert ap["kernel_shape"] == [3, 3]
     assert ap["strides"] == [2, 2]
     assert ap["pads"] == [1, 1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# numpy runtime round-trips (onnx/_runtime.py): export → decode → execute
+# with numpy → compare against the eager forward.  This is the numeric
+# oracle the structural decode above can't provide.
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.onnx._runtime import run_model  # noqa: E402
+
+
+def test_runtime_getitem_roundtrip(tmp_path):
+    class M(nn.Layer):
+        def forward(self, x):
+            a = x[:, 1:7:2]           # strided slice   (4, 3, 3)
+            b = x[2]                  # int (squeeze)   (8, 3) → bcast no;
+            c = x[:, None, 0, 0]      # newaxis + ints  (4, 1)
+            d = x[::-1]               # negative step   (4, 8, 3)
+            return a + c[:, :, None] + d[:, 1:7:2] + b[1:7:2]
+
+    m = M()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8, 3).astype(np.float32))
+    p = export(m, str(tmp_path / "gi"), input_spec=[x])
+    got = run_model(p, x.numpy())[0]
+    np.testing.assert_allclose(got, m(x).numpy(), rtol=1e-6, atol=1e-6)
+
+
+def test_runtime_gather_index_roundtrip(tmp_path):
+    idx = paddle.to_tensor(np.array([2, 0, 1], np.int64))
+
+    class M(nn.Layer):
+        def forward(self, x):
+            return x[:, idx]
+
+    m = M()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4, 3).astype(np.float32))
+    p = export(m, str(tmp_path / "gix"), input_spec=[x])
+    got = run_model(p, x.numpy())[0]
+    np.testing.assert_allclose(got, m(x).numpy(), rtol=1e-6, atol=1e-6)
+
+
+def test_runtime_sdpa_causal_roundtrip(tmp_path):
+    import paddle_tpu.nn.functional as F
+
+    class Attn(nn.Layer):
+        def forward(self, q, k, v):
+            return F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                  training=False)
+
+    rs = np.random.RandomState(1)
+    q = paddle.to_tensor(rs.randn(2, 8, 4, 16).astype(np.float32))
+    k = paddle.to_tensor(rs.randn(2, 8, 4, 16).astype(np.float32))
+    v = paddle.to_tensor(rs.randn(2, 8, 4, 16).astype(np.float32))
+    m = Attn()
+    p = export(m, str(tmp_path / "sdpa"), input_spec=[q, k, v])
+    got = run_model(p, q.numpy(), k.numpy(), v.numpy())[0]
+    np.testing.assert_allclose(got, m(q, k, v).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_runtime_sdpa_mask_and_gqa_roundtrip(tmp_path):
+    import paddle_tpu.nn.functional as F
+
+    class Attn(nn.Layer):
+        def forward(self, q, k, v, mask):
+            return F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                                  training=False)
+
+    rs = np.random.RandomState(2)
+    q = paddle.to_tensor(rs.randn(2, 6, 4, 8).astype(np.float32))
+    k = paddle.to_tensor(rs.randn(2, 6, 2, 8).astype(np.float32))  # GQA
+    v = paddle.to_tensor(rs.randn(2, 6, 2, 8).astype(np.float32))
+    mask = paddle.to_tensor(
+        (rs.rand(2, 1, 6, 6) < 0.8).astype(np.float32) * -1e4)
+    m = Attn()
+    p = export(m, str(tmp_path / "sdpam"), input_spec=[q, k, v, mask])
+    got = run_model(p, q.numpy(), k.numpy(), v.numpy(), mask.numpy())[0]
+    np.testing.assert_allclose(got, m(q, k, v, mask).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_runtime_matmul_transpose_flags(tmp_path):
+    class M(nn.Layer):
+        def forward(self, x, w):
+            return paddle.matmul(x, w, transpose_y=True)
+
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(2, 5, 8).astype(np.float32))
+    w = paddle.to_tensor(rs.randn(7, 8).astype(np.float32))
+    m = M()
+    p = export(m, str(tmp_path / "mmt"), input_spec=[x, w])
+    got = run_model(p, x.numpy(), w.numpy())[0]
+    np.testing.assert_allclose(got, m(x, w).numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_runtime_bert_tiny_dynamic_batch(tmp_path):
+    """Whole-model oracle: BERT-tiny exports with a symbolic batch and the
+    numpy runtime reproduces the eager forward at a DIFFERENT batch."""
+    from paddle_tpu.jit.to_static import InputSpec
+    from paddle_tpu.models.bert import BertConfig, BertModel
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=32, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    m = BertModel(cfg)
+    m.eval()
+    p = export(m, str(tmp_path / "bert"),
+               input_spec=[InputSpec([None, 12], "int64")])
+    ids = np.random.RandomState(5).randint(0, 64, (3, 12)).astype("int64")
+    want = m(paddle.to_tensor(ids))
+    got = run_model(p, ids)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(g, w.numpy(), rtol=1e-4, atol=2e-5)
+
+
+def test_runtime_gpt_tied_head_dynamic_batch(tmp_path):
+    """GPT-tiny: tied-embedding LM head (matmul transpose_y recovery) +
+    [B*H,S,D] head-merge reshapes must stay batch-polymorphic."""
+    from paddle_tpu.jit.to_static import InputSpec
+    from paddle_tpu.models import GPTForPretraining, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("tiny", max_position_embeddings=32,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    p = export(m, str(tmp_path / "gpt"),
+               input_spec=[InputSpec([None, 12], "int64")])
+    ids = np.random.RandomState(6).randint(
+        0, cfg.vocab_size, (2, 12)).astype("int64")
+    want = m(paddle.to_tensor(ids)).numpy()
+    got = run_model(p, ids)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-5)
+
+
+def test_runtime_resnet18_roundtrip(tmp_path):
+    """Vision flagship: resnet18 (conv/bn/maxpool/globalpool attr
+    recovery at a symbolic batch) runs under the numpy ONNX runtime."""
+    from paddle_tpu.jit.to_static import InputSpec
+    from paddle_tpu.vision import models as vm
+
+    paddle.seed(0)
+    m = vm.resnet18(num_classes=10)
+    m.eval()
+    p = export(m, str(tmp_path / "rn18"),
+               input_spec=[InputSpec([None, 3, 32, 32], "float32")])
+    x = np.random.RandomState(1).randn(2, 3, 32, 32).astype(np.float32)
+    want = m(paddle.to_tensor(x)).numpy()
+    got = run_model(p, x)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_runtime_batch_axis_slice_stays_symbolic(tmp_path):
+    """Slicing the SYMBOLIC batch axis must not bake the example batch:
+    x[1:] exports with an open-ended Slice and works at a batch the
+    trace never saw (code-review r4 finding)."""
+    from paddle_tpu.jit.to_static import InputSpec
+
+    class M(nn.Layer):
+        def forward(self, x):
+            return x[1:] * 2.0
+
+    p = export(M(), str(tmp_path / "bslice"),
+               input_spec=[InputSpec([None, 4], "float32")])
+    x = np.random.RandomState(0).randn(9, 4).astype(np.float32)
+    got = run_model(p, x)[0]
+    np.testing.assert_allclose(got, x[1:] * 2.0, rtol=1e-6)
+
+
+def test_runtime_batch_axis_negative_index_refused(tmp_path):
+    """x[-1] on the symbolic batch axis cannot be expressed without
+    baking the example size — must refuse, not mis-export."""
+    from paddle_tpu.jit.to_static import InputSpec
+
+    class M(nn.Layer):
+        def forward(self, x):
+            return x[-1]
+
+    with pytest.raises(NotImplementedError, match="symbolic batch"):
+        export(M(), str(tmp_path / "bneg"),
+               input_spec=[InputSpec([None, 4], "float32")])
+
+
+def test_runtime_separated_advanced_index_refused(tmp_path):
+    """numpy moves an array-index result axis to the FRONT when it is
+    separated from int indices by a slice; the Slice+Gather lowering
+    cannot express that — must refuse, not emit a transposed graph
+    (code-review r4 finding)."""
+    idx = paddle.to_tensor(np.array([0, 2], np.int64))
+
+    class M(nn.Layer):
+        def forward(self, x):
+            return x[2, :, idx]
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 5, 6).astype(np.float32))
+    with pytest.raises(NotImplementedError, match="axis reordering|decompose"):
+        export(M(), str(tmp_path / "sep"), input_spec=[x])
+
+
+def test_runtime_thirteen_divisible_dims_no_collision(tmp_path):
+    """Twin-trace batch detection must not confuse REAL dims that equal
+    or divide the example batch (13/26-unit layers) with the batch."""
+    from paddle_tpu.jit.to_static import InputSpec
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 26)
+
+        def forward(self, x):
+            h = self.fc(x)                     # [B, 26]
+            return h.reshape([-1, 13])         # [B*2, 13]
+
+    m = M()
+    p = export(m, str(tmp_path / "thirteen"),
+               input_spec=[InputSpec([None, 8], "float32")])
+    x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+    got = run_model(p, x)[0]
+    want = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
