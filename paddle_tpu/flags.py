@@ -111,6 +111,12 @@ define_flag("pallas_gqa", False,
             "TPU; default off — the GQA dkv Mosaic compile hung the "
             "remote compiler on v5e (2026-07-30, see NOTES_r4); "
             "interpret-mode tests cover it regardless")
+define_flag("sot_relax_guards", False,
+            "SOT-lite: allow widening value-equality guards to shape-only"
+            " when a re-record demonstrates an identical op stream and "
+            "outputs.  UNSOUND if a host-read value steers python "
+            "control flow near a threshold the demonstrations did not "
+            "cross — enable only when host reads are logging-only")
 define_flag("pallas_interpret", False,
             "run Pallas kernels in interpreter mode (CPU tests)")
 define_flag("pallas_autotune", False,

@@ -160,6 +160,31 @@ class _Segment:
         self.pure = jax.jit(pure)
 
 
+def _op_spec_sig(ops, breaks):
+    """Structural signature of a recording: op names, tensor shapes/
+    dtypes, non-tensor kwargs, and break positions/shapes.  Two
+    recordings with equal signatures took the SAME python control-flow
+    path and differ at most in data values."""
+    def tsig(t):
+        return (tuple(t._data.shape), str(t._data.dtype))
+
+    def ksig(v):
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return ("arr", tuple(v.shape), str(v.dtype))
+        if isinstance(v, tuple):
+            return tuple(ksig(i) for i in v)
+        return repr(v)
+
+    return (
+        tuple((op.name,
+               tuple(tsig(t) for t in op.inputs),
+               tuple(tsig(t) for t in op.outputs),
+               tuple(sorted((k, ksig(v)) for k, v in op.kwargs.items())))
+              for op in ops),
+        tuple(sorted((i, v.shape, str(v.dtype)) for i, _, v in breaks)),
+    )
+
+
 class SotTrace:
     """One guard-specialized compiled chain for one input signature."""
 
@@ -170,6 +195,10 @@ class SotTrace:
         out_leaf_ids = [id(t) for t in out_leaves]
         self.out_leaf_ids = out_leaf_ids
         self.input_ids = input_ids
+        self.spec_sig = _op_spec_sig(ops, recording.breaks)
+        # set by replay(): None (ok) | "value" (all guard failures were
+        # value-only at matching shapes — relaxation candidate) | "shape"
+        self.last_fail: Optional[str] = None
 
         # break positions cut the stream; merge duplicates at one index
         bounds = sorted({i for i, _, _ in recording.breaks})
@@ -179,10 +208,12 @@ class SotTrace:
             spans.append((prev, b))
             prev = b
         spans.append((prev, len(ops)))
-        # guards grouped by their boundary index
-        self.guards_at: Dict[int, List[Tuple[Tensor, np.ndarray]]] = {}
+        # guards grouped by their boundary index; the third slot is
+        # check_value — flipped to False when relaxation demonstrates the
+        # leaked value does not steer control flow (shape check remains)
+        self.guards_at: Dict[int, List[List]] = {}
         for i, t, v in recording.breaks:
-            self.guards_at.setdefault(i, []).append((t, v))
+            self.guards_at.setdefault(i, []).append([t, v, True])
 
         needed_later: Dict[int, int] = {}      # id -> last span needing it
         for si, (a, b) in enumerate(spans):
@@ -239,10 +270,14 @@ class SotTrace:
             self._tensors.setdefault(id(t), t)
 
     # -- replay ------------------------------------------------------------
-    def replay(self, input_tensors: Sequence[Tensor]):
-        """Run the compiled chain.  Returns the rebuilt output, or None if
-        a guard failed (caller records a new specialization)."""
+    def replay(self, input_tensors: Sequence[Tensor], force: bool = False):
+        """Run the compiled chain.  Returns the rebuilt output, or None
+        if a guard failed (caller records a new specialization); the
+        failure kind lands in ``self.last_fail``.  With ``force`` the
+        chain runs to completion ignoring VALUE mismatches (used by the
+        relaxation probe) — shape mismatches still abort."""
         env: Dict[int, Tensor] = dict(zip(self.input_ids, input_tensors))
+        self.last_fail = None
 
         def resolve(tid) -> Tensor:
             t = env.get(tid)
@@ -263,13 +298,26 @@ class SotTrace:
                         o.stop_gradient = rec_t.stop_gradient
                     env[tid] = o
             # guards at this boundary
-            for t, expected in self.guards_at.get(end_bound, ()):  # noqa: B909
+            for g in self.guards_at.get(end_bound, ()):  # noqa: B909
+                t, expected, check_value = g
                 cur = env.get(id(t), t)
                 got = np.asarray(cur._data)
-                if got.shape != expected.shape or \
-                        not np.array_equal(got, expected):
+                if got.shape != expected.shape:
+                    self.last_fail = "shape"
                     return None
+                if check_value and not np.array_equal(got, expected):
+                    self.last_fail = "value"
+                    if not force:
+                        return None
         return self._rebuild(env)
+
+    def relax_value_guards(self):
+        """Flip every guard to shape-only (called once a probe run has
+        demonstrated the leaked values do not alter the op stream or the
+        outputs)."""
+        for gs in self.guards_at.values():
+            for g in gs:
+                g[2] = False
 
     def _rebuild(self, env):
         def walk(o):
@@ -311,24 +359,69 @@ def build_trace(recording: _Recording, input_tensors: Sequence[Tensor],
     return trace, output
 
 
+def _leaves_allclose(a, b, rtol=1e-4, atol=1e-6) -> bool:
+    """Structural comparison of two outputs' Tensor leaves."""
+    if isinstance(a, Tensor) and isinstance(b, Tensor):
+        x, y = np.asarray(a._data), np.asarray(b._data)
+        return x.shape == y.shape and bool(
+            np.allclose(x, y, rtol=rtol, atol=atol, equal_nan=True))
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            _leaves_allclose(x, y, rtol, atol) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _leaves_allclose(a[k], b[k], rtol, atol) for k in a)
+    try:
+        return bool(a == b)
+    except Exception:
+        return False
+
+
 class SotCache:
     """Per-signature list of guard-specialized traces.
 
     ``gave_up`` stops NEW recordings only — already-compiled traces keep
-    being consulted, so recurring guard values still hit the cache."""
+    being consulted, so recurring guard values still hit the cache.
+
+    Guard RELAXATION (``FLAGS_sot_relax_guards``, default OFF): a
+    value-equality guard re-records whenever a merely-LOGGED scalar
+    changes (loss printed every step → a re-record every step until the
+    cap).  With the flag on, when a re-record produces the structurally
+    identical op stream AND the old chain probe-replays to the new
+    eager outputs, the old trace's guards widen to shape-only and the
+    new trace is discarded.  This is deliberately opt-in: two
+    demonstrations on the same side of a data-dependent python branch
+    (``if float(s) > 0``) cannot prove the predicate for inputs that
+    cross the threshold — value-equality guards are the SOUND default,
+    and the flag is the user's assertion that host reads are
+    logging-only."""
 
     def __init__(self):
         self.traces: List[SotTrace] = []
         self.gave_up = False
+        self._relax_candidates: List[SotTrace] = []
 
     def lookup_and_replay(self, input_tensors):
+        self._relax_candidates = []
         for trace in self.traces:
             out = trace.replay(input_tensors)
             if out is not None:
                 return out
+            if trace.last_fail == "value":
+                self._relax_candidates.append(trace)
         return None
 
-    def add(self, trace: SotTrace):
+    def add(self, trace: SotTrace, input_tensors=None, eager_out=None):
+        from ..flags import get_flag
+        if input_tensors is not None and get_flag("sot_relax_guards"):
+            for cand in self._relax_candidates:
+                if cand.spec_sig != trace.spec_sig:
+                    continue
+                probe = cand.replay(input_tensors, force=True)
+                if probe is not None and _leaves_allclose(probe,
+                                                          eager_out):
+                    cand.relax_value_guards()
+                    return          # old trace now covers this path
         self.traces.append(trace)
         if len(self.traces) >= MAX_TRACES_PER_SIG:
             self.gave_up = True

@@ -332,7 +332,7 @@ class StaticFunction:
             sot.gave_up = True
             return out
         trace, out = sot_lite.build_trace(rec, inputs, out)
-        sot.add(trace)
+        sot.add(trace, inputs, out)
         if sot.gave_up:
             warnings.warn(
                 f"to_static: {len(sot.traces)} guard specializations for "

@@ -406,8 +406,11 @@ def _emit_op(e: _Emit, op) -> None:
               "exp": "Exp", "sqrt": "Sqrt", "abs": "Abs", "neg": "Neg",
               "erf": "Erf", "log": "Log", "floor": "Floor",
               "ceil": "Ceil", "identity": "Identity"}
+    # no "matmul" here: it MUST go through the transpose-flag recovery
+    # branch below (a plain MatMul on transposed operands would be a
+    # silently wrong graph)
     binary = {"add": "Add", "subtract": "Sub", "multiply": "Mul",
-              "divide": "Div", "matmul": "MatMul", "pow": "Pow",
+              "divide": "Div", "pow": "Pow",
               "maximum": "Max", "minimum": "Min"}
     if name in simple:
         e.add(simple[name], ins, out(name))

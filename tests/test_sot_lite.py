@@ -306,3 +306,73 @@ print(float(f(paddle.to_tensor(np.ones((4, 4), "float32"))).numpy()))
                           timeout=240)
     assert out2.returncode == 0, out2.stderr[-800:]
     assert out1.stdout.strip() == out2.stdout.strip()
+
+
+def test_logged_scalar_guard_relaxes_with_flag():
+    """With FLAGS_sot_relax_guards on, a host-read scalar that is ONLY
+    logged must not re-record forever: the second record demonstrates
+    the op stream is value-independent, the guard widens to shape-only,
+    and every later call replays the compiled chain."""
+    logged = []
+
+    def f(x):
+        h = x * 2.0
+        logged.append(float(h.sum()))     # host read → graph break
+        return h + 1.0
+
+    fn = to_static(f)
+    paddle.set_flags({"FLAGS_sot_relax_guards": True})
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i in range(6):
+                x = paddle.to_tensor(np.full((3,), float(i), np.float32))
+                np.testing.assert_allclose(
+                    fn(x).numpy(), np.full((3,), 2.0 * i + 1.0),
+                    rtol=1e-6)
+    finally:
+        paddle.set_flags({"FLAGS_sot_relax_guards": False})
+    sot = next(iter(fn._sot_cache.values()))
+    assert len(sot.traces) == 1, "relaxation should keep ONE trace"
+    assert not sot.gave_up
+    # python body ran only for the two recordings; replays skip it
+    assert len(logged) == 2, logged
+
+
+def test_branch_on_host_read_stays_sound_by_default():
+    """Value guards are the SOUND default: a predicate branch on a host
+    read must keep per-branch specializations — inputs that cross the
+    threshold after two same-side observations still get the right
+    branch (the unsoundness that keeps relaxation opt-in)."""
+    def f(x):
+        s = float(x.sum())
+        return x * 2.0 if s > 0 else x * 3.0
+
+    fn = to_static(f)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        a = fn(paddle.to_tensor(np.full((2,), -2.0, np.float32)))
+        b = fn(paddle.to_tensor(np.full((2,), -1.0, np.float32)))
+        c = fn(paddle.to_tensor(np.full((2,), 2.0, np.float32)))
+    np.testing.assert_allclose(a.numpy(), [-6.0, -6.0])
+    np.testing.assert_allclose(b.numpy(), [-3.0, -3.0])
+    np.testing.assert_allclose(c.numpy(), [4.0, 4.0])  # crossed: x*2
+
+
+def test_baked_scalar_still_respecialises():
+    """Relaxation must NOT fire when the leaked value feeds computation:
+    the probe replay reproduces the OLD constant, outputs differ, and a
+    fresh specialization is recorded (value semantics preserved)."""
+    def f(x):
+        s = float(x.sum())
+        return x + s                      # s is baked into the chain
+
+    fn = to_static(f)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(3):
+            x = paddle.to_tensor(np.full((2,), float(i), np.float32))
+            np.testing.assert_allclose(
+                fn(x).numpy(), np.full((2,), 3.0 * i), rtol=1e-6)
+    sot = next(iter(fn._sot_cache.values()))
+    assert len(sot.traces) == 3           # one per distinct baked value
