@@ -2,10 +2,14 @@
 python/paddle/distribution/: ~20 distributions, kl.py registry,
 transform.py flows).
 
-TPU-native: densities/entropies are jnp expressions traced through the
-op layer (they jit and differentiate like any op); sampling draws keys
-from the global generator (paddle_tpu.random_state) and uses jax.random
-— reparameterized (rsample) wherever the reference supports it.
+TPU-native: densities/entropies/reparameterized samplers are jnp
+expressions traced through the dispatch layer (``_op`` → ``call_op``), so
+they join the autograd tape and differentiate wrt distribution parameters
+— the reference's distributions back ELBO/policy-gradient losses, so
+``kl_divergence(Normal(mu, sigma), ...)`` must produce grads for mu/sigma.
+Sampling draws keys from the global generator (paddle_tpu.random_state)
+and uses jax.random — reparameterized (rsample) wherever the reference
+supports it; non-reparameterizable samplers return detached tensors.
 """
 from __future__ import annotations
 
@@ -37,6 +41,20 @@ def _arr(x):
         return x._data
     return jnp.asarray(np.asarray(x), jnp.float32) \
         if not isinstance(x, jnp.ndarray) else x
+
+
+def _tens(x) -> Tensor:
+    """Lift to Tensor preserving tape identity for Tensor inputs."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(_arr(x))
+
+
+def _op(fn, *args, name=""):
+    """Trace ``fn(*arrays)`` through the dispatch layer: the result joins
+    the autograd tape and grads flow to any Tensor argument."""
+    from ..core.dispatch import call_op
+    return call_op(fn, [_tens(a) for a in args], {}, op_name=name)
 
 
 def _shape(shape) -> Tuple[int, ...]:
@@ -82,7 +100,7 @@ class Distribution:
         raise NotImplementedError
 
     def prob(self, value):
-        return Tensor(jnp.exp(self.log_prob(value)._data))
+        return self.log_prob(value).exp()
 
     def entropy(self):
         raise NotImplementedError
@@ -103,169 +121,203 @@ class Normal(Distribution):
     """ref: distribution/normal.py."""
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self._loc = _tens(loc)
+        self._scale = _tens(scale)
+        self.loc = self._loc._data
+        self.scale = self._scale._data
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda l: jnp.broadcast_to(l, sh), self._loc,
+                   name="normal_mean")
 
     @property
     def variance(self):
-        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda s: jnp.broadcast_to(s ** 2, sh), self._scale,
+                   name="normal_variance")
 
     @property
     def stddev(self):
-        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda s: jnp.broadcast_to(s, sh), self._scale,
+                   name="normal_stddev")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        eps = jax.random.normal(key, self._extend(shape))
-        return Tensor(self.loc + self.scale * eps)
+        sh = self._extend(shape)
+        return _op(lambda l, s: l + s * jax.random.normal(key, sh),
+                   self._loc, self._scale, name="normal_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        var = self.scale ** 2
-        return Tensor(-((v - self.loc) ** 2) / (2 * var)
-                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return _op(lambda l, s, v: -((v - l) ** 2) / (2 * s ** 2)
+                   - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+                   self._loc, self._scale, value, name="normal_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(
-            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
-            self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda s: jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), sh),
+            self._scale, name="normal_entropy")
 
     def cdf(self, value):
-        v = _arr(value)
-        return Tensor(0.5 * (1 + jax.scipy.special.erf(
-            (v - self.loc) / (self.scale * math.sqrt(2)))))
+        return _op(lambda l, s, v: 0.5 * (1 + jax.scipy.special.erf(
+            (v - l) / (s * math.sqrt(2)))),
+            self._loc, self._scale, value, name="normal_cdf")
 
 
 class LogNormal(Distribution):
     """ref: distribution/lognormal.py."""
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self._loc = _tens(loc)
+        self._scale = _tens(scale)
+        self.loc = self._loc._data
+        self.scale = self._scale._data
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+        return _op(lambda l, s: jnp.exp(l + s ** 2 / 2),
+                   self._loc, self._scale, name="lognormal_mean")
 
     @property
     def variance(self):
-        s2 = self.scale ** 2
-        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+        return _op(lambda l, s: (jnp.exp(s ** 2) - 1)
+                   * jnp.exp(2 * l + s ** 2),
+                   self._loc, self._scale, name="lognormal_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        eps = jax.random.normal(key, self._extend(shape))
-        return Tensor(jnp.exp(self.loc + self.scale * eps))
+        sh = self._extend(shape)
+        return _op(lambda l, s: jnp.exp(l + s * jax.random.normal(key, sh)),
+                   self._loc, self._scale, name="lognormal_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        logv = jnp.log(v)
-        var = self.scale ** 2
-        return Tensor(-((logv - self.loc) ** 2) / (2 * var) - logv
-                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        def f(l, s, v):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s ** 2) - logv
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        return _op(f, self._loc, self._scale, value,
+                   name="lognormal_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.broadcast_to(
-            self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
-            + jnp.log(self.scale), self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda l, s: jnp.broadcast_to(
+            l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), sh),
+            self._loc, self._scale, name="lognormal_entropy")
 
 
 class Uniform(Distribution):
     """ref: distribution/uniform.py."""
 
     def __init__(self, low, high, name=None):
-        self.low = _arr(low)
-        self.high = _arr(high)
+        self._low = _tens(low)
+        self._high = _tens(high)
+        self.low = self._low._data
+        self.high = self._high._data
         super().__init__(jnp.broadcast_shapes(self.low.shape,
                                               self.high.shape))
 
     @property
     def mean(self):
-        return Tensor((self.low + self.high) / 2)
+        return _op(lambda lo, hi: (lo + hi) / 2, self._low, self._high,
+                   name="uniform_mean")
 
     @property
     def variance(self):
-        return Tensor((self.high - self.low) ** 2 / 12)
+        return _op(lambda lo, hi: (hi - lo) ** 2 / 12,
+                   self._low, self._high, name="uniform_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        u = jax.random.uniform(key, self._extend(shape))
-        return Tensor(self.low + (self.high - self.low) * u)
+        sh = self._extend(shape)
+        return _op(lambda lo, hi: lo + (hi - lo)
+                   * jax.random.uniform(key, sh),
+                   self._low, self._high, name="uniform_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        inside = (v >= self.low) & (v < self.high)
-        lp = -jnp.log(self.high - self.low)
-        return Tensor(jnp.where(inside, lp, -jnp.inf))
+        def f(lo, hi, v):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return _op(f, self._low, self._high, value, name="uniform_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.log(self.high - self.low)
-                      + jnp.zeros(self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda lo, hi: jnp.log(hi - lo) + jnp.zeros(sh),
+                   self._low, self._high, name="uniform_entropy")
 
 
 class Beta(ExponentialFamily):
     """ref: distribution/beta.py."""
 
     def __init__(self, alpha, beta, name=None):
-        self.alpha = _arr(alpha)
-        self.beta = _arr(beta)
+        self._alpha = _tens(alpha)
+        self._beta = _tens(beta)
+        self.alpha = self._alpha._data
+        self.beta = self._beta._data
         super().__init__(jnp.broadcast_shapes(self.alpha.shape,
                                               self.beta.shape))
 
     @property
     def mean(self):
-        return Tensor(self.alpha / (self.alpha + self.beta))
+        return _op(lambda a, b: a / (a + b), self._alpha, self._beta,
+                   name="beta_mean")
 
     @property
     def variance(self):
-        t = self.alpha + self.beta
-        return Tensor(self.alpha * self.beta / (t * t * (t + 1)))
+        def f(a, b):
+            t = a + b
+            return a * b / (t * t * (t + 1))
+        return _op(f, self._alpha, self._beta, name="beta_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
         k1, k2 = jax.random.split(key)
         sh = self._extend(shape)
-        ga = jax.random.gamma(k1, jnp.broadcast_to(self.alpha, sh))
-        gb = jax.random.gamma(k2, jnp.broadcast_to(self.beta, sh))
-        return Tensor(ga / (ga + gb))
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, sh))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, sh))
+            return ga / (ga + gb)
+        return _op(f, self._alpha, self._beta, name="beta_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        from jax.scipy.special import betaln
-        return Tensor((self.alpha - 1) * jnp.log(v)
-                      + (self.beta - 1) * jnp.log1p(-v)
-                      - betaln(self.alpha, self.beta))
+        def f(a, b, v):
+            from jax.scipy.special import betaln
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+        return _op(f, self._alpha, self._beta, value, name="beta_log_prob")
 
     def entropy(self):
-        from jax.scipy.special import betaln, digamma
-        a, b = self.alpha, self.beta
-        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
-                      - (b - 1) * digamma(b)
-                      + (a + b - 2) * digamma(a + b))
+        def f(a, b):
+            from jax.scipy.special import betaln, digamma
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+        return _op(f, self._alpha, self._beta, name="beta_entropy")
 
 
 class Bernoulli(ExponentialFamily):
     """ref: distribution/bernoulli.py."""
 
     def __init__(self, probs, name=None):
-        self.probs = _arr(probs)
+        self._probs = _tens(probs)
+        self.probs = self._probs._data
         super().__init__(self.probs.shape)
 
     @property
     def mean(self):
-        return Tensor(self.probs)
+        return _op(lambda p: p, self._probs, name="bernoulli_mean")
 
     @property
     def variance(self):
-        return Tensor(self.probs * (1 - self.probs))
+        return _op(lambda p: p * (1 - p), self._probs,
+                   name="bernoulli_variance")
 
     def sample(self, shape=()):
         key = random_state.next_key()
@@ -273,31 +325,38 @@ class Bernoulli(ExponentialFamily):
             key, self.probs, self._extend(shape)).astype(jnp.float32))
 
     def log_prob(self, value):
-        v = _arr(value)
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+        def f(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return _op(f, self._probs, value, name="bernoulli_log_prob")
 
     def entropy(self):
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return _op(f, self._probs, name="bernoulli_entropy")
 
 
 class Binomial(Distribution):
     """ref: distribution/binomial.py."""
 
     def __init__(self, total_count, probs, name=None):
-        self.total_count = _arr(total_count)
-        self.probs = _arr(probs)
+        self._total_count = _tens(total_count)
+        self._probs = _tens(probs)
+        self.total_count = self._total_count._data
+        self.probs = self._probs._data
         super().__init__(jnp.broadcast_shapes(self.total_count.shape,
                                               self.probs.shape))
 
     @property
     def mean(self):
-        return Tensor(self.total_count * self.probs)
+        return _op(lambda n, p: n * p, self._total_count, self._probs,
+                   name="binomial_mean")
 
     @property
     def variance(self):
-        return Tensor(self.total_count * self.probs * (1 - self.probs))
+        return _op(lambda n, p: n * p * (1 - p),
+                   self._total_count, self._probs, name="binomial_variance")
 
     def sample(self, shape=()):
         key = random_state.next_key()
@@ -310,25 +369,32 @@ class Binomial(Distribution):
         return Tensor(draws.sum(axis=len(_shape(shape))).astype(jnp.float32))
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _arr(value)
-        n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
-                      + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        def f(n, p, v):
+            from jax.scipy.special import gammaln
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return _op(f, self._total_count, self._probs, value,
+                   name="binomial_log_prob")
 
 
 class Categorical(Distribution):
     """ref: distribution/categorical.py (logits parameterization)."""
 
     def __init__(self, logits, name=None):
-        self.logits = _arr(logits)
+        self._logits = _tens(logits)
+        self.logits = self._logits._data
         super().__init__(self.logits.shape[:-1])
         self._n = self.logits.shape[-1]
 
+    @staticmethod
+    def _lp(logits):
+        return logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True)
+
     @property
     def _log_pmf(self):
-        return self.logits - jax.scipy.special.logsumexp(
-            self.logits, axis=-1, keepdims=True)
+        return self._lp(self.logits)
 
     def sample(self, shape=()):
         key = random_state.next_key()
@@ -336,183 +402,220 @@ class Categorical(Distribution):
             key, self.logits, shape=_shape(shape) + self._batch_shape))
 
     def log_prob(self, value):
-        v = _arr(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(
-            self._log_pmf, v[..., None], axis=-1)[..., 0])
+        def f(lg, v):
+            v = v.astype(jnp.int32)
+            return jnp.take_along_axis(self._lp(lg), v[..., None],
+                                       axis=-1)[..., 0]
+        return _op(f, self._logits, value, name="categorical_log_prob")
 
     def probs(self, value=None):
-        p = jnp.exp(self._log_pmf)
         if value is None:
-            return Tensor(p)
-        v = _arr(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0])
+            return _op(lambda lg: jnp.exp(self._lp(lg)), self._logits,
+                       name="categorical_probs")
+
+        def f(lg, v):
+            v = v.astype(jnp.int32)
+            return jnp.take_along_axis(jnp.exp(self._lp(lg)),
+                                       v[..., None], axis=-1)[..., 0]
+        return _op(f, self._logits, value, name="categorical_probs")
 
     def entropy(self):
-        lp = self._log_pmf
-        return Tensor(-(jnp.exp(lp) * lp).sum(-1))
+        def f(lg):
+            lp = self._lp(lg)
+            return -(jnp.exp(lp) * lp).sum(-1)
+        return _op(f, self._logits, name="categorical_entropy")
 
 
 class Cauchy(Distribution):
     """ref: distribution/cauchy.py."""
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self._loc = _tens(loc)
+        self._scale = _tens(scale)
+        self.loc = self._loc._data
+        self.scale = self._scale._data
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        u = jax.random.uniform(key, self._extend(shape), minval=1e-6,
-                               maxval=1 - 1e-6)
-        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+        sh = self._extend(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, sh, minval=1e-6, maxval=1 - 1e-6)
+            return l + s * jnp.tan(math.pi * (u - 0.5))
+        return _op(f, self._loc, self._scale, name="cauchy_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        z = (v - self.loc) / self.scale
-        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+        def f(l, s, v):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+        return _op(f, self._loc, self._scale, value, name="cauchy_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.log(4 * math.pi * self.scale)
-                      + jnp.zeros(self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda s: jnp.log(4 * math.pi * s) + jnp.zeros(sh),
+                   self._scale, name="cauchy_entropy")
 
     def cdf(self, value):
-        v = _arr(value)
-        return Tensor(jnp.arctan((v - self.loc) / self.scale) / math.pi
-                      + 0.5)
+        return _op(lambda l, s, v: jnp.arctan((v - l) / s) / math.pi + 0.5,
+                   self._loc, self._scale, value, name="cauchy_cdf")
 
 
 class Gamma(ExponentialFamily):
     """ref: distribution/gamma.py (concentration/rate)."""
 
     def __init__(self, concentration, rate, name=None):
-        self.concentration = _arr(concentration)
-        self.rate = _arr(rate)
+        self._concentration = _tens(concentration)
+        self._rate = _tens(rate)
+        self.concentration = self._concentration._data
+        self.rate = self._rate._data
         super().__init__(jnp.broadcast_shapes(self.concentration.shape,
                                               self.rate.shape))
 
     @property
     def mean(self):
-        return Tensor(self.concentration / self.rate)
+        return _op(lambda a, b: a / b, self._concentration, self._rate,
+                   name="gamma_mean")
 
     @property
     def variance(self):
-        return Tensor(self.concentration / self.rate ** 2)
+        return _op(lambda a, b: a / b ** 2,
+                   self._concentration, self._rate, name="gamma_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
         sh = self._extend(shape)
-        g = jax.random.gamma(key, jnp.broadcast_to(self.concentration, sh))
-        return Tensor(g / self.rate)
+        # jax.random.gamma is reparameterized (implicit differentiation)
+        return _op(lambda a, b: jax.random.gamma(
+            key, jnp.broadcast_to(a, sh)) / b,
+            self._concentration, self._rate, name="gamma_rsample")
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _arr(value)
-        a, b = self.concentration, self.rate
-        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
-                      - gammaln(a))
+        def f(a, b, v):
+            from jax.scipy.special import gammaln
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - gammaln(a))
+        return _op(f, self._concentration, self._rate, value,
+                   name="gamma_log_prob")
 
     def entropy(self):
-        from jax.scipy.special import digamma, gammaln
-        a, b = self.concentration, self.rate
-        return Tensor(a - jnp.log(b) + gammaln(a)
-                      + (1 - a) * digamma(a))
+        def f(a, b):
+            from jax.scipy.special import digamma, gammaln
+            return a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+        return _op(f, self._concentration, self._rate, name="gamma_entropy")
 
 
 class Chi2(Gamma):
     """ref: distribution/chi2.py — Gamma(df/2, 1/2)."""
 
     def __init__(self, df, name=None):
-        df = _arr(df)
-        self.df = df
-        super().__init__(df / 2.0, jnp.full_like(df, 0.5))
+        df_t = _tens(df)
+        self.df = df_t._data
+        super().__init__(df_t * 0.5,
+                         Tensor(jnp.full_like(df_t._data, 0.5)))
 
 
 class Dirichlet(ExponentialFamily):
     """ref: distribution/dirichlet.py."""
 
     def __init__(self, concentration, name=None):
-        self.concentration = _arr(concentration)
+        self._concentration = _tens(concentration)
+        self.concentration = self._concentration._data
         super().__init__(self.concentration.shape[:-1],
                          self.concentration.shape[-1:])
 
     @property
     def mean(self):
-        return Tensor(self.concentration
-                      / self.concentration.sum(-1, keepdims=True))
+        return _op(lambda a: a / a.sum(-1, keepdims=True),
+                   self._concentration, name="dirichlet_mean")
 
     @property
     def variance(self):
-        a = self.concentration
-        a0 = a.sum(-1, keepdims=True)
-        return Tensor(a * (a0 - a) / (a0 * a0 * (a0 + 1)))
+        def f(a):
+            a0 = a.sum(-1, keepdims=True)
+            return a * (a0 - a) / (a0 * a0 * (a0 + 1))
+        return _op(f, self._concentration, name="dirichlet_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
         sh = _shape(shape) + self.concentration.shape
-        g = jax.random.gamma(key, jnp.broadcast_to(self.concentration, sh))
-        return Tensor(g / g.sum(-1, keepdims=True))
+
+        def f(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, sh))
+            return g / g.sum(-1, keepdims=True)
+        return _op(f, self._concentration, name="dirichlet_rsample")
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _arr(value)
-        a = self.concentration
-        return Tensor(((a - 1) * jnp.log(v)).sum(-1)
-                      + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+        def f(a, v):
+            from jax.scipy.special import gammaln
+            return (((a - 1) * jnp.log(v)).sum(-1)
+                    + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+        return _op(f, self._concentration, value, name="dirichlet_log_prob")
 
     def entropy(self):
-        from jax.scipy.special import digamma, gammaln
-        a = self.concentration
-        a0 = a.sum(-1)
-        k = a.shape[-1]
-        return Tensor(gammaln(a).sum(-1) - gammaln(a0)
-                      + (a0 - k) * digamma(a0)
-                      - ((a - 1) * digamma(a)).sum(-1))
+        def f(a):
+            from jax.scipy.special import digamma, gammaln
+            a0 = a.sum(-1)
+            k = a.shape[-1]
+            return (gammaln(a).sum(-1) - gammaln(a0)
+                    + (a0 - k) * digamma(a0)
+                    - ((a - 1) * digamma(a)).sum(-1))
+        return _op(f, self._concentration, name="dirichlet_entropy")
 
 
 class Exponential(ExponentialFamily):
     """ref: distribution/exponential.py."""
 
     def __init__(self, rate, name=None):
-        self.rate = _arr(rate)
+        self._rate = _tens(rate)
+        self.rate = self._rate._data
         super().__init__(self.rate.shape)
 
     @property
     def mean(self):
-        return Tensor(1.0 / self.rate)
+        return _op(lambda r: 1.0 / r, self._rate, name="exponential_mean")
 
     @property
     def variance(self):
-        return Tensor(1.0 / self.rate ** 2)
+        return _op(lambda r: 1.0 / r ** 2, self._rate,
+                   name="exponential_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        u = jax.random.uniform(key, self._extend(shape), minval=1e-7,
-                               maxval=1.0)
-        return Tensor(-jnp.log(u) / self.rate)
+        sh = self._extend(shape)
+
+        def f(r):
+            u = jax.random.uniform(key, sh, minval=1e-7, maxval=1.0)
+            return -jnp.log(u) / r
+        return _op(f, self._rate, name="exponential_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        return Tensor(jnp.log(self.rate) - self.rate * v)
+        return _op(lambda r, v: jnp.log(r) - r * v, self._rate, value,
+                   name="exponential_log_prob")
 
     def entropy(self):
-        return Tensor(1.0 - jnp.log(self.rate))
+        return _op(lambda r: 1.0 - jnp.log(r), self._rate,
+                   name="exponential_entropy")
 
 
 class Geometric(Distribution):
     """ref: distribution/geometric.py — failures before first success."""
 
     def __init__(self, probs, name=None):
-        self.probs = _arr(probs)
+        self._probs = _tens(probs)
+        self.probs = self._probs._data
         super().__init__(self.probs.shape)
 
     @property
     def mean(self):
-        return Tensor((1 - self.probs) / self.probs)
+        return _op(lambda p: (1 - p) / p, self._probs,
+                   name="geometric_mean")
 
     @property
     def variance(self):
-        return Tensor((1 - self.probs) / self.probs ** 2)
+        return _op(lambda p: (1 - p) / p ** 2, self._probs,
+                   name="geometric_variance")
 
     def sample(self, shape=()):
         key = random_state.next_key()
@@ -521,80 +624,100 @@ class Geometric(Distribution):
         return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
 
     def log_prob(self, value):
-        v = _arr(value)
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(v * jnp.log1p(-p) + jnp.log(p))
+        def f(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return _op(f, self._probs, value, name="geometric_log_prob")
 
     def entropy(self):
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+        return _op(f, self._probs, name="geometric_entropy")
 
 
 class Gumbel(Distribution):
     """ref: distribution/gumbel.py."""
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self._loc = _tens(loc)
+        self._scale = _tens(scale)
+        self.loc = self._loc._data
+        self.scale = self._scale._data
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(self.loc + self.scale * np.euler_gamma)
+        return _op(lambda l, s: l + s * np.euler_gamma,
+                   self._loc, self._scale, name="gumbel_mean")
 
     @property
     def variance(self):
-        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+        return _op(lambda s: (math.pi ** 2 / 6) * s ** 2, self._scale,
+                   name="gumbel_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        u = jax.random.uniform(key, self._extend(shape), minval=1e-7,
-                               maxval=1 - 1e-7)
-        return Tensor(self.loc - self.scale * jnp.log(-jnp.log(u)))
+        sh = self._extend(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, sh, minval=1e-7, maxval=1 - 1e-7)
+            return l - s * jnp.log(-jnp.log(u))
+        return _op(f, self._loc, self._scale, name="gumbel_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        z = (v - self.loc) / self.scale
-        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op(f, self._loc, self._scale, value, name="gumbel_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.log(self.scale) + 1 + np.euler_gamma
-                      + jnp.zeros(self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda s: jnp.log(s) + 1 + np.euler_gamma
+                   + jnp.zeros(sh), self._scale, name="gumbel_entropy")
 
 
 class Laplace(Distribution):
     """ref: distribution/laplace.py."""
 
     def __init__(self, loc, scale, name=None):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self._loc = _tens(loc)
+        self._scale = _tens(scale)
+        self.loc = self._loc._data
+        self.scale = self._scale._data
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda l: jnp.broadcast_to(l, sh), self._loc,
+                   name="laplace_mean")
 
     @property
     def variance(self):
-        return Tensor(2 * self.scale ** 2)
+        return _op(lambda s: 2 * s ** 2, self._scale,
+                   name="laplace_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        u = jax.random.uniform(key, self._extend(shape), minval=-0.5 + 1e-7,
-                               maxval=0.5 - 1e-7)
-        return Tensor(self.loc
-                      - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+        sh = self._extend(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, sh, minval=-0.5 + 1e-7,
+                                   maxval=0.5 - 1e-7)
+            return l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+        return _op(f, self._loc, self._scale, name="laplace_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        return Tensor(-jnp.abs(v - self.loc) / self.scale
-                      - jnp.log(2 * self.scale))
+        return _op(lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                   self._loc, self._scale, value, name="laplace_log_prob")
 
     def entropy(self):
-        return Tensor(1 + jnp.log(2 * self.scale)
-                      + jnp.zeros(self._batch_shape))
+        sh = self._batch_shape
+        return _op(lambda s: 1 + jnp.log(2 * s) + jnp.zeros(sh),
+                   self._scale, name="laplace_entropy")
 
 
 class Multinomial(Distribution):
@@ -602,16 +725,20 @@ class Multinomial(Distribution):
 
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
-        self.probs = _arr(probs)
+        self._probs = _tens(probs)
+        self.probs = self._probs._data
         super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
 
     @property
     def mean(self):
-        return Tensor(self.total_count * self.probs)
+        n = self.total_count
+        return _op(lambda p: n * p, self._probs, name="multinomial_mean")
 
     @property
     def variance(self):
-        return Tensor(self.total_count * self.probs * (1 - self.probs))
+        n = self.total_count
+        return _op(lambda p: n * p * (1 - p), self._probs,
+                   name="multinomial_variance")
 
     def sample(self, shape=()):
         key = random_state.next_key()
@@ -624,73 +751,85 @@ class Multinomial(Distribution):
         return Tensor(onehot.sum(0))
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _arr(value)
-        p = jnp.clip(self.probs, 1e-12, None)
-        return Tensor(gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
-                      + (v * jnp.log(p)).sum(-1))
+        def f(p, v):
+            from jax.scipy.special import gammaln
+            p = jnp.clip(p, 1e-12, None)
+            return (gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+                    + (v * jnp.log(p)).sum(-1))
+        return _op(f, self._probs, value, name="multinomial_log_prob")
 
 
 class MultivariateNormal(Distribution):
     """ref: distribution/multivariate_normal.py (loc + covariance)."""
 
     def __init__(self, loc, covariance_matrix=None, name=None):
-        self.loc = _arr(loc)
+        self._loc = _tens(loc)
+        self.loc = self._loc._data
         if covariance_matrix is None:
             covariance_matrix = jnp.eye(self.loc.shape[-1])
-        self.covariance_matrix = _arr(covariance_matrix)
-        self._chol = jnp.linalg.cholesky(self.covariance_matrix)
+        self._cov = _tens(covariance_matrix)
+        self.covariance_matrix = self._cov._data
         super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
 
     @property
     def mean(self):
-        return Tensor(self.loc)
+        return _op(lambda l: l, self._loc, name="mvn_mean")
 
     @property
     def variance(self):
-        return Tensor(jnp.diagonal(self.covariance_matrix, axis1=-2,
-                                   axis2=-1) + jnp.zeros_like(self.loc))
+        return _op(lambda l, c: jnp.diagonal(c, axis1=-2, axis2=-1)
+                   + jnp.zeros_like(l),
+                   self._loc, self._cov, name="mvn_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
-        eps = jax.random.normal(key, _shape(shape) + self.loc.shape)
-        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
-                                            self._chol, eps))
+        sh = _shape(shape) + self.loc.shape
+
+        def f(l, c):
+            L = jnp.linalg.cholesky(c)
+            eps = jax.random.normal(key, sh)
+            return l + jnp.einsum("...ij,...j->...i", L, eps)
+        return _op(f, self._loc, self._cov, name="mvn_rsample")
 
     def log_prob(self, value):
-        v = _arr(value)
-        d = v - self.loc
-        L = jnp.broadcast_to(self._chol,
-                             d.shape[:-1] + self._chol.shape[-2:])
-        sol = jax.scipy.linalg.solve_triangular(L, d[..., None],
-                                                lower=True)[..., 0]
         k = self.loc.shape[-1]
-        logdet = jnp.log(jnp.diagonal(self._chol, axis1=-2,
-                                      axis2=-1)).sum(-1)
-        return Tensor(-0.5 * (sol ** 2).sum(-1) - logdet
-                      - 0.5 * k * math.log(2 * math.pi))
+
+        def f(l, c, v):
+            L = jnp.linalg.cholesky(c)
+            d = v - l
+            Lb = jnp.broadcast_to(L, d.shape[:-1] + L.shape[-2:])
+            sol = jax.scipy.linalg.solve_triangular(
+                Lb, d[..., None], lower=True)[..., 0]
+            logdet = jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)).sum(-1)
+            return (-0.5 * (sol ** 2).sum(-1) - logdet
+                    - 0.5 * k * math.log(2 * math.pi))
+        return _op(f, self._loc, self._cov, value, name="mvn_log_prob")
 
     def entropy(self):
         k = self.loc.shape[-1]
-        logdet = jnp.log(jnp.diagonal(self._chol, axis1=-2,
-                                      axis2=-1)).sum(-1)
-        return Tensor(0.5 * k * (1 + math.log(2 * math.pi)) + logdet)
+
+        def f(c):
+            L = jnp.linalg.cholesky(c)
+            logdet = jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)).sum(-1)
+            return 0.5 * k * (1 + math.log(2 * math.pi)) + logdet
+        return _op(f, self._cov, name="mvn_entropy")
 
 
 class Poisson(ExponentialFamily):
     """ref: distribution/poisson.py."""
 
     def __init__(self, rate, name=None):
-        self.rate = _arr(rate)
+        self._rate = _tens(rate)
+        self.rate = self._rate._data
         super().__init__(self.rate.shape)
 
     @property
     def mean(self):
-        return Tensor(self.rate)
+        return _op(lambda r: r, self._rate, name="poisson_mean")
 
     @property
     def variance(self):
-        return Tensor(self.rate)
+        return _op(lambda r: r, self._rate, name="poisson_variance")
 
     def sample(self, shape=()):
         key = random_state.next_key()
@@ -698,48 +837,57 @@ class Poisson(ExponentialFamily):
             key, self.rate, self._extend(shape)).astype(jnp.float32))
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _arr(value)
-        return Tensor(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
+        def f(r, v):
+            from jax.scipy.special import gammaln
+            return v * jnp.log(r) - r - gammaln(v + 1)
+        return _op(f, self._rate, value, name="poisson_log_prob")
 
 
 class StudentT(Distribution):
     """ref: distribution/student_t.py."""
 
     def __init__(self, df, loc, scale, name=None):
-        self.df = _arr(df)
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
+        self._df = _tens(df)
+        self._loc = _tens(loc)
+        self._scale = _tens(scale)
+        self.df = self._df._data
+        self.loc = self._loc._data
+        self.scale = self._scale._data
         super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
                                               self.scale.shape))
 
     @property
     def mean(self):
-        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+        return _op(lambda d, l: jnp.where(d > 1, l, jnp.nan),
+                   self._df, self._loc, name="studentt_mean")
 
     @property
     def variance(self):
-        var = self.scale ** 2 * self.df / (self.df - 2)
-        return Tensor(jnp.where(self.df > 2, var, jnp.nan))
+        return _op(lambda d, s: jnp.where(d > 2,
+                                          s ** 2 * d / (d - 2), jnp.nan),
+                   self._df, self._scale, name="studentt_variance")
 
     def rsample(self, shape=()):
         key = random_state.next_key()
         k1, k2 = jax.random.split(key)
         sh = self._extend(shape)
-        z = jax.random.normal(k1, sh)
-        g = jax.random.gamma(k2, jnp.broadcast_to(self.df / 2, sh))
-        chi2 = 2 * g
-        return Tensor(self.loc
-                      + self.scale * z * jnp.sqrt(self.df / chi2))
+
+        def f(d, l, s):
+            z = jax.random.normal(k1, sh)
+            g = jax.random.gamma(k2, jnp.broadcast_to(d / 2, sh))
+            return l + s * z * jnp.sqrt(d / (2 * g))
+        return _op(f, self._df, self._loc, self._scale,
+                   name="studentt_rsample")
 
     def log_prob(self, value):
-        from jax.scipy.special import gammaln
-        v = _arr(value)
-        d, s = self.df, self.scale
-        z = (v - self.loc) / s
-        return Tensor(gammaln((d + 1) / 2) - gammaln(d / 2)
-                      - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
-                      - (d + 1) / 2 * jnp.log1p(z * z / d))
+        def f(d, l, s, v):
+            from jax.scipy.special import gammaln
+            z = (v - l) / s
+            return (gammaln((d + 1) / 2) - gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+        return _op(f, self._df, self._loc, self._scale, value,
+                   name="studentt_log_prob")
 
 
 # ---------------------------------------------------------------------------
@@ -747,19 +895,25 @@ class StudentT(Distribution):
 # ---------------------------------------------------------------------------
 
 class Transform:
-    """ref: transform.py Transform base (forward/inverse/log_det)."""
+    """ref: transform.py Transform base (forward/inverse/log_det).
+
+    Public methods trace through the dispatch layer: grads flow wrt the
+    input tensor (transform parameters are treated as constants, matching
+    the reference's flow usage where parameters live in the base
+    distribution)."""
 
     def forward(self, x):
-        return Tensor(self._forward(_arr(x)))
+        return _op(self._forward, x, name="transform_forward")
 
     def inverse(self, y):
-        return Tensor(self._inverse(_arr(y)))
+        return _op(self._inverse, y, name="transform_inverse")
 
     def forward_log_det_jacobian(self, x):
-        return Tensor(self._fldj(_arr(x)))
+        return _op(self._fldj, x, name="transform_fldj")
 
     def inverse_log_det_jacobian(self, y):
-        return Tensor(-self._fldj(self._inverse(_arr(y))))
+        return _op(lambda yy: -self._fldj(self._inverse(yy)), y,
+                   name="transform_ildj")
 
 
 class AffineTransform(Transform):
@@ -874,10 +1028,10 @@ class TransformedDistribution(Distribution):
         return self.transform.forward(x)
 
     def log_prob(self, value):
-        y = _arr(value)
-        x = self.transform._inverse(y)
-        base_lp = self.base.log_prob(Tensor(x))._data
-        return Tensor(base_lp - self.transform._fldj(x))
+        # Tensor-composed so grads reach the base's parameters
+        x = self.transform.inverse(_tens(value))
+        return (self.base.log_prob(x)
+                - self.transform.forward_log_det_jacobian(x))
 
 
 class Independent(Distribution):
@@ -898,12 +1052,14 @@ class Independent(Distribution):
         return self.base.sample(shape)
 
     def log_prob(self, value):
-        lp = self.base.log_prob(value)._data
-        return Tensor(lp.sum(axis=tuple(range(-self.rank, 0))))
+        axes = tuple(range(-self.rank, 0))
+        return _op(lambda lp: lp.sum(axis=axes),
+                   self.base.log_prob(value), name="independent_log_prob")
 
     def entropy(self):
-        e = self.base.entropy()._data
-        return Tensor(e.sum(axis=tuple(range(-self.rank, 0))))
+        axes = tuple(range(-self.rank, 0))
+        return _op(lambda e: e.sum(axis=axes), self.base.entropy(),
+                   name="independent_entropy")
 
 
 # ---------------------------------------------------------------------------
@@ -939,99 +1095,119 @@ def kl_divergence(p, q):
 
 @register_kl(Normal, Normal)
 def _kl_normal_normal(p, q):
-    vr = (p.scale / q.scale) ** 2
-    t1 = ((p.loc - q.loc) / q.scale) ** 2
-    return Tensor(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+    def f(pl, ps, ql, qs):
+        vr = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (vr + t1 - 1 - jnp.log(vr))
+    return _op(f, p._loc, p._scale, q._loc, q._scale, name="kl_normal")
 
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform_uniform(p, q):
-    out = jnp.log((q.high - q.low) / (p.high - p.low))
-    oob = (p.low < q.low) | (p.high > q.high)
-    return Tensor(jnp.where(oob, jnp.inf, out))
+    def f(pl, ph, ql, qh):
+        out = jnp.log((qh - ql) / (ph - pl))
+        oob = (pl < ql) | (ph > qh)
+        return jnp.where(oob, jnp.inf, out)
+    return _op(f, p._low, p._high, q._low, q._high, name="kl_uniform")
 
 
 @register_kl(Bernoulli, Bernoulli)
 def _kl_bern_bern(p, q):
-    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
-                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    def f(pp, qq):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(qq, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(qq))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    return _op(f, p._probs, q._probs, name="kl_bernoulli")
 
 
 @register_kl(Categorical, Categorical)
 def _kl_cat_cat(p, q):
-    lp, lq = p._log_pmf, q._log_pmf
-    return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
+    def f(lgp, lgq):
+        lp = Categorical._lp(lgp)
+        lq = Categorical._lp(lgq)
+        return (jnp.exp(lp) * (lp - lq)).sum(-1)
+    return _op(f, p._logits, q._logits, name="kl_categorical")
 
 
 @register_kl(Exponential, Exponential)
 def _kl_exp_exp(p, q):
-    r = q.rate / p.rate
-    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+    return _op(lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1,
+               p._rate, q._rate, name="kl_exponential")
 
 
 @register_kl(Gamma, Gamma)
 def _kl_gamma_gamma(p, q):
-    from jax.scipy.special import digamma, gammaln
-    a1, b1, a2, b2 = (p.concentration, p.rate, q.concentration, q.rate)
-    return Tensor((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
-                  + a2 * (jnp.log(b1) - jnp.log(b2))
-                  + a1 * (b2 - b1) / b1)
+    def f(a1, b1, a2, b2):
+        from jax.scipy.special import digamma, gammaln
+        return ((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+                + a2 * (jnp.log(b1) - jnp.log(b2))
+                + a1 * (b2 - b1) / b1)
+    return _op(f, p._concentration, p._rate, q._concentration, q._rate,
+               name="kl_gamma")
 
 
 @register_kl(Beta, Beta)
 def _kl_beta_beta(p, q):
-    from jax.scipy.special import betaln, digamma
-    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
-    t1 = betaln(a2, b2) - betaln(a1, b1)
-    return Tensor(t1 + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
-                  + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    def f(a1, b1, a2, b2):
+        from jax.scipy.special import betaln, digamma
+        t1 = betaln(a2, b2) - betaln(a1, b1)
+        return (t1 + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    return _op(f, p._alpha, p._beta, q._alpha, q._beta, name="kl_beta")
 
 
 @register_kl(Dirichlet, Dirichlet)
 def _kl_dir_dir(p, q):
-    from jax.scipy.special import digamma, gammaln
-    a, b = p.concentration, q.concentration
-    a0 = a.sum(-1)
-    return Tensor(gammaln(a0) - gammaln(a).sum(-1)
-                  - gammaln(b.sum(-1)) + gammaln(b).sum(-1)
-                  + ((a - b) * (digamma(a)
-                                - digamma(a0[..., None]))).sum(-1))
+    def f(a, b):
+        from jax.scipy.special import digamma, gammaln
+        a0 = a.sum(-1)
+        return (gammaln(a0) - gammaln(a).sum(-1)
+                - gammaln(b.sum(-1)) + gammaln(b).sum(-1)
+                + ((a - b) * (digamma(a)
+                              - digamma(a0[..., None]))).sum(-1))
+    return _op(f, p._concentration, q._concentration, name="kl_dirichlet")
 
 
 @register_kl(Laplace, Laplace)
 def _kl_laplace_laplace(p, q):
-    d = jnp.abs(p.loc - q.loc)
-    r = p.scale / q.scale
-    return Tensor(jnp.log(q.scale / p.scale) + r * jnp.exp(-d / p.scale)
-                  + d / q.scale - 1)
+    def f(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        r = ps / qs
+        return jnp.log(qs / ps) + r * jnp.exp(-d / ps) + d / qs - 1
+    return _op(f, p._loc, p._scale, q._loc, q._scale, name="kl_laplace")
 
 
 @register_kl(Poisson, Poisson)
 def _kl_poisson_poisson(p, q):
-    return Tensor(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
-                  - p.rate + q.rate)
+    return _op(lambda pr, qr: pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr,
+               p._rate, q._rate, name="kl_poisson")
 
 
 @register_kl(Geometric, Geometric)
 def _kl_geo_geo(p, q):
-    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-    return Tensor((jnp.log(pp) - jnp.log(qq)
-                   + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq))))
+    def f(pp, qq):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(qq, 1e-7, 1 - 1e-7)
+        return (jnp.log(pp) - jnp.log(qq)
+                + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    return _op(f, p._probs, q._probs, name="kl_geometric")
 
 
 @register_kl(MultivariateNormal, MultivariateNormal)
 def _kl_mvn_mvn(p, q):
     k = p.loc.shape[-1]
-    ql, pl = q._chol, p._chol
-    m = jax.scipy.linalg.solve_triangular(ql, pl, lower=True)
-    tr = (m ** 2).sum((-2, -1))
-    d = q.loc - p.loc
-    Lq = jnp.broadcast_to(ql, d.shape[:-1] + ql.shape[-2:])
-    sol = jax.scipy.linalg.solve_triangular(Lq, d[..., None],
-                                            lower=True)[..., 0]
-    logdet = (jnp.log(jnp.diagonal(ql, axis1=-2, axis2=-1)).sum(-1)
-              - jnp.log(jnp.diagonal(pl, axis1=-2, axis2=-1)).sum(-1))
-    return Tensor(0.5 * (tr + (sol ** 2).sum(-1) - k) + logdet)
+
+    def f(pl, pc, ql, qc):
+        Lp = jnp.linalg.cholesky(pc)
+        Lq = jnp.linalg.cholesky(qc)
+        m = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+        tr = (m ** 2).sum((-2, -1))
+        d = ql - pl
+        Lqb = jnp.broadcast_to(Lq, d.shape[:-1] + Lq.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(Lqb, d[..., None],
+                                                lower=True)[..., 0]
+        logdet = (jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)).sum(-1)
+                  - jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)).sum(-1))
+        return 0.5 * (tr + (sol ** 2).sum(-1) - k) + logdet
+    return _op(f, p._loc, p._cov, q._loc, q._cov, name="kl_mvn")

@@ -359,8 +359,14 @@ def build_trace(recording: _Recording, input_tensors: Sequence[Tensor],
     return trace, output
 
 
-def _leaves_allclose(a, b, rtol=1e-4, atol=1e-6) -> bool:
-    """Structural comparison of two outputs' Tensor leaves."""
+def _leaves_allclose(a, b, rtol=0.0, atol=0.0) -> bool:
+    """Structural comparison of two outputs' Tensor leaves.
+
+    Defaults to EXACT equality: the relaxation probe compares a replay of
+    the recorded program against the eager run of the same ops on the same
+    inputs, so any difference is precisely the baked host-read value
+    mattering — a loose tolerance would permanently freeze a baked scalar
+    whose effect is small relative to the output's magnitude."""
     if isinstance(a, Tensor) and isinstance(b, Tensor):
         x, y = np.asarray(a._data), np.asarray(b._data)
         return x.shape == y.shape and bool(

@@ -92,8 +92,12 @@ def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """ref: python/paddle/tensor/random.py randint_like — unlike randint,
+    the result dtype may be floating (integer values cast to x.dtype)."""
     x = ensure_tensor(x)
-    return randint(low, high, x.shape, dtype or x.dtype)
+    out_dtype = dtype or x.dtype
+    ints = randint(low, high, x.shape, "int64")
+    return ints.astype(out_dtype)
 
 
 def randperm(n, dtype="int64", name=None):

@@ -297,15 +297,30 @@ def adjust_contrast(img, contrast_factor):
     return Tensor(out) if was_tensor else out
 
 
+def _channel_axis(arr, was_tensor):
+    """CHW for 3-channel-first tensors (paddle layout), else HWC."""
+    if arr.ndim == 3 and was_tensor and arr.shape[0] in (1, 3, 4):
+        return 0
+    return -1
+
+
 def adjust_saturation(img, saturation_factor):
-    """ref: functional.adjust_saturation."""
+    """ref: functional.adjust_saturation — lerp towards the BT.601
+    grayscale (matches the PIL ImageEnhance.Color path; upstream's
+    functional_tensor.py adjust_saturation uses rgb_to_grayscale)."""
     if _is_pil(img):
         return ImageEnhance.Color(img).enhance(saturation_factor)
     was_tensor = _is_tensor(img)
     arr = img.numpy() if was_tensor else img
     dtype = arr.dtype
     f = arr.astype("float32")
-    gray = f.mean(axis=-1, keepdims=True)
+    ax = _channel_axis(arr, was_tensor)
+    w = np.asarray([0.299, 0.587, 0.114], "float32")
+    if f.shape[ax] == 3:
+        gray = np.tensordot(f, w, axes=([ax], [0]))
+        gray = np.expand_dims(gray, ax)
+    else:  # non-RGB (single-channel, RGBA, ...): per-pixel channel mean
+        gray = f.mean(axis=ax, keepdims=True)
     out = (f - gray) * saturation_factor + gray
     if dtype == np.uint8:
         out = out.clip(0, 255).astype(np.uint8)
@@ -314,8 +329,43 @@ def adjust_saturation(img, saturation_factor):
     return Tensor(out) if was_tensor else out
 
 
+def _np_rgb_to_hsv(r, g, b):
+    """Vectorized colorsys.rgb_to_hsv over float arrays in [0, 1]."""
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    c = maxc - minc
+    safe_max = np.where(maxc == 0, 1.0, maxc)
+    s = np.where(maxc > 0, c / safe_max, 0.0)
+    safe_c = np.where(c == 0, 1.0, c)
+    rc = (maxc - r) / safe_c
+    gc = (maxc - g) / safe_c
+    bc = (maxc - b) / safe_c
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(c == 0, 0.0, (h / 6.0) % 1.0)
+    return h, s, v
+
+
+def _np_hsv_to_rgb(h, s, v):
+    """Vectorized colorsys.hsv_to_rgb."""
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int64) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return r, g, b
+
+
 def adjust_hue(img, hue_factor):
-    """ref: functional.adjust_hue (|hue_factor| <= 0.5)."""
+    """ref: functional.adjust_hue (|hue_factor| <= 0.5).
+
+    Array/tensor inputs take a real HSV rotation in float (uint8 scaled
+    through [0, 1]); only PIL inputs use PIL's quantized 8-bit HSV."""
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError("hue_factor must be in [-0.5, 0.5]")
     if _is_pil(img):
@@ -327,8 +377,20 @@ def adjust_hue(img, hue_factor):
         return hsv.convert(img.mode)
     was_tensor = _is_tensor(img)
     arr = img.numpy() if was_tensor else np.asarray(img)
-    pil = Image.fromarray(arr.astype(np.uint8))
-    out = np.asarray(adjust_hue(pil, hue_factor))
+    dtype = arr.dtype
+    f = arr.astype("float32") / (255.0 if dtype == np.uint8 else 1.0)
+    ax = _channel_axis(arr, was_tensor)
+    if f.shape[ax] != 3:
+        return Tensor(arr) if was_tensor else arr  # grayscale: no hue
+    r, g, b = np.moveaxis(f, ax, 0)
+    h, s, v = _np_rgb_to_hsv(r, g, b)
+    h = (h + hue_factor) % 1.0
+    out = np.stack(_np_hsv_to_rgb(h, s, v), axis=0)
+    out = np.moveaxis(out, 0, ax if ax >= 0 else out.ndim - 1)
+    if dtype == np.uint8:
+        out = (out * 255.0).round().clip(0, 255).astype(np.uint8)
+    else:
+        out = out.astype(dtype)
     return Tensor(out) if was_tensor else out
 
 
