@@ -105,7 +105,7 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
                 "distributed", "distribution", "vision", "hapi", "incubate",
                 "utils", "profiler", "sparse", "text", "audio",
                 "quantization", "onnx", "version", "inference",
-                "hub", "sysconfig"]
+                "hub", "sysconfig", "multiprocessing", "callbacks"]
 
 
 def __getattr__(name):
@@ -117,7 +117,10 @@ def __getattr__(name):
     # lazy subpackage import keeps partially-built stages from breaking the core
     if name in _SUBPACKAGES:
         import importlib
-        mod = importlib.import_module(f".{name}", __name__)
+        if name == "callbacks":   # paddle.callbacks = hapi.callbacks (ref)
+            mod = importlib.import_module(".hapi.callbacks", __name__)
+        else:
+            mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

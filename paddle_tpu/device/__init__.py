@@ -353,3 +353,7 @@ cuda.Stream = Stream
 cuda.current_stream = current_stream
 cuda.stream_guard = stream_guard
 cuda.get_device_properties = get_device_properties
+
+from . import graphs as _graphs  # noqa: E402
+cuda.graphs = _graphs
+cuda.CUDAGraph = _graphs.CUDAGraph

@@ -86,9 +86,15 @@ class DistributedStrategy:
         self.lamb_configs = _Bunch(lamb_weight_decay=0.01,
                                    exclude_from_weight_decay=[])
         self.lars = False
-        self.lars_configs = _Bunch()
+        self.lars_configs = _Bunch(lars_coeff=0.001, lars_weight_decay=0.0005,
+                                   epsilon=0.0, exclude_from_weight_decay=[])
         self.dgc = False
+        self.dgc_configs = _Bunch(rampup_begin_step=0, rampup_step=1,
+                                  sparsity=[0.999])
         self.localsgd = False
+        self.localsgd_configs = _Bunch(k_steps=1, begin_step=1)
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = _Bunch(init_k_steps=1, begin_step=1)
 
         # hybrid parallel degrees — the mesh definition
         self.hybrid_configs = {k: (dict(v) if isinstance(v, dict) else
@@ -97,6 +103,78 @@ class DistributedStrategy:
 
         self.heter_ccl_mode = False
         self.is_fl_ps_mode = False
+
+        # a_sync (parameter-server era) — accepted, PS is out of scope
+        self.a_sync_configs = _Bunch(k_steps=-1, max_merge_var_num=1,
+                                     send_queue_size=16,
+                                     independent_recv_thread=False,
+                                     thread_pool_size=1, send_wait_times=1,
+                                     runtime_split_send_recv=False)
+
+        # quantization / sparsity meta-knobs (flows live in
+        # paddle.quantization / incubate.asp; the strategy bits gate them
+        # the way the reference's meta-optimizers do)
+        self.qat = False
+        self.qat_configs = _Bunch(channel_wise_abs_max=True,
+                                  weight_bits=8, activation_bits=8,
+                                  not_quant_pattern=[], algo="")
+        self.asp = False
+
+        # comm-tuning knobs: accepted for API parity; XLA owns streams,
+        # bucketing and hierarchical allreduce on TPU (ICI collectives
+        # are emitted inside the compiled program)
+        self.fp16_allreduce = False
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.last_comm_group_size_MB = 1.0
+        self.calc_comm_same_stream = False
+        self.fuse_grad_merge = False
+        self.fuse_grad_size_in_num = 8
+        self.sync_batch_norm = False
+
+        # cudnn autotune knobs: meaningless on TPU, accepted for parity
+        self.cudnn_exhaustive_search = False
+        self.conv_workspace_size_limit = 512
+        self.cudnn_batchnorm_spatial_persistent = False
+
+        # semi-auto parallel gate (auto_parallel Engine consumes it)
+        self.semi_auto = False
+
+        # execution/build strategy sub-objects (static-graph era shells;
+        # the jit cache + XLA subsume their effects)
+        self.execution_strategy = _Bunch(num_threads=1,
+                                         num_iteration_per_drop_scope=10,
+                                         num_iteration_per_run=1,
+                                         use_thread_barrier=False)
+        self.build_strategy = _Bunch(
+            enable_sequential_execution=False, fuse_elewise_add_act_ops=False,
+            fuse_bn_act_ops=False, fuse_relu_depthwise_conv=False,
+            fuse_broadcast_ops=False, fuse_all_optimizer_ops=False,
+            enable_inplace=True, enable_addto=False,
+            cache_runtime_context=False)
+
+    # -- prototxt round-trip (ref: save_to_prototxt/load_from_prototxt on
+    # the protobuf-backed strategy; here a key=value text dump) ----------
+    def save_to_prototxt(self, output):
+        import json
+        payload = {}
+        for k, v in vars(self).items():
+            key = k.lstrip("_")
+            payload[key] = dict(v) if isinstance(v, dict) else v
+        with open(output, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+
+    def load_from_prototxt(self, pb_file):
+        import json
+        with open(pb_file) as f:
+            payload = json.load(f)
+        for k, v in payload.items():
+            if k == "hybrid_configs":
+                self.hybrid_configs = v
+            elif isinstance(v, dict):
+                setattr(self, k, _Bunch(v))
+            else:
+                setattr(self, k, v)
 
     @property
     def hybrid_configs(self):

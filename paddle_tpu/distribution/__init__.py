@@ -769,6 +769,10 @@ class MultivariateNormal(Distribution):
             covariance_matrix = jnp.eye(self.loc.shape[-1])
         self._cov = _tens(covariance_matrix)
         self.covariance_matrix = self._cov._data
+        # factor ONCE through the tape: the O(k^3) Cholesky is paid per
+        # distribution, not per method call, and grads still flow
+        # cov -> chol -> downstream
+        self._chol = _op(jnp.linalg.cholesky, self._cov, name="mvn_chol")
         super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
 
     @property
@@ -785,17 +789,15 @@ class MultivariateNormal(Distribution):
         key = random_state.next_key()
         sh = _shape(shape) + self.loc.shape
 
-        def f(l, c):
-            L = jnp.linalg.cholesky(c)
+        def f(l, L):
             eps = jax.random.normal(key, sh)
             return l + jnp.einsum("...ij,...j->...i", L, eps)
-        return _op(f, self._loc, self._cov, name="mvn_rsample")
+        return _op(f, self._loc, self._chol, name="mvn_rsample")
 
     def log_prob(self, value):
         k = self.loc.shape[-1]
 
-        def f(l, c, v):
-            L = jnp.linalg.cholesky(c)
+        def f(l, L, v):
             d = v - l
             Lb = jnp.broadcast_to(L, d.shape[:-1] + L.shape[-2:])
             sol = jax.scipy.linalg.solve_triangular(
@@ -803,16 +805,15 @@ class MultivariateNormal(Distribution):
             logdet = jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)).sum(-1)
             return (-0.5 * (sol ** 2).sum(-1) - logdet
                     - 0.5 * k * math.log(2 * math.pi))
-        return _op(f, self._loc, self._cov, value, name="mvn_log_prob")
+        return _op(f, self._loc, self._chol, value, name="mvn_log_prob")
 
     def entropy(self):
         k = self.loc.shape[-1]
 
-        def f(c):
-            L = jnp.linalg.cholesky(c)
+        def f(L):
             logdet = jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)).sum(-1)
             return 0.5 * k * (1 + math.log(2 * math.pi)) + logdet
-        return _op(f, self._cov, name="mvn_entropy")
+        return _op(f, self._chol, name="mvn_entropy")
 
 
 class Poisson(ExponentialFamily):
@@ -1198,9 +1199,7 @@ def _kl_geo_geo(p, q):
 def _kl_mvn_mvn(p, q):
     k = p.loc.shape[-1]
 
-    def f(pl, pc, ql, qc):
-        Lp = jnp.linalg.cholesky(pc)
-        Lq = jnp.linalg.cholesky(qc)
+    def f(pl, Lp, ql, Lq):
         m = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
         tr = (m ** 2).sum((-2, -1))
         d = ql - pl
@@ -1210,4 +1209,4 @@ def _kl_mvn_mvn(p, q):
         logdet = (jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)).sum(-1)
                   - jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)).sum(-1))
         return 0.5 * (tr + (sol ** 2).sum(-1) - k) + logdet
-    return _op(f, p._loc, p._cov, q._loc, q._cov, name="kl_mvn")
+    return _op(f, p._loc, p._chol, q._loc, q._chol, name="kl_mvn")

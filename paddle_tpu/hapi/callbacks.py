@@ -12,6 +12,7 @@ import numpy as np
 from .progressbar import ProgressBar
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "VisualDL", "WandbCallback",
            "LRScheduler"]
 
 
@@ -252,3 +253,149 @@ class EarlyStopping(Callback):
             if self.verbose > 0:
                 print(f"Epoch early stopped (patience {self.patience}); "
                       f"best {self.monitor}: {self.best_value}")
+
+
+class VisualDL(Callback):
+    """ref: callbacks.VisualDL — scalar logging to a VisualDL log dir.
+
+    Uses the ``visualdl`` LogWriter when the package is importable;
+    otherwise falls back to a JSONL scalar log in the same directory
+    (one record per scalar: {"tag", "step", "value"}) so training logs
+    survive in environments without VisualDL installed."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.epoch = 0
+        self._writer = None
+        self._jsonl = None
+        self._global_step = 0
+
+    def _ensure_writer(self):
+        if self._writer is not None or self._jsonl is not None:
+            return
+        os.makedirs(self.log_dir, exist_ok=True)
+        try:
+            from visualdl import LogWriter
+            self._writer = LogWriter(logdir=self.log_dir)
+        except ImportError:
+            self._jsonl = open(os.path.join(self.log_dir,
+                                            "scalars.jsonl"), "a")
+
+    def _add_scalar(self, tag, value, step):
+        self._ensure_writer()
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        if self._writer is not None:
+            self._writer.add_scalar(tag=tag, value=value, step=step)
+        else:
+            import json
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "step": int(step), "value": value}) + "\n")
+            self._jsonl.flush()
+
+    def _log(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            if k in ("batch_size", "num_samples"):
+                continue
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            self._add_scalar(f"{prefix}/{k}", v, step)
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        # monotonic counter: steps-per-epoch may be unknown (iterable
+        # datasets), and epoch*steps would then stack epochs at step 0
+        self._log("train", logs, self._global_step)
+        self._global_step += 1
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self.epoch)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+
+class WandbCallback(Callback):
+    """ref: callbacks.WandbCallback — metric logging to Weights&Biases.
+
+    When ``wandb`` is not importable the callback degrades to a local
+    JSONL run log (documented deviation: the reference raises — here
+    training should not depend on a network service being installed)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        self._settings = dict(project=project, entity=entity, name=name,
+                              dir=dir, mode=mode, job_type=job_type,
+                              **kwargs)
+        self.run = None
+        self._jsonl = None
+        self.epoch = 0
+
+    def _ensure_run(self):
+        if self.run is not None or self._jsonl is not None:
+            return
+        try:
+            import wandb
+            self.run = wandb.init(
+                **{k: v for k, v in self._settings.items()
+                   if v is not None})
+        except ImportError:
+            d = self._settings.get("dir") or "./wandb_local"
+            os.makedirs(d, exist_ok=True)
+            self._jsonl = open(os.path.join(d, "run.jsonl"), "a")
+
+    def _log(self, payload, step=None):
+        self._ensure_run()
+        clean = {}
+        for k, v in payload.items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            try:
+                clean[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        if self.run is not None:
+            self.run.log(clean, step=step)
+        else:
+            import json
+            self._jsonl.write(json.dumps(
+                {"step": step, **clean}) + "\n")
+            self._jsonl.flush()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if logs:
+            self._log({f"train/{k}": v for k, v in logs.items()},
+                      step=step)
+
+    def on_eval_end(self, logs=None):
+        if logs:
+            self._log({f"eval/{k}": v for k, v in logs.items()},
+                      step=self.epoch)
+
+    def on_train_end(self, logs=None):
+        if self.run is not None:
+            self.run.finish()
+            self.run = None
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

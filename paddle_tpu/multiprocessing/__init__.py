@@ -1,0 +1,116 @@
+"""paddle.multiprocessing — send Tensors between processes (ref:
+python/paddle/multiprocessing/reductions.py + incubate/multiprocessing:
+ForkingPickler reductions that move tensor storage through shared
+memory instead of pickling bytes through the pipe).
+
+TPU-native: device arrays can't be memory-shared across processes (the
+accelerator buffer belongs to one PJRT client), so the reduction stages
+through POSIX shared memory on the host — the sender materialises the
+array into a SharedMemory block, the receiver maps it and re-wraps it as
+a Tensor.  The receiver COPIES out of the block and releases it (the
+reference's file_system strategy keeps the mapping live for in-place
+sharing; with device-resident compute an in-place host mapping cannot
+alias the accelerator buffer anyway, so copy-on-receive is the honest
+semantic here).
+
+Usage matches the reference::
+
+    import paddle_tpu.multiprocessing as mp
+    q = mp.Queue()                    # tensors move via shared memory
+    p = mp.Process(target=worker, args=(q,))
+"""
+from __future__ import annotations
+
+import atexit
+import multiprocessing as _std_mp
+from multiprocessing import *  # noqa: F401,F403 — re-export the stdlib API
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = list(getattr(_std_mp, "__all__", [])) + [
+    "init_reductions", "ForkingPickler"]
+
+# sender-side blocks stay alive until the receiver consumes them
+# (single-consumer semantics: the receiver unlinks after rebuilding).
+# The sender keeps handles only as a safety net — it opportunistically
+# reaps blocks the receiver already unlinked, and unlinks any leftovers
+# (unconsumed sends) at exit — so long-running producers do not
+# accumulate /dev/shm segments.
+_SENT_BLOCKS = []
+
+
+def _reap_consumed():
+    alive = []
+    for shm in _SENT_BLOCKS:
+        try:
+            # re-attach by name: fails once the receiver has unlinked it
+            probe = shared_memory.SharedMemory(name=shm.name)
+            probe.close()
+            alive.append(shm)
+        except FileNotFoundError:
+            try:
+                shm.close()
+            except Exception:
+                pass
+    _SENT_BLOCKS[:] = alive
+
+
+def _cleanup():
+    for shm in _SENT_BLOCKS:
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+    _SENT_BLOCKS.clear()
+
+
+atexit.register(_cleanup)
+
+
+def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
+    finally:
+        shm.close()
+        try:
+            shm.unlink()          # single-consumer: release the segment
+        except Exception:
+            pass
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _rebuild_small(arr, stop_gradient):
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t: Tensor):
+    a = np.asarray(t._data)
+    if a.nbytes == 0:
+        # zero-size: no block needed, pickle the array inline
+        return (_rebuild_small, (a.copy(), t.stop_gradient))
+    _reap_consumed()
+    shm = shared_memory.SharedMemory(create=True, size=a.nbytes)
+    np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+    _SENT_BLOCKS.append(shm)
+    return (_rebuild_tensor,
+            (shm.name, a.shape, a.dtype.str, t.stop_gradient))
+
+
+def init_reductions():
+    """Register the Tensor reduction with ForkingPickler (ref:
+    reductions.init_reductions) — Queue/Pipe then move tensors through
+    shared memory automatically."""
+    ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()

@@ -1,0 +1,156 @@
+"""Aux API surface: CUDA-graph shim, multiprocessing tensor IPC,
+VisualDL/Wandb callbacks, DistributedStrategy knob breadth (ref:
+python/paddle/device/cuda/graphs.py, python/paddle/multiprocessing/,
+python/paddle/hapi/callbacks.py, fleet/base/distributed_strategy.py)."""
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# CUDA graphs
+# ---------------------------------------------------------------------------
+
+def test_cuda_graph_capture_replay():
+    from paddle_tpu.device.graphs import CUDAGraph
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    g = CUDAGraph()
+    g.capture_begin()
+    y = (x * 3.0) + 1.0
+    g.capture_end()
+    np.testing.assert_allclose(y.numpy(), [4.0, 7.0])
+    # fixed-buffer semantics: refresh the input buffer, replay, the SAME
+    # output tensor updates
+    x.set_value(paddle.to_tensor(np.array([10.0, 20.0], "float32")).value)
+    g.replay()
+    np.testing.assert_allclose(y.numpy(), [31.0, 61.0])
+    g.reset()
+
+
+def test_cuda_graph_namespace_and_dot(tmp_path):
+    assert paddle.device.cuda.CUDAGraph is \
+        paddle.device.cuda.graphs.CUDAGraph
+    from paddle_tpu.device.graphs import CUDAGraph
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    g = CUDAGraph()
+    g.capture_begin()
+    _ = x + 1.0
+    g.capture_end()
+    p = g.print_to_dot_files(tmp_path)
+    assert "digraph" in open(p).read()
+
+
+def test_wrap_cuda_graph():
+    from paddle_tpu.device.graphs import wrap_cuda_graph
+    f = wrap_cuda_graph(lambda a: a * 2.0 + 5.0)
+    a = paddle.to_tensor(np.array([1.0], "float32"))
+    np.testing.assert_allclose(f(a).numpy(), [7.0])
+    out = f(paddle.to_tensor(np.array([7.0], "float32")))
+    np.testing.assert_allclose(out.numpy(), [19.0])
+
+
+# ---------------------------------------------------------------------------
+# multiprocessing tensor IPC
+# ---------------------------------------------------------------------------
+
+def test_mp_tensor_pickle_roundtrip_shared_memory():
+    import paddle_tpu.multiprocessing as pmp
+    t = paddle.to_tensor(np.arange(64, dtype="float32").reshape(8, 8))
+    t.stop_gradient = False
+    data = pmp.ForkingPickler.dumps(t)
+    back = pickle.loads(data)
+    assert isinstance(back, Tensor)
+    np.testing.assert_array_equal(back.numpy(), t.numpy())
+    assert back.stop_gradient is False
+
+
+def test_mp_zero_size_tensor():
+    import paddle_tpu.multiprocessing as pmp
+    t = paddle.to_tensor(np.zeros((0, 3), "float32"))
+    back = pickle.loads(pmp.ForkingPickler.dumps(t))
+    assert list(back.shape) == [0, 3]
+
+
+def test_mp_reexports_stdlib():
+    import paddle_tpu.multiprocessing as pmp
+    assert callable(pmp.get_context)
+    assert hasattr(pmp, "Queue") and hasattr(pmp, "Process")
+
+
+# ---------------------------------------------------------------------------
+# VisualDL / Wandb callbacks (JSONL fallback path)
+# ---------------------------------------------------------------------------
+
+def _tiny_fit(callback):
+    from paddle_tpu import nn
+    from paddle_tpu.io import TensorDataset, DataLoader
+    paddle.seed(0)
+    xs = paddle.randn([16, 4])
+    ys = paddle.randn([16, 1])
+    model = paddle.Model(nn.Linear(4, 1))
+    model.prepare(paddle.optimizer.SGD(0.1,
+                                       parameters=model.network.parameters()),
+                  paddle.nn.MSELoss())
+    ds = TensorDataset([xs, ys])
+    model.fit(ds, batch_size=8, epochs=2, verbose=0, callbacks=[callback])
+
+
+def test_visualdl_callback_jsonl_fallback(tmp_path):
+    cb = paddle.callbacks.VisualDL(log_dir=str(tmp_path))
+    _tiny_fit(cb)
+    path = os.path.join(str(tmp_path), "scalars.jsonl")
+    assert os.path.exists(path)
+    rows = [json.loads(l) for l in open(path)]
+    assert any(r["tag"].startswith("train/loss") for r in rows)
+    assert all(isinstance(r["value"], float) for r in rows)
+
+
+def test_wandb_callback_jsonl_fallback(tmp_path):
+    cb = paddle.callbacks.WandbCallback(dir=str(tmp_path))
+    _tiny_fit(cb)
+    path = os.path.join(str(tmp_path), "run.jsonl")
+    assert os.path.exists(path)
+    rows = [json.loads(l) for l in open(path)]
+    assert any(k.startswith("train/") for r in rows for k in r)
+
+
+# ---------------------------------------------------------------------------
+# DistributedStrategy knob breadth
+# ---------------------------------------------------------------------------
+
+def test_strategy_knob_surface():
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    s = DistributedStrategy()
+    # the reference's proto fields exist with config sub-objects
+    for knob in ["dgc_configs", "localsgd_configs",
+                 "adaptive_localsgd_configs", "a_sync_configs",
+                 "qat_configs", "lars_configs"]:
+        assert isinstance(getattr(s, knob), dict), knob
+    assert s.fp16_allreduce is False
+    assert s.execution_strategy["num_threads"] == 1
+    assert s.build_strategy["enable_inplace"] is True
+    s.qat = True
+    s.qat_configs = {"weight_bits": 4}
+    assert s.qat_configs["weight_bits"] == 4
+
+
+def test_strategy_prototxt_roundtrip(tmp_path):
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    s = DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    path = str(tmp_path / "strategy.prototxt")
+    s.save_to_prototxt(path)
+    s2 = DistributedStrategy()
+    s2.load_from_prototxt(path)
+    assert s2.gradient_merge is True
+    assert s2.gradient_merge_configs["k_steps"] == 4
+    assert s2.hybrid_configs["dp_degree"] == 2
+    assert s2.hybrid_configs["mp_degree"] == 4
