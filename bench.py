@@ -292,6 +292,7 @@ def main():
         return
 
     errors = {}
+    t_start = time.monotonic()
 
     # budget invariant: worst case (every stage hung) stays <= ~14 min
     # (120 + 360 + 240 + 120 = 840s), matching the pre-ladder contract —
@@ -331,6 +332,32 @@ def main():
             print(json.dumps(out))
             return
         errors["tpu-retry"] = err
+
+        # one extra attempt for KNOWN-TRANSIENT failures (observed on the
+        # axon tunnel: the terminal's libtpu intermittently fails worker-
+        # hostname discovery, and the remote-compile endpoint drops a
+        # response mid-read).  The ladder's wall-clock contract (the
+        # fail-safe JSON must appear within ~840s) is enforced by
+        # MEASURED elapsed time, not error text: the retry only spends
+        # budget the earlier stages left unused by failing fast.
+        transient = ("TPU_WORKER_HOSTNAMES", "read body",
+                     "Connection Failed", "Connection refused",
+                     "Unavailable", "UNAVAILABLE")
+        total_budget = int(os.environ.get("BENCH_TOTAL_BUDGET", "840"))
+        remaining = total_budget - (time.monotonic() - t_start) - 140
+        if remaining >= 80 and any(t in errors.get("tpu", "")
+                                   + errors.get("tpu-retry", "")
+                                   for t in transient):
+            time.sleep(20)   # let the terminal-side fault clear
+            line, err = _run_child(retry_env,
+                                   int(min(t_tpu, remaining - 20)))
+            if line:
+                out = json.loads(line)
+                out["probe"] = probe
+                out["errors"] = errors
+                print(json.dumps(out))
+                return
+            errors["tpu-transient-retry"] = err
 
     line, err = _run_child({"BENCH_FORCE_CPU": "1"}, 120)
     if line:

@@ -38,9 +38,8 @@ class CUDAGraph:
         self._program: Optional[Program] = None
         self._cm = None
         self._compiled = None
-        self._in_ids: List[int] = []
-        self._externals: Dict[int, Tensor] = {}
-        self._out_pairs: List[Any] = []   # (recorded Tensor, env id)
+        self._externals: List[Tensor] = []
+        self._out_tensors: List[Tensor] = []
 
     def capture_begin(self):
         if self._cm is not None:
@@ -54,65 +53,30 @@ class CUDAGraph:
             raise RuntimeError("capture_end() without capture_begin()")
         self._cm.__exit__(None, None, None)
         self._cm = None
-        ops = self._program.ops
-        # externals = tensors read before being produced (params + inputs)
-        produced: set = set()
-        externals: Dict[int, Tensor] = {}
-        for op in ops:
-            for t in op.inputs:
-                if id(t) not in produced:
-                    externals.setdefault(id(t), t)
+        # the replay must refresh the final value of every produced
+        # tensor (they are the graph's output buffers)
+        outs: Dict[int, Tensor] = {}
+        for op in self._program.ops:
             for t in op.outputs:
-                produced.add(id(t))
-        self._externals = externals
-        self._in_ids = list(externals)
-        # every produced tensor that escapes the capture is an output
-        # buffer the replay must refresh; conservatively refresh all
-        # final values of produced tensors still alive
-        out_ids = list(dict.fromkeys(
-            id(t) for op in ops for t in op.outputs))
-        self._out_pairs = [(tid, t) for tid in out_ids
-                           for t in [self._find_tensor(tid, ops)]]
-        specs = [(op.fn, dict(op.kwargs), [id(t) for t in op.inputs],
-                  [id(t) for t in op.outputs], op.multi_out)
-                 for op in ops]
-        in_ids = self._in_ids
-
-        def pure(*xs):
-            env = dict(zip(in_ids, xs))
-            for fn, kw, tin, tout, multi in specs:
-                got = fn(*(env[t] for t in tin), **kw)
-                if multi:
-                    for tid, o in zip(tout, got):
-                        env[tid] = o
-                else:
-                    env[tout[0]] = got
-            return tuple(env[tid] for tid, _ in self._out_pairs)
-
-        self._compiled = jax.jit(pure)
-
-    @staticmethod
-    def _find_tensor(tid, ops):
-        for op in ops:
-            for t in op.outputs:
-                if id(t) == tid:
-                    return t
-        raise KeyError(tid)
+                outs[id(t)] = t
+        self._out_tensors = list(outs.values())
+        pure, self._externals = self._program.build_replay(
+            [], self._out_tensors)
+        self._compiled = jax.jit(lambda ext: pure((), ext))
 
     def replay(self):
         if self._compiled is None:
             raise RuntimeError("replay() before capture_end()")
-        ins = tuple(self._externals[tid]._data for tid in self._in_ids)
-        outs = self._compiled(*ins)
-        for (tid, t), o in zip(self._out_pairs, outs):
+        new = self._compiled(tuple(t._data for t in self._externals))
+        for t, o in zip(self._out_tensors, new):
             t._data = o
         return None
 
     def reset(self):
         self._program = None
         self._compiled = None
-        self._externals = {}
-        self._out_pairs = []
+        self._externals = []
+        self._out_tensors = []
 
     def print_to_dot_files(self, dirname, flags=None):
         # the reference dumps CUDA graph DOT files; here the captured op

@@ -347,6 +347,7 @@ class WandbCallback(Callback):
         self.run = None
         self._jsonl = None
         self.epoch = 0
+        self._global_step = 0
 
     def _ensure_run(self):
         if self.run is not None or self._jsonl is not None:
@@ -383,14 +384,17 @@ class WandbCallback(Callback):
         self.epoch = epoch
 
     def on_train_batch_end(self, step, logs=None):
+        # wandb requires monotonically increasing steps; the per-epoch
+        # batch index resets to 0 each epoch and would be rejected
         if logs:
             self._log({f"train/{k}": v for k, v in logs.items()},
-                      step=step)
+                      step=self._global_step)
+        self._global_step += 1
 
     def on_eval_end(self, logs=None):
         if logs:
             self._log({f"eval/{k}": v for k, v in logs.items()},
-                      step=self.epoch)
+                      step=self._global_step)
 
     def on_train_end(self, logs=None):
         if self.run is not None:

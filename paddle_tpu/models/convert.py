@@ -190,3 +190,62 @@ def bert_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
     model.pooler.dense.weight._data = cast(sd["pooler.dense.weight"].T)
     model.pooler.dense.bias._data = cast(sd["pooler.dense.bias"])
     return model
+
+
+def gpt2_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                 config=None, dtype: str = "float32"):
+    """Build a GPTForPretraining carrying a transformers GPT-2
+    checkpoint (ref: PaddleNLP gpt/modeling.py checkpoint conversion;
+    architectures align: pre-LN blocks, learned positions, tanh-gelu,
+    fused c_attn ordered [q|k|v], tied lm head).
+
+    transformers' GPT2 stores Conv1D weights as (in, out) — the same
+    orientation as our Linear weights, so projections copy without
+    transposition."""
+    from .gpt import GPTConfig, GPTForPretraining
+
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    if any(k.startswith("transformer.") for k in sd):
+        sd = {k[len("transformer."):]: v for k, v in sd.items()
+              if k.startswith("transformer.")}
+
+    cfg = GPTConfig(
+        vocab_size=config.vocab_size,
+        hidden_size=config.hidden_size,
+        num_layers=config.num_hidden_layers,
+        num_heads=config.num_attention_heads,
+        max_position_embeddings=config.max_position_embeddings,
+        intermediate_size=getattr(config, "n_inner", None)
+        or 4 * config.hidden_size,
+        hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0,
+        tie_word_embeddings=True,
+    )
+    model = GPTForPretraining(cfg)
+    cast = lambda a: jnp.asarray(a, dtype=dtype)
+
+    emb = model.gpt.embeddings
+    emb.word_embeddings.weight._data = cast(sd["wte.weight"])
+    emb.position_embeddings.weight._data = cast(sd["wpe.weight"])
+
+    for i, block in enumerate(model.gpt.layers):
+        p = f"h.{i}."
+        block.ln1.weight._data = cast(sd[p + "ln_1.weight"])
+        block.ln1.bias._data = cast(sd[p + "ln_1.bias"])
+        block.attn.qkv_proj.weight._data = cast(sd[p + "attn.c_attn.weight"])
+        block.attn.qkv_proj.bias._data = cast(sd[p + "attn.c_attn.bias"])
+        block.attn.out_proj.weight._data = cast(sd[p + "attn.c_proj.weight"])
+        block.attn.out_proj.bias._data = cast(sd[p + "attn.c_proj.bias"])
+        block.ln2.weight._data = cast(sd[p + "ln_2.weight"])
+        block.ln2.bias._data = cast(sd[p + "ln_2.bias"])
+        block.mlp.fc1.weight._data = cast(sd[p + "mlp.c_fc.weight"])
+        block.mlp.fc1.bias._data = cast(sd[p + "mlp.c_fc.bias"])
+        block.mlp.fc2.weight._data = cast(sd[p + "mlp.c_proj.weight"])
+        block.mlp.fc2.bias._data = cast(sd[p + "mlp.c_proj.bias"])
+
+    model.gpt.final_ln.weight._data = cast(sd["ln_f.weight"])
+    model.gpt.final_ln.bias._data = cast(sd["ln_f.bias"])
+    return model

@@ -60,10 +60,16 @@ def _reap_consumed():
 
 
 def _cleanup():
+    # Close handles ONLY — never unlink at exit: a worker that queued a
+    # tensor and returned exits BEFORE the parent calls q.get(), and
+    # unlinking here would crash the parent's rebuild.  The receiver's
+    # unlink is the release path; a payload that is never consumed
+    # leaks its /dev/shm segment until container teardown (the
+    # reference's file_system strategy has the same property, cleaned by
+    # its shm-manager daemon which this build does not ship).
     for shm in _SENT_BLOCKS:
         try:
             shm.close()
-            shm.unlink()
         except Exception:
             pass
     _SENT_BLOCKS.clear()
@@ -73,7 +79,14 @@ atexit.register(_cleanup)
 
 
 def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
-    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        shm = shared_memory.SharedMemory(name=shm_name)
+    except FileNotFoundError:
+        raise RuntimeError(
+            "paddle_tpu.multiprocessing tensor payloads are "
+            "single-consumer: shared-memory segment "
+            f"{shm_name!r} was already consumed (or the sender's "
+            "container released it)") from None
     try:
         arr = np.ndarray(shape, dtype=dtype, buffer=shm.buf).copy()
     finally:
@@ -100,6 +113,15 @@ def _reduce_tensor(t: Tensor):
         return (_rebuild_small, (a.copy(), t.stop_gradient))
     _reap_consumed()
     shm = shared_memory.SharedMemory(create=True, size=a.nbytes)
+    # lifetime is handed to the RECEIVER (it unlinks after rebuilding);
+    # without unregistering, the creator's resource_tracker would unlink
+    # the segment when the creator exits — racing a parent that gets
+    # from the queue after join()
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
     np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
     _SENT_BLOCKS.append(shm)
     return (_rebuild_tensor,

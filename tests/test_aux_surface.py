@@ -154,3 +154,42 @@ def test_strategy_prototxt_roundtrip(tmp_path):
     assert s2.gradient_merge_configs["k_steps"] == 4
     assert s2.hybrid_configs["dp_degree"] == 2
     assert s2.hybrid_configs["mp_degree"] == 4
+
+
+def test_mp_segment_survives_worker_exit(tmp_path):
+    """A worker that queues a tensor and exits must not invalidate the
+    payload: the parent gets AFTER the worker died (the shared-memory
+    segment's lifetime belongs to the receiver)."""
+    import subprocess, sys, textwrap
+    script = tmp_path / "prod.py"
+    script.write_text(textwrap.dedent("""
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import time
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.multiprocessing as pmp
+
+        def producer(q):
+            import jax as j; j.config.update("jax_platforms", "cpu")
+            import paddle_tpu as p
+            import numpy as np
+            q.put(p.to_tensor(np.full((50,), 2.0, "float32")))
+
+        if __name__ == "__main__":
+            ctx = pmp.get_context("spawn")
+            q = ctx.Queue()
+            p = ctx.Process(target=producer, args=(q,))
+            p.start()
+            time.sleep(6)
+            assert not p.is_alive()
+            t = q.get(timeout=30)
+            assert abs(float(t.sum()) - 100.0) < 1e-3
+            print("OK")
+    """))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    out = subprocess.run([sys.executable, str(script)], timeout=240,
+                         capture_output=True, text=True, env=env)
+    assert "OK" in out.stdout, out.stderr[-800:]

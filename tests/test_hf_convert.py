@@ -100,3 +100,41 @@ def test_converted_weights_do_not_alias_torch():
         ours.llama.norm.weight.numpy(), before["norm"])
     np.testing.assert_array_equal(
         ours.llama.layers[0].self_attn.q_proj.weight.numpy(), before["q"])
+
+
+def test_gpt2_logits_match_transformers():
+    from paddle_tpu.models.convert import gpt2_from_hf
+    torch.manual_seed(3)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager")
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ids = np.array([[5, 11, 42, 7, 88, 3, 19]], "int64")
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    ours = gpt2_from_hf(hf)
+    ours.eval()
+    got = np.asarray(ours(Tensor(ids)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_gpt2_kv_cache_decode_matches_full_forward():
+    """The converted GPT-2 must decode identically with and without the
+    KV cache (ties HF interop to the generation path)."""
+    from paddle_tpu.models.convert import gpt2_from_hf
+    torch.manual_seed(4)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=24, n_layer=2, n_head=3,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager")
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ours = gpt2_from_hf(hf)
+    ours.eval()
+    ids = np.array([[2, 9, 30, 4, 17]], "int64")
+    full = np.asarray(ours(Tensor(ids)).numpy())
+    # prefill on the prefix, decode the last token with the cache
+    logits, past = ours(Tensor(ids[:, :-1]), use_cache=True)
+    step, _ = ours(Tensor(ids[:, -1:]), past=past, use_cache=True)
+    np.testing.assert_allclose(np.asarray(step.numpy())[:, 0],
+                               full[:, -1], rtol=1e-4, atol=1e-5)
