@@ -105,7 +105,8 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
                 "distributed", "distribution", "vision", "hapi", "incubate",
                 "utils", "profiler", "sparse", "text", "audio",
                 "quantization", "onnx", "version", "inference",
-                "hub", "sysconfig", "multiprocessing", "callbacks"]
+                "hub", "sysconfig", "multiprocessing", "callbacks",
+                "geometric"]
 
 
 def __getattr__(name):

@@ -1,0 +1,85 @@
+"""paddle.distributed.utils — MoE token-dispatch helpers (ref:
+python/paddle/distributed/utils/moe_utils.py global_scatter/global_gather
++ the expert_count op behind them).
+
+TPU-native stance: ragged NCCL alltoall does not map to XLA's
+static-shape collectives; the production EP path here is the MoE layer's
+capacity-based einsum dispatch on the ``ep`` mesh axis
+(incubate/distributed/models/moe/moe_layer.py).  These functions keep
+the reference's API with exact semantics where shapes allow:
+
+* single-process groups (the legacy-imperative usage these ops serve in
+  tests) — exact: rows are already expert-grouped, the dispatch is the
+  identity permutation;
+* inside an SPMD region — raise with guidance to MoELayer, instead of
+  silently computing something else.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["global_scatter", "global_gather", "expert_count"]
+
+
+def _counts_np(c) -> np.ndarray:
+    c = ensure_tensor(c)
+    return np.asarray(c._data).astype("int64").reshape(-1)
+
+
+def _check_group(group, name):
+    from .communication.group import _world_group
+    g = group
+    if g is None:
+        try:
+            g = _world_group()
+        except Exception:
+            g = None
+    in_spmd = bool(g is not None and g.in_spmd_scope())
+    if in_spmd:
+        raise RuntimeError(
+            f"{name} is a ragged-alltoall dispatch and cannot run inside "
+            "a compiled SPMD region (XLA collectives need static "
+            "shapes). Use paddle.incubate.distributed.models.moe."
+            "MoELayer — its capacity-based dispatch is the TPU-native "
+            "expert-parallel path.")
+    # outside an SPMD region every rank sees only itself — the same
+    # single-process semantics as the module's other eager collectives
+    # (communication/collective_ops.py: alltoall passes through)
+
+
+def expert_count(gate_idx, n_expert: int) -> Tensor:
+    """ref: the expert_count op — tokens per expert, int64 (n_expert,)."""
+    idx = np.asarray(ensure_tensor(gate_idx)._data).astype("int64")
+    return Tensor(np.bincount(idx.reshape(-1),
+                              minlength=int(n_expert)).astype("int64"))
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream: bool = True) -> Tensor:
+    """ref: moe_utils.global_scatter — send expert-grouped rows to the
+    ranks owning each expert."""
+    _check_group(group, "global_scatter")
+    x = ensure_tensor(x)
+    lc = _counts_np(local_count)
+    if int(lc.sum()) != int(x.shape[0]):
+        raise ValueError(
+            f"local_count sums to {int(lc.sum())} but x has "
+            f"{int(x.shape[0])} rows")
+    # every expert is local: the dispatch is the identity
+    return Tensor(x._data)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream: bool = True) -> Tensor:
+    """ref: moe_utils.global_gather — inverse of global_scatter."""
+    _check_group(group, "global_gather")
+    x = ensure_tensor(x)
+    gc = _counts_np(global_count)
+    if int(gc.sum()) != int(x.shape[0]):
+        raise ValueError(
+            f"global_count sums to {int(gc.sum())} but x has "
+            f"{int(x.shape[0])} rows")
+    return Tensor(x._data)

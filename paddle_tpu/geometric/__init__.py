@@ -1,0 +1,139 @@
+"""paddle.geometric — graph learning message-passing ops (ref:
+python/paddle/geometric/: message_passing/send_recv.py send_u_recv/
+send_ue_recv/send_uv, math.py segment_sum/mean/max/min).
+
+TPU-native: gather + scatter-reduce via jnp ``.at[]`` updates inside the
+dispatch layer (differentiable; XLA lowers scatter-adds onto the VPU).
+``out_size``/segment counts are taken from the index tensors eagerly —
+under ``jit`` pass ``out_size`` explicitly so the shape is static.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv",
+           "segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def _n_out(index, out_size):
+    if out_size is not None:
+        return int(out_size)
+    idx = np.asarray(ensure_tensor(index)._data)
+    return int(idx.max()) + 1 if idx.size else 0
+
+
+def _scatter_reduce(msg, dst, n, reduce_op):
+    """msg (E, ...) reduced into (n, ...) buckets by dst."""
+    if reduce_op == "sum":
+        return jnp.zeros((n,) + msg.shape[1:], msg.dtype).at[dst].add(msg)
+    if reduce_op == "mean":
+        tot = jnp.zeros((n,) + msg.shape[1:], msg.dtype).at[dst].add(msg)
+        cnt = jnp.zeros((n,), msg.dtype).at[dst].add(1.0)
+        cnt = jnp.maximum(cnt, 1.0).reshape((n,) + (1,) * (msg.ndim - 1))
+        return tot / cnt
+    if reduce_op in ("max", "min"):
+        # dtype-aware sentinel + explicit emptiness tracking: the
+        # reference fills empty segments with 0 for ints and floats
+        # alike, and float -inf would clamp to INT_MIN on int inputs
+        if jnp.issubdtype(msg.dtype, jnp.floating):
+            lo, hi = -jnp.inf, jnp.inf
+        else:
+            info = jnp.iinfo(msg.dtype)
+            lo, hi = info.min, info.max
+        init = jnp.full((n,) + msg.shape[1:],
+                        lo if reduce_op == "max" else hi, msg.dtype)
+        out = (init.at[dst].max(msg) if reduce_op == "max"
+               else init.at[dst].min(msg))
+        cnt = jnp.zeros((n,), jnp.int32).at[dst].add(1)
+        empty = (cnt == 0).reshape((n,) + (1,) * (msg.ndim - 1))
+        return jnp.where(empty, jnp.zeros((), msg.dtype), out)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+def _message(xs, ys, message_op):
+    if message_op == "add":
+        return xs + ys
+    if message_op == "sub":
+        return xs - ys
+    if message_op == "mul":
+        return xs * ys
+    if message_op == "div":
+        return xs / ys
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None) -> Tensor:
+    """ref: send_recv.send_u_recv — gather source features along edges,
+    reduce at destinations."""
+    n = _n_out(dst_index, out_size)
+    return call_op(
+        lambda xv, s, d: _scatter_reduce(xv[s.astype(jnp.int32)],
+                                         d.astype(jnp.int32), n,
+                                         reduce_op),
+        (ensure_tensor(x), ensure_tensor(src_index),
+         ensure_tensor(dst_index)), op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None) -> Tensor:
+    """ref: send_recv.send_ue_recv — combine source features with edge
+    features, reduce at destinations."""
+    n = _n_out(dst_index, out_size)
+
+    def fn(xv, yv, s, d):
+        msg = _message(xv[s.astype(jnp.int32)], yv, message_op)
+        return _scatter_reduce(msg, d.astype(jnp.int32), n, reduce_op)
+    return call_op(fn, (ensure_tensor(x), ensure_tensor(y),
+                        ensure_tensor(src_index),
+                        ensure_tensor(dst_index)),
+                   op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add",
+            name=None) -> Tensor:
+    """ref: send_recv.send_uv — per-edge message from both endpoint
+    features."""
+    def fn(xv, yv, s, d):
+        return _message(xv[s.astype(jnp.int32)],
+                        yv[d.astype(jnp.int32)], message_op)
+    return call_op(fn, (ensure_tensor(x), ensure_tensor(y),
+                        ensure_tensor(src_index),
+                        ensure_tensor(dst_index)), op_name="send_uv")
+
+
+def _segment(data, segment_ids, reduce_op):
+    n = _n_out(segment_ids, None)
+    return call_op(
+        lambda dv, ids: _scatter_reduce(dv, ids.astype(jnp.int32), n,
+                                        reduce_op),
+        (ensure_tensor(data), ensure_tensor(segment_ids)),
+        op_name=f"segment_{reduce_op}")
+
+
+def segment_sum(data, segment_ids, name=None) -> Tensor:
+    """ref: math.segment_sum."""
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None) -> Tensor:
+    """ref: math.segment_mean."""
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None) -> Tensor:
+    """ref: math.segment_max."""
+    return _segment(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None) -> Tensor:
+    """ref: math.segment_min."""
+    return _segment(data, segment_ids, "min")

@@ -1,1 +1,7 @@
 from . import functional
+from .layer import (FusedFeedForward, FusedLinear,
+                    FusedMultiHeadAttention,
+                    FusedTransformerEncoderLayer)
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedLinear"]

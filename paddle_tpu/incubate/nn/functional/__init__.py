@@ -131,6 +131,9 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:   # weight arrives (out_features, in_features)
+        from ....tensor.manipulation import transpose as _t
+        weight = _t(weight, [1, 0])
     return _F.linear(x, weight, bias)
 
 

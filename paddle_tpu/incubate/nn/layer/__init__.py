@@ -1,0 +1,209 @@
+"""paddle.incubate.nn fused transformer layers (ref:
+python/paddle/incubate/nn/layer/fused_transformer.py:
+FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+FusedLinear).
+
+TPU-native: "fused" means the whole sublayer (projections + attention +
+residual + layernorm) is expressed as one op chain inside the jitted
+step — XLA fuses the elementwise epilogues into the matmuls, and the
+attention core routes through scaled_dot_product_attention (the Pallas
+flash path when enabled).  Parameter names and layouts match the
+reference so state dicts round-trip:
+qkv_weight (3, num_heads, head_dim, embed_dim), qkv_bias
+(3, num_heads, head_dim), linear_weight (embed_dim, embed_dim).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ....nn import Layer, functional as F
+from ....framework.param_attr import ParamAttr
+from ....nn.initializer import Constant, XavierUniform
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedLinear"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: fused_transformer.FusedMultiHeadAttention — attention
+    sublayer incl. residual add + layer_norm in one fused op."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.need_weights = need_weights
+        self._epsilon = epsilon
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True is unsupported (matches the "
+                "reference's fused kernel restriction)")
+        self.qkv_weight = self.create_parameter(
+            shape=[3, num_heads, self.head_dim, embed_dim],
+            attr=qkv_weight_attr,
+            default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            shape=[3, num_heads, self.head_dim], attr=qkv_bias_attr,
+            is_bias=True)
+        self.linear_weight = self.create_parameter(
+            shape=[embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        import paddle_tpu as paddle
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self._epsilon)
+        B, S, H = x.shape
+        # qkv_weight (3, nh, hd, H): one matmul against H
+        w = self.qkv_weight.reshape([3 * H, H])
+        qkv = paddle.matmul(x, w, transpose_y=True) \
+            + self.qkv_bias.reshape([3 * H])
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0,
+            training=self.training)
+        out = out.reshape([B, S, H])
+        out = paddle.matmul(out, self.linear_weight) + self.linear_bias
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """ref: fused_transformer.FusedFeedForward — FFN sublayer incl.
+    residual + layer_norm."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._d_model = d_model
+        self._activation = activation
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            shape=[d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            shape=[dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            shape=[dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            shape=[d_model], attr=linear2_bias_attr, is_bias=True)
+        self._ln1_scale = self.create_parameter(
+            shape=[d_model], attr=ln1_scale_attr,
+            default_initializer=Constant(1.0))
+        self._ln1_bias = self.create_parameter(
+            shape=[d_model], attr=ln1_bias_attr, is_bias=True)
+        self._ln2_scale = self.create_parameter(
+            shape=[d_model], attr=ln2_scale_attr,
+            default_initializer=Constant(1.0))
+        self._ln2_bias = self.create_parameter(
+            shape=[d_model], attr=ln2_bias_attr, is_bias=True)
+
+    def forward(self, src, cache=None):
+        import paddle_tpu as paddle
+        residual = src
+        x = src
+        if self._normalize_before:
+            x = F.layer_norm(x, [self._d_model], self._ln1_scale,
+                             self._ln1_bias, self._epsilon)
+        x = paddle.matmul(x, self.linear1_weight) + self.linear1_bias
+        x = getattr(F, self._activation)(x)
+        x = F.dropout(x, self._act_dropout_rate, training=self.training)
+        x = paddle.matmul(x, self.linear2_weight) + self.linear2_bias
+        x = F.dropout(x, self._dropout_rate, training=self.training)
+        x = residual + x
+        if not self._normalize_before:
+            x = F.layer_norm(x, [self._d_model], self._ln2_scale,
+                             self._ln2_bias, self._epsilon)
+        return x
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: fused_transformer.FusedTransformerEncoderLayer — the two
+    fused sublayers chained."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedLinear(Layer):
+    """ref: fused_transformer.FusedLinear — Linear whose bias/epilogue
+    fuses into the matmul (XLA does this by construction)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(
+            shape=shape, attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            shape=[out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        from ..functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)

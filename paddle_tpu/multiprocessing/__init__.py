@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import atexit
 import multiprocessing as _std_mp
+import os
 from multiprocessing import *  # noqa: F401,F403 — re-export the stdlib API
 from multiprocessing import shared_memory
 from multiprocessing.reduction import ForkingPickler
@@ -36,22 +37,24 @@ __all__ = list(getattr(_std_mp, "__all__", [])) + [
 
 # sender-side blocks stay alive until the receiver consumes them
 # (single-consumer semantics: the receiver unlinks after rebuilding).
-# The sender keeps handles only as a safety net — it opportunistically
-# reaps blocks the receiver already unlinked, and unlinks any leftovers
-# (unconsumed sends) at exit — so long-running producers do not
-# accumulate /dev/shm segments.
+# The sender opportunistically reaps handles for blocks the receiver
+# already unlinked, so long-running producers do not accumulate /dev/shm
+# segments.  At exit the sender only CLOSES leftover handles — an
+# unconsumed payload's segment intentionally outlives the sender (see
+# _cleanup) so a parent can still q.get() after the worker died.
 _SENT_BLOCKS = []
 
 
 def _reap_consumed():
     alive = []
     for shm in _SENT_BLOCKS:
-        try:
-            # re-attach by name: fails once the receiver has unlinked it
-            probe = shared_memory.SharedMemory(name=shm.name)
-            probe.close()
+        # stat the segment instead of re-attaching: SharedMemory(name=...)
+        # would RE-register it with this process's resource tracker
+        # (CPython registers on attach too), undoing the unregister that
+        # hands lifetime to the receiver
+        if os.path.exists("/dev/shm/" + shm.name.lstrip("/")):
             alive.append(shm)
-        except FileNotFoundError:
+        else:
             try:
                 shm.close()
             except Exception:
@@ -93,6 +96,11 @@ def _rebuild_tensor(shm_name, shape, dtype, stop_gradient):
         shm.close()
         try:
             shm.unlink()          # single-consumer: release the segment
+        except Exception:
+            pass
+        try:                      # the attach above registered it with
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
         except Exception:
             pass
     t = Tensor(arr)

@@ -30,6 +30,7 @@ from .loss import (  # noqa: F401
 from .input import embedding, one_hot  # noqa: F401
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
+    sparse_attention,
     sequence_mask)
 from .vision import (  # noqa: F401
     grid_sample, affine_grid, pairwise_distance)

@@ -173,3 +173,61 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         return call_op(lambda v: (jnp.arange(m)[None, :] < v[..., None]).astype(jdt),
                        (x,), {}, op_name="sequence_mask")
     return call_op(f, (x,), {}, op_name="sequence_mask")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """ref: nn/functional/sparse_attention.py — block-sparse attention
+    where each query row attends only to the keys named by its CSR row
+    (offset (B, H, S+1), columns (B, H, nnz)).
+
+    TPU-native: the CSR pattern becomes a dense additive mask built
+    inside the traced fn (searchsorted recovers each nonzero's row from
+    the offsets, so the lowering is shape-static and jittable); the core
+    is the standard masked softmax-matmul, which XLA tiles onto the MXU.
+    The reference's CUDA kernel wins memory, not semantics — for long
+    sequences use flash/ring attention instead.
+    """
+    from ...core.dispatch import call_op
+    from ...tensor._helpers import ensure_tensor
+
+    def fn(q, k, v, off, cols, *extra):
+        B, H, S, D = q.shape
+        nnz = cols.shape[-1]
+        off = off.astype(jnp.int32)
+        cols = cols.astype(jnp.int32)
+
+        def one(off_bh, cols_bh):
+            rows = jnp.searchsorted(off_bh, jnp.arange(nnz),
+                                    side="right") - 1
+            m = jnp.zeros((S, S), jnp.bool_)
+            return m.at[rows, cols_bh].set(True)
+
+        mask = jax.vmap(jax.vmap(one))(off, cols)        # (B, H, S, S)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(D, q.dtype))
+        neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+        scores = jnp.where(mask, scores, neg)
+        # both masks use the reference's 0-means-masked convention
+        i = 0
+        if key_padding_mask is not None:
+            kp = extra[i]; i += 1
+            scores = jnp.where(kp[:, None, None, :].astype(bool), scores,
+                               neg)
+        if attn_mask is not None:
+            am = extra[i]; i += 1
+            scores = jnp.where(am.astype(bool), scores, neg)
+        p = jax.nn.softmax(scores, axis=-1)
+        # fully-masked rows (empty CSR row) must output zeros, not nan
+        p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p, v)
+
+    args = [ensure_tensor(query), ensure_tensor(key),
+            ensure_tensor(value), ensure_tensor(sparse_csr_offset),
+            ensure_tensor(sparse_csr_columns)]
+    if key_padding_mask is not None:
+        args.append(ensure_tensor(key_padding_mask))
+    if attn_mask is not None:
+        args.append(ensure_tensor(attn_mask))
+    return call_op(fn, tuple(args), op_name="sparse_attention")

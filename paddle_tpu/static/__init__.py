@@ -460,6 +460,25 @@ class nn:
         return out
 
     @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, param_attr=None, bias_attr=None,
+               act=None, name=None, data_format="NCHW"):
+        """ref: static/nn/common.py conv2d."""
+        from .. import nn as dynn
+        from ..nn import functional as F
+        in_channels = (input.shape[1] if data_format == "NCHW"
+                       else input.shape[-1])
+        layer = dynn.Conv2D(in_channels, num_filters, filter_size,
+                            stride=stride, padding=padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+        out = layer(input)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
     def batch_norm(input, **kwargs):
         from .. import nn as dynn
         bn = dynn.BatchNorm1D(input.shape[1]) if input.ndim == 2 else \

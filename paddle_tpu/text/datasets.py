@@ -1,0 +1,9 @@
+"""paddle.text.datasets — dataset classes alias module (ref:
+python/paddle/text/datasets/: Conll05st, Imdb, Imikolov, Movielens,
+UCIHousing, WMT14, WMT16).  The implementations live in paddle.text;
+this module mirrors the reference's import path."""
+from . import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+               WMT14, WMT16)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -8,6 +8,15 @@ import warnings
 
 from . import cpp_extension, unique_name
 
+
+def __getattr__(name):   # lazy: dlpack submodule imports back from here
+    if name == "dlpack":
+        import importlib
+        mod = importlib.import_module(".dlpack", __name__)
+        globals()["dlpack"] = mod
+        return mod
+    raise AttributeError(name)
+
 __all__ = ["cpp_extension", "unique_name", "deprecated", "try_import",
            "run_check", "to_dlpack", "from_dlpack"]
 

@@ -1,0 +1,7 @@
+"""paddle.utils.dlpack — zero-copy tensor exchange submodule (ref:
+python/paddle/utils/dlpack.py).  Canonical impls live in utils.__init__;
+this module mirrors the reference's import path
+(``from paddle.utils.dlpack import to_dlpack``)."""
+from . import from_dlpack, to_dlpack
+
+__all__ = ["to_dlpack", "from_dlpack"]

@@ -180,8 +180,11 @@ def test_mp_segment_survives_worker_exit(tmp_path):
             q = ctx.Queue()
             p = ctx.Process(target=producer, args=(q,))
             p.start()
-            time.sleep(6)
-            assert not p.is_alive()
+            deadline = time.time() + 120
+            while p.is_alive() and time.time() < deadline:
+                time.sleep(0.5)
+            assert not p.is_alive(), "worker did not finish in time"
+            time.sleep(1)   # let the worker's atexit hooks run
             t = q.get(timeout=30)
             assert abs(float(t.sum()) - 100.0) < 1e-3
             print("OK")

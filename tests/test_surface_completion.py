@@ -1,0 +1,215 @@
+"""Surface-completion batch (ref paths in each section): dlpack
+interop, text.datasets alias, incubate.nn fused layers, geometric
+message passing, sparse_attention, static.nn.conv2d,
+distributed.utils MoE dispatch API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# dlpack (ref: python/paddle/utils/dlpack.py)
+# ---------------------------------------------------------------------------
+
+def test_dlpack_roundtrip_with_torch():
+    torch = pytest.importorskip("torch")
+    t = paddle.to_tensor(np.arange(6, dtype="float32"))
+    tt = torch.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_array_equal(tt.numpy(), t.numpy())
+    back = paddle.utils.dlpack.from_dlpack(torch.arange(4).float())
+    np.testing.assert_array_equal(back.numpy(), [0, 1, 2, 3])
+
+
+def test_text_datasets_alias():
+    from paddle_tpu.text import datasets as td
+    assert td.Imdb is paddle.text.Imdb
+    assert td.WMT16 is paddle.text.WMT16
+
+
+# ---------------------------------------------------------------------------
+# incubate.nn fused layers (ref: incubate/nn/layer/fused_transformer.py)
+# ---------------------------------------------------------------------------
+
+def test_fused_multi_head_attention_matches_unfused():
+    from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+    paddle.seed(0)
+    H, nh = 16, 4
+    hd = H // nh
+    x = paddle.randn([2, 6, H])
+    att = FusedMultiHeadAttention(H, nh, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0)
+    att.eval()
+    got = np.asarray(att(x).numpy())
+
+    xv = np.asarray(x.numpy())
+    w = np.asarray(att.qkv_weight.numpy()).reshape(3 * H, H)
+    b = np.asarray(att.qkv_bias.numpy()).reshape(3 * H)
+    qkv = (xv @ w.T + b).reshape(2, 6, 3, nh, hd)
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = (p @ v).transpose(0, 2, 1, 3).reshape(2, 6, H)
+    o = xv + (o @ np.asarray(att.linear_weight.numpy())
+              + np.asarray(att.linear_bias.numpy()))
+    mu, var = o.mean(-1, keepdims=True), o.var(-1, keepdims=True)
+    want = ((o - mu) / np.sqrt(var + 1e-5)
+            * np.asarray(att.ln_scale.numpy())
+            + np.asarray(att.ln_bias.numpy()))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_encoder_layer_trains():
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+    paddle.seed(1)
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    x = paddle.randn([2, 5, 16])
+    enc(x).sum().backward()
+    assert enc.fused_attn.qkv_weight.grad is not None
+    assert enc.ffn.linear1_weight.grad is not None
+
+
+def test_fused_linear_transpose_weight():
+    from paddle_tpu.incubate.nn import FusedLinear
+    paddle.seed(2)
+    fl = FusedLinear(8, 4, transpose_weight=True)
+    x = paddle.randn([3, 8])
+    out = np.asarray(fl(x).numpy())
+    want = (np.asarray(x.numpy())
+            @ np.asarray(fl.weight.numpy()).T
+            + np.asarray(fl.bias.numpy()))
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# geometric (ref: python/paddle/geometric/)
+# ---------------------------------------------------------------------------
+
+def test_send_u_recv_reduce_ops():
+    x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(4, 3))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], "int64"))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int64"))
+    xv = np.arange(12, dtype="float32").reshape(4, 3)
+    want = np.zeros((3, 3), "float32")
+    for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+        want[d] += xv[s]
+    np.testing.assert_allclose(
+        paddle.geometric.send_u_recv(x, src, dst).numpy(), want)
+    got_max = paddle.geometric.send_u_recv(x, src, dst,
+                                           reduce_op="max").numpy()
+    assert np.allclose(got_max[1], np.maximum(xv[0], xv[2]))
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor(np.ones((3, 2), "float32"))
+    e = paddle.to_tensor(np.full((4, 2), 2.0, "float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2, 1], "int64"))
+    dst = paddle.to_tensor(np.array([1, 0, 1, 2], "int64"))
+    out = paddle.geometric.send_ue_recv(x, e, src, dst,
+                                        message_op="mul").numpy()
+    want = np.zeros((3, 2), "float32")
+    for s, d in zip([0, 1, 2, 1], [1, 0, 1, 2]):
+        want[d] += 2.0
+    np.testing.assert_allclose(out, want)
+    uv = paddle.geometric.send_uv(x, x * 3.0, src, dst,
+                                  message_op="add").numpy()
+    np.testing.assert_allclose(uv, np.full((4, 2), 4.0))
+
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
+    np.testing.assert_allclose(
+        paddle.geometric.segment_sum(data, ids).numpy(),
+        [[2.0, 4.0], [10.0, 12.0]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_mean(data, ids).numpy(),
+        [[1.0, 2.0], [5.0, 6.0]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_min(data, ids).numpy(),
+        [[0.0, 1.0], [4.0, 5.0]])
+    np.testing.assert_allclose(
+        paddle.geometric.segment_max(data, ids).numpy(),
+        [[2.0, 3.0], [6.0, 7.0]])
+
+
+def test_geometric_grad_flows():
+    x = paddle.to_tensor(np.ones((4, 3), "float32"), stop_gradient=False)
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], "int64"))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int64"))
+    paddle.geometric.send_u_recv(x, src, dst).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy()[:, 0], [2.0, 1.0, 1.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# sparse_attention (ref: nn/functional/sparse_attention.py)
+# ---------------------------------------------------------------------------
+
+def _dense_attn(q, k, v, mask):
+    D = q.shape[-1]
+    s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return p @ v
+
+
+def test_sparse_attention_causal_csr():
+    B, H, S, D = 1, 2, 4, 8
+    rs = np.random.RandomState(0)
+    q, k, v = (rs.randn(B, H, S, D).astype("float32") for _ in range(3))
+    offs = np.tile(np.cumsum([0] + list(range(1, S + 1)))
+                   .astype("int32"), (B, H, 1))
+    cols = np.tile(np.concatenate(
+        [np.arange(i + 1) for i in range(S)]).astype("int32"), (B, H, 1))
+    out = paddle.nn.functional.sparse_attention(
+        Tensor(q), Tensor(k), Tensor(v), Tensor(offs), Tensor(cols))
+    want = _dense_attn(q, k, v, np.tril(np.ones((S, S), bool)))
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_attention_block_pattern():
+    B, H, S, D = 1, 1, 6, 4
+    rs = np.random.RandomState(1)
+    q, k, v = (rs.randn(B, H, S, D).astype("float32") for _ in range(3))
+    # each row attends to itself and row 0 (global-token pattern)
+    cols_list = [[0] if i == 0 else [0, i] for i in range(S)]
+    offs = np.cumsum([0] + [len(c) for c in cols_list]).astype("int32")
+    cols = np.concatenate(cols_list).astype("int32")
+    out = paddle.nn.functional.sparse_attention(
+        Tensor(q), Tensor(k), Tensor(v),
+        Tensor(np.tile(offs, (B, H, 1))),
+        Tensor(np.tile(cols, (B, H, 1))))
+    mask = np.zeros((S, S), bool)
+    for i, cs in enumerate(cols_list):
+        mask[i, cs] = True
+    want = _dense_attn(q, k, v, mask)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# static.nn.conv2d + distributed.utils
+# ---------------------------------------------------------------------------
+
+def test_static_nn_conv2d():
+    paddle.seed(3)
+    out = paddle.static.nn.conv2d(paddle.randn([1, 3, 8, 8]), 4, 3)
+    assert list(out.shape) == [1, 4, 6, 6]
+
+
+def test_global_scatter_gather_single_rank():
+    from paddle_tpu.distributed.utils import (expert_count, global_gather,
+                                              global_scatter)
+    x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    counts = paddle.to_tensor(np.array([1, 3], "int64"))
+    out = global_scatter(x, counts, counts)
+    np.testing.assert_array_equal(out.numpy(), x.numpy())
+    back = global_gather(out, counts, counts)
+    np.testing.assert_array_equal(back.numpy(), x.numpy())
+    ec = expert_count(paddle.to_tensor(np.array([0, 1, 1, 1], "int64")), 2)
+    np.testing.assert_array_equal(ec.numpy(), [1, 3])
+    with pytest.raises(ValueError):
+        global_scatter(x, paddle.to_tensor(np.array([1, 1], "int64")),
+                       counts)
