@@ -2323,6 +2323,16 @@ _EXTRA_GRAD = {
     "incubate.nn.functional.fused_rms_norm",
     "incubate.nn.functional.fused_rotary_position_embedding",
     "vision.ops.box_coder", "distribution.kl_divergence",
+    # wave 11: smooth/deterministic ops with real-valued outputs whose
+    # jax VJPs are well-defined at the (random, tie-free) case points
+    "float_power", "frac", "deg2rad", "rad2deg", "neg",
+    "mod", "remainder", "floor_mod", "vander",
+    "cholesky_solve", "triangular_solve", "slogdet",
+    "eigh", "linalg.eigh",
+    "svd", "linalg.svd", "svdvals", "qr", "linalg.qr",
+    "vector_norm", "matrix_norm", "cond", "linalg.cond",
+    "topk", "kthvalue", "cummax", "cummin",
+    "nn.functional.rrelu", "nn.functional.batch_norm",
 }
 
 
