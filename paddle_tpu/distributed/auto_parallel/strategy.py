@@ -52,17 +52,56 @@ class TuningConfig(_Config):
         super().__init__(enable=False, profile=False, candidates=None)
 
 
+class FusedPassesConfig(_Config):
+    """ref: strategy.FusedPassesConfig — named fusion passes.  XLA's
+    fusion subsumes their effect; the list is accepted for config
+    compatibility and not interpreted."""
+
+    def __init__(self):
+        super().__init__(enable=False, fused_passes_list=[])
+
+
+class DPOptimizationConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, fuse_all_reduce_ops=True,
+                         fuse_grad_size_in_MB=32, overlap_comm_cacl=True)
+
+
+class SPOptimizationConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False)
+
+
+class QATConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, channel_wise_abs_max=True,
+                         weight_bits=8, activation_bits=8,
+                         not_quant_pattern=[], algo=None)
+
+
+class DatasetConfig(_Config):
+    def __init__(self):
+        super().__init__(enable=False, num_shards=1)
+
+
 class Strategy(_Config):
     def __init__(self, config=None):
         super().__init__()
+        self.auto_mode = "semi"
         self.sharding = ShardingConfig()
         self.amp = AMPConfig()
         self.recompute = RecomputeConfig()
         self.pipeline = PipelineConfig()
         self.gradient_merge = GradientMergeConfig()
         self.mp_optimization = MPOptimizationConfig()
+        self.dp_optimization = DPOptimizationConfig()
+        self.sp_optimization = SPOptimizationConfig()
+        self.fused_passes = FusedPassesConfig()
+        self.qat = QATConfig()
+        self.dataset = DatasetConfig()
         self.tuning = TuningConfig()
         self.split_data = True
+        self.gradient_scale_using_world_size = False
         self.seed = None
         if config:
             for k, v in dict(config).items():

@@ -43,14 +43,44 @@ class PipelineParallel(MetaParallelBase):
         mb = b // n
         return [data[i * mb:(i + 1) * mb] for i in range(n)]
 
-    def _scheduler(self):
+    def _scheduler(self, microbatch_size=None):
         """The host-driven schedule driver for this wrapper's
         ``schedule_mode`` (FThenB/1F1B/VPP/ZBH1 — ref: the reference's
         schedule zoo), built lazily."""
         if self._host_sched is None:
             from .pp_schedules import HostPipelineSchedule
+            import jax as _jax
+            dp = 1
+            if self._hcg is not None:
+                dp = self._hcg.get_data_parallel_world_size()
+                # the host drivers handle dp x pp ONLY; any other live
+                # axis routes through the compiled shard_map ring
+                for getter in ("get_model_parallel_world_size",
+                               "get_sharding_parallel_world_size"):
+                    fn = getattr(self._hcg, getter, None)
+                    if fn is not None and fn() > 1:
+                        dp = 1
+                        break
+            n_stages = self._layers.get_num_stages()
+            if dp > 1 and microbatch_size is not None \
+                    and microbatch_size % dp != 0:
+                import warnings
+                warnings.warn(
+                    f"pipeline host driver: microbatch size "
+                    f"{microbatch_size} is not divisible by "
+                    f"dp_degree={dp}; falling back to dp=1 (pure pp)")
+                dp = 1
+            if dp > 1 and n_stages * dp > len(_jax.devices()):
+                import warnings
+                warnings.warn(
+                    f"pipeline host driver: dp_degree={dp} x "
+                    f"{n_stages} stages exceeds {len(_jax.devices())} "
+                    "devices; falling back to dp=1 (pure pp)")
+                dp = 1
+            self._host_dp = dp
             self._host_sched = HostPipelineSchedule(
-                self._layers, schedule_mode=self.schedule_mode)
+                self._layers, schedule_mode=self.schedule_mode,
+                dp_degree=dp)
         return self._host_sched
 
     def forward_backward_pipeline(self, data, scaler=None):
@@ -69,7 +99,10 @@ class PipelineParallel(MetaParallelBase):
         # tape-driven grad-accum loop
         single_in = not isinstance(inputs, (tuple, list))
         if scaler is None and single_in:
-            sched = self._scheduler()
+            mb = (micro_inputs[0].shape[0]
+                  if micro_inputs and hasattr(micro_inputs[0], "shape")
+                  else None)
+            sched = self._scheduler(microbatch_size=mb)
             x_arrays = [x._data if isinstance(x, Tensor) else x
                         for x in micro_inputs]
             y_arrays = [y._data if isinstance(y, Tensor) else y

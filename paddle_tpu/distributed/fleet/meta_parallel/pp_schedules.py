@@ -27,10 +27,15 @@ per-stage jit-compiled functions:
 All schedules are numerically identical (grad accumulation is a sum);
 what differs is event ORDER (asserted in tests via ``event_log``) and
 peak residency of saved activations (``peak_live_residuals``: FThenB
-holds all M×S forward residuals, 1F1B at most S per stage).  For hybrid
-meshes (mp/sharding inside a stage) the compiled shard_map ring
+holds all M×S forward residuals, 1F1B at most S per stage).
+
+Hybrid: ``dp_degree > 1`` drives dp x pp — each stage owns a contiguous
+dp-submesh (params replicated over it, microbatch batch-dim sharded
+across it; GSPMD inserts the grad psum), and stage boundaries reshard
+activations across submeshes (the host-driver analogue of p2p
+send/recv).  For mp/sharding INSIDE a stage the compiled shard_map ring
 (pp_spmd.py) remains the fast path; these drivers carry the reference's
-schedule semantics and the pp-only path.
+schedule semantics.
 """
 from __future__ import annotations
 
@@ -71,9 +76,13 @@ class _StageRunner:
     bounding saved residuals exactly like the eager recompute() path."""
 
     def __init__(self, layers: Sequence, device, loss_fn=None,
-                 recompute_every: int = 0):
+                 recompute_every: int = 0, data_sharding=None):
         self.layers = list(layers)
-        self.device = device
+        self.device = device          # Device OR param NamedSharding
+        # set for dp x pp hybrid driving: batch-dim sharding over this
+        # stage's dp submesh; activations/labels reshard to it at the
+        # stage boundary (the host-driver analogue of p2p send/recv)
+        self.data_sharding = data_sharding
         self.loss_fn = loss_fn        # set on the LAST stage only
         seen, params = set(), []
         for l in self.layers:
@@ -197,6 +206,21 @@ _ORDERS = {"FThenB": _order_fthenb, "F-then-B": _order_fthenb,
            "1F1B": _order_1f1b, "ZBH1": _order_zbh1, "ZBpp": _order_zbh1}
 
 
+def _to_stage(runner: "_StageRunner", arr):
+    """Move an activation/cotangent/label onto the runner's stage.
+
+    dp x pp: batch-bearing arrays reshard to the stage's dp submesh
+    (scalars replicate); pure pp: pin single-device arrays to the stage
+    device, leave GSPMD-committed arrays alone."""
+    if runner.data_sharding is not None:
+        if getattr(arr, "ndim", 0) == 0:
+            return jax.device_put(arr, runner.device)
+        return jax.device_put(arr, runner.data_sharding)
+    if runner.device is not None and not _is_sharded(arr):
+        return jax.device_put(arr, runner.device)
+    return arr
+
+
 # ---------------------------------------------------------------------------
 # the host event loop
 # ---------------------------------------------------------------------------
@@ -210,7 +234,7 @@ class HostPipelineSchedule:
     """
 
     def __init__(self, pipeline_layer, schedule_mode: str = "1F1B",
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None, dp_degree: int = 1):
         self.pl = pipeline_layer
         self.mode = schedule_mode
         n_stages = pipeline_layer.get_num_stages()
@@ -224,7 +248,36 @@ class HostPipelineSchedule:
             v = 1
         self.n_virtual = n_stages * v
         self.n_devices = n_stages
-        if devices is None:
+        self.dp_degree = int(dp_degree) if dp_degree else 1
+        data_shardings = None
+        if self.dp_degree > 1 and devices is not None:
+            raise ValueError(
+                "devices= and dp_degree>1 conflict: hybrid driving "
+                "builds its own per-stage dp submeshes; pass one or "
+                "the other")
+        if self.dp_degree > 1:
+            # dp x pp hybrid: stage s owns a CONTIGUOUS dp-submesh of
+            # devices (stage-major so the stage boundary — the lower-
+            # bandwidth hop — crosses submeshes while dp collectives
+            # stay inside one); params replicate over the submesh,
+            # microbatches shard their batch dim across it
+            import numpy as _np
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as _P)
+            devs = jax.devices()
+            need = n_stages * self.dp_degree
+            if len(devs) < need:
+                raise ValueError(
+                    f"dp_degree={self.dp_degree} x {n_stages} stages "
+                    f"needs {need} devices, have {len(devs)}")
+            devices, data_shardings = [], []
+            for s in range(n_stages):
+                sub = _np.array(devs[s * self.dp_degree:
+                                     (s + 1) * self.dp_degree])
+                mesh = Mesh(sub, ("dp",))
+                devices.append(NamedSharding(mesh, _P()))
+                data_shardings.append(NamedSharding(mesh, _P("dp")))
+        elif devices is None:
             devs = jax.devices()
             devices = [devs[s % len(devs)] for s in range(n_stages)]
         # virtual stage k -> device k % P (interleaved mapping)
@@ -238,7 +291,9 @@ class HostPipelineSchedule:
             self.runners.append(_StageRunner(
                 layers, devices[k % n_stages],
                 loss_fn=pipeline_layer._loss_fn if is_last else None,
-                recompute_every=rc))
+                recompute_every=rc,
+                data_sharding=(data_shardings[k % n_stages]
+                               if data_shardings else None)))
         self.event_log: List[Tuple[int, str, int]] = []
         self.peak_live_residuals = 0
 
@@ -283,14 +338,14 @@ class HostPipelineSchedule:
                 # pop: the boundary activation has exactly one consumer —
                 # holding it would defeat the 1F1B residency bound
                 h = micro_inputs[i] if s == 0 else acts.pop((s - 1, i))
-                if r.device is not None and not _is_sharded(h):
-                    h = jax.device_put(h, r.device)
+                h = _to_stage(r, h)
                 pv = r.param_values()
                 # fresh per-(stage, micro) dropout stream from the host
                 # generator — an ARGUMENT of the jitted fn, never baked
                 key = default_generator.next_key()
                 if s == S - 1:
-                    out, vjp = jax.vjp(r.fwd, pv, h, key, micro_labels[i])
+                    labels = _to_stage(r, micro_labels[i])
+                    out, vjp = jax.vjp(r.fwd, pv, h, key, labels)
                     losses.append(out)
                 else:
                     out, vjp = jax.vjp(r.fwd, pv, h, key)
@@ -302,8 +357,7 @@ class HostPipelineSchedule:
             if kind in (BWD, BWD_D):
                 cot = (jnp.ones_like(losses[0]) / m) if s == S - 1 \
                     else gin.pop((s + 1, i))
-                if r.device is not None and not _is_sharded(cot):
-                    cot = jax.device_put(cot, r.device)
+                cot = _to_stage(r, cot)
                 got = vjps.pop((s, i))(cot)
                 dparams, dh = got[0], got[1]
                 if s > 0:
