@@ -56,7 +56,9 @@ class PipelineParallel(MetaParallelBase):
                 # the host drivers handle dp x pp ONLY; any other live
                 # axis routes through the compiled shard_map ring
                 for getter in ("get_model_parallel_world_size",
-                               "get_sharding_parallel_world_size"):
+                               "get_sharding_parallel_world_size",
+                               "get_sep_parallel_world_size",
+                               "get_context_parallel_world_size"):
                     fn = getattr(self._hcg, getter, None)
                     if fn is not None and fn() > 1:
                         dp = 1
@@ -77,7 +79,6 @@ class PipelineParallel(MetaParallelBase):
                     f"{n_stages} stages exceeds {len(_jax.devices())} "
                     "devices; falling back to dp=1 (pure pp)")
                 dp = 1
-            self._host_dp = dp
             self._host_sched = HostPipelineSchedule(
                 self._layers, schedule_mode=self.schedule_mode,
                 dp_degree=dp)
@@ -103,6 +104,14 @@ class PipelineParallel(MetaParallelBase):
                   if micro_inputs and hasattr(micro_inputs[0], "shape")
                   else None)
             sched = self._scheduler(microbatch_size=mb)
+            if sched.dp_degree > 1 and mb is not None \
+                    and mb % sched.dp_degree != 0:
+                raise ValueError(
+                    f"microbatch size {mb} is not divisible by the "
+                    f"pipeline driver's dp_degree={sched.dp_degree}; "
+                    "keep batch // accumulate_steps a multiple of "
+                    "dp_degree (the schedule was compiled for the "
+                    "first batch's shape)")
             x_arrays = [x._data if isinstance(x, Tensor) else x
                         for x in micro_inputs]
             y_arrays = [y._data if isinstance(y, Tensor) else y

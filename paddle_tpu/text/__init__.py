@@ -551,3 +551,12 @@ class WMT16(_LocalFileDataset):
             self.data.append((np.asarray(sid, "int64"),
                               np.asarray([0] + tid, "int64"),
                               np.asarray(tid + [1], "int64")))
+
+
+def __getattr__(name):
+    if name == "datasets":   # paddle.text.datasets alias module (ref path)
+        import importlib
+        mod = importlib.import_module(".datasets", __name__)
+        globals()["datasets"] = mod
+        return mod
+    raise AttributeError(name)
