@@ -1,0 +1,104 @@
+"""Probabilistic + graph workloads on the tape-connected domain APIs.
+
+Two miniature end-to-end trainings that exercise surfaces the flagship
+configs don't touch:
+
+* a **VAE** whose ELBO backpropagates through
+  ``paddle.distribution.kl_divergence`` and the reparameterized
+  ``Normal.rsample`` (the reference trains VAEs/policies exactly this
+  way — distributions must be differentiable wrt their parameters);
+* a **GNN** node regressor over ``paddle.geometric.send_u_recv``
+  message passing.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # run from a source checkout
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distribution import Normal, kl_divergence
+
+
+class VAE(nn.Layer):
+    def __init__(self, d_in=16, d_z=4):
+        super().__init__()
+        self.enc = nn.Linear(d_in, 2 * d_z)
+        self.dec = nn.Linear(d_z, d_in)
+        self.d_z = d_z
+
+    def forward(self, x):
+        h = self.enc(x)
+        mu, log_sig = h[:, :self.d_z], h[:, self.d_z:]
+        post = Normal(mu, log_sig.exp())
+        z = post.rsample()                     # pathwise gradients
+        recon = self.dec(z)
+        kl = kl_divergence(
+            post, Normal(paddle.zeros_like(mu),
+                         paddle.ones_like(mu))).sum(axis=-1)
+        return recon, kl
+
+
+def train_vae(steps=150):
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    data = paddle.to_tensor(rs.randn(64, 16).astype("float32") * 0.5)
+    vae = VAE()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=vae.parameters())
+    first = None
+    for i in range(steps):
+        recon, kl = vae(data)
+        elbo_loss = ((recon - data) ** 2).sum(axis=-1).mean() + kl.mean()
+        elbo_loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(elbo_loss)
+    print(f"VAE: -ELBO {first:.3f} -> {float(elbo_loss):.3f}")
+    assert float(elbo_loss) < first
+
+
+def train_gnn(steps=150):
+    paddle.seed(1)
+    rs = np.random.RandomState(1)
+    n, d = 32, 8
+    x = paddle.to_tensor(rs.randn(n, d).astype("float32"))
+    src = paddle.to_tensor(rs.randint(0, n, 128).astype("int64"))
+    dst = paddle.to_tensor(rs.randint(0, n, 128).astype("int64"))
+    target = paddle.to_tensor(rs.randn(n, 1).astype("float32"))
+    w1 = nn.Linear(d, d)
+    w2 = nn.Linear(d, 1)
+    opt = paddle.optimizer.Adam(
+        learning_rate=5e-3,
+        parameters=list(w1.parameters()) + list(w2.parameters()))
+    first = None
+    for i in range(steps):
+        h = paddle.nn.functional.relu(w1(x))
+        h = paddle.geometric.send_u_recv(h, src, dst, reduce_op="mean",
+                                         out_size=n)
+        loss = ((w2(h) - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+    print(f"GNN: loss {first:.3f} -> {float(loss):.3f}")
+    assert float(loss) < first
+
+
+def main():
+    train_vae()
+    train_gnn()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
