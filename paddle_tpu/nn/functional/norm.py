@@ -91,6 +91,20 @@ def layer_norm(x, normalized_shape, weight=None, bias=None,
     if has_b:
         args.append(ensure_tensor(bias))
 
+    # Pallas hot path: last-axis norm with full weight+bias (the
+    # transformer-block shape); other layouts use the XLA composition
+    if n_norm == 1 and has_w and has_b:
+        from ...ops.pallas import layer_norm as _pln
+        if _pln.available():
+            from ...flags import get_flag
+            interp = bool(get_flag("pallas_interpret"))
+
+            def fp(v, w, b):
+                return _pln.layer_norm_pallas(v, w, b, float(epsilon),
+                                              _pln.DEFAULT_BLOCK_N,
+                                              interp)
+            return call_op(fp, tuple(args), {}, op_name="layer_norm")
+
     def f(v, *rest):
         # fp32 statistics regardless of input dtype (bf16-safe, matches the
         # reference's float accumulation)

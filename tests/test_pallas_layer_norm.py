@@ -1,0 +1,92 @@
+"""Pallas fused LayerNorm — OpTest-style parity vs the jnp reference in
+interpret mode (SURVEY.md §4: numeric check for every Pallas kernel)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.pallas.layer_norm import (layer_norm_pallas,
+                                              reference_layer_norm)
+
+
+@pytest.mark.parametrize("shape", [(4, 128), (3, 5, 256), (33, 128)],
+                         ids=["2d", "3d", "ragged-rows"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_forward_parity(shape, dtype):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*shape), dtype)
+    w = jnp.asarray(rs.randn(shape[-1]) + 1.0, dtype)
+    b = jnp.asarray(rs.randn(shape[-1]), dtype)
+    out = layer_norm_pallas(x, w, b, 1e-5, 16, True)
+    ref = reference_layer_norm(x, w, b, 1e-5)
+    tol = 1e-6 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=tol,
+                               rtol=tol)
+
+
+def test_layer_norm_grads_match_autodiff():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(40, 128), jnp.float32)
+    w = jnp.asarray(rs.randn(128) + 1.0, jnp.float32)
+    b = jnp.asarray(rs.randn(128), jnp.float32)
+
+    def via_kernel(x, w, b):
+        return layer_norm_pallas(x, w, b, 1e-5, 16, True).sum()
+
+    def via_ref(x, w, b):
+        return reference_layer_norm(x, w, b, 1e-5).sum()
+
+    gk = jax.grad(via_kernel, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(via_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_functional_layer_norm_routes_through_kernel():
+    """The nn.functional hot path uses the kernel (flag-gated) and the
+    tape still produces weight/bias grads."""
+    paddle.set_flags({"FLAGS_pallas_interpret": True})
+    try:
+        rs = np.random.RandomState(2)
+        x = Tensor(rs.randn(4, 6, 64).astype("float32"))
+        x.stop_gradient = False
+        w = Tensor(rs.randn(64).astype("float32"))
+        w.stop_gradient = False
+        b = Tensor(rs.randn(64).astype("float32"))
+        b.stop_gradient = False
+        out = paddle.nn.functional.layer_norm(x, [64], w, b)
+        xf = np.asarray(x.numpy(), np.float64)
+        m = xf.mean(-1, keepdims=True)
+        v = xf.var(-1, keepdims=True)
+        want = ((xf - m) / np.sqrt(v + 1e-5)) * np.asarray(w.numpy()) \
+            + np.asarray(b.numpy())
+        np.testing.assert_allclose(out.numpy(), want, atol=1e-5, rtol=1e-5)
+        out.sum().backward()
+        assert x.grad is not None and w.grad is not None \
+            and b.grad is not None
+        np.testing.assert_allclose(b.grad.numpy(), np.full(64, 24.0),
+                                   rtol=1e-6)
+    finally:
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
+
+
+def test_flag_off_uses_xla_path_same_numbers():
+    rs = np.random.RandomState(3)
+    x = Tensor(rs.randn(5, 32).astype("float32"))
+    w = Tensor(rs.randn(32).astype("float32"))
+    b = Tensor(rs.randn(32).astype("float32"))
+    paddle.set_flags({"FLAGS_pallas_interpret": True})
+    try:
+        on = paddle.nn.functional.layer_norm(x, [32], w, b).numpy()
+    finally:
+        paddle.set_flags({"FLAGS_pallas_interpret": False})
+    paddle.set_flags({"FLAGS_use_pallas_layer_norm": False})
+    try:
+        off = paddle.nn.functional.layer_norm(x, [32], w, b).numpy()
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_layer_norm": True})
+    np.testing.assert_allclose(on, off, atol=1e-6, rtol=1e-6)

@@ -68,6 +68,33 @@ def rms_norm():
         check(f"rms_norm.dw.{dtype.__name__}", dwp, dwr, tol * 4)
 
 
+def layer_norm():
+    from paddle_tpu.ops.pallas.layer_norm import (layer_norm_pallas,
+                                                  reference_layer_norm)
+    for dtype, tol in ((jnp.float32, 2e-5), (jnp.bfloat16, 2e-2)):
+        x = jax.random.normal(jax.random.PRNGKey(0), (512, 1024), dtype)
+        w = jax.random.normal(jax.random.PRNGKey(1), (1024,), dtype) * 0.1 + 1.0
+        b = jax.random.normal(jax.random.PRNGKey(2), (1024,), dtype) * 0.1
+        g = jax.random.normal(jax.random.PRNGKey(3), (512, 1024), dtype)
+
+        out = layer_norm_pallas(x, w, b)
+        ref = reference_layer_norm(x, w, b)
+        check(f"layer_norm.fwd.{dtype.__name__}", out, ref, tol)
+
+        def loss_p(x, w, b):
+            return jnp.sum(layer_norm_pallas(x, w, b) *
+                           g.astype(jnp.float32))
+
+        def loss_r(x, w, b):
+            return jnp.sum(reference_layer_norm(x, w, b) *
+                           g.astype(jnp.float32))
+
+        dp = jax.grad(loss_p, (0, 1, 2))(x, w, b)
+        dr = jax.grad(loss_r, (0, 1, 2))(x, w, b)
+        for nm, a, c in zip(("dx", "dw", "db"), dp, dr):
+            check(f"layer_norm.{nm}.{dtype.__name__}", a, c, tol * 4)
+
+
 def flash():
     from paddle_tpu.ops.flash_attention import (
         flash_attention_bhsd, reference_attention_bhsd)
@@ -197,6 +224,7 @@ def main():
                           "CPU (use the interpret-mode tests)"}))
         return 1
     run("rms_norm", rms_norm)
+    run("layer_norm", layer_norm)
     run("rope", rope)
     run("adamw", adamw)
     run("flash_attention", flash)
