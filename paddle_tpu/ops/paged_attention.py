@@ -1,0 +1,211 @@
+"""Paged-KV-cache decode attention + page-pool manager.
+
+ref: the reference serves autoregressive decode through
+paddle/phi/kernels/fusion/ block_multihead_attention (PaddleNLP's
+block/paged KV cache, vLLM-style), exposed as
+incubate/nn/functional/block_multihead_attention.py.  PAPERS.md's
+Ragged Paged Attention is the TPU-native treatment.
+
+TPU-native design: the KV cache lives in fixed-size PAGES
+(``[num_kv_heads, total_pages, page_size, head_dim]``); each sequence
+owns a list of page ids, so wildly different context lengths share one
+pool with no reallocation or fragmentation.  The decode-attention core
+routes to the sanctioned Pallas TPU kernel
+(jax.experimental.pallas.ops.tpu.paged_attention) on hardware — the
+same role cuDNN/flashattn plays for the reference — with a jnp
+reference path everywhere else (and as the test oracle).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ["paged_attention", "paged_attention_ref", "PagedKVCache"]
+
+
+def _use_tpu_kernel() -> bool:
+    from ..flags import get_flag
+    if not get_flag("use_pallas_paged_attention"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def paged_attention_ref(q, k_pages, v_pages, lengths, page_indices):
+    """jnp reference: gather each sequence's pages densely, run masked
+    attention.  q [B, nh, hd]; k/v_pages [nkv, P, ps, hd]; lengths
+    i32[B]; page_indices i32[B, pages_per_seq] -> [B, nh, hd]."""
+    b, nh, hd = q.shape
+    nkv, _, ps, _ = k_pages.shape
+    rep = nh // nkv
+    ppseq = page_indices.shape[1]
+    # [B, nkv, ppseq*ps, hd] gathered per sequence
+    k = jnp.swapaxes(k_pages[:, page_indices], 0, 1) \
+        .reshape(b, nkv, ppseq * ps, hd)
+    v = jnp.swapaxes(v_pages[:, page_indices], 0, 1) \
+        .reshape(b, nkv, ppseq * ps, hd)
+    k = jnp.repeat(k, rep, axis=1)           # GQA broadcast
+    v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / np.sqrt(hd)
+    pos = jnp.arange(ppseq * ps)[None, None, :]
+    mask = pos < lengths[:, None, None]
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bht,bhtd->bhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, lengths, page_indices,
+                    pages_per_compute_block: int = 4):
+    """Decode attention over a paged KV cache (one query token per
+    sequence).  Tensor in/out; routes to the TPU Pallas kernel when
+    available, else the jnp reference.
+
+    The kernel path serves inference: it has no autodiff rule, so any
+    grad-requiring input falls back to the (differentiable) reference."""
+    args = (ensure_tensor(q), ensure_tensor(k_pages),
+            ensure_tensor(v_pages), ensure_tensor(lengths),
+            ensure_tensor(page_indices))
+
+    from ..core.autograd_state import is_grad_enabled
+    needs_grad = is_grad_enabled() and any(
+        not t.stop_gradient for t in args)
+
+    if _use_tpu_kernel() and not needs_grad:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _pa)
+        # the jax kernel applies NO internal softmax scaling — fold the
+        # 1/sqrt(head_dim) temperature into q to match the reference
+        scale = 1.0 / np.sqrt(float(args[0].shape[-1]))
+        # kernel constraint: pages_per_sequence must be a multiple of
+        # the compute block — clamp to the largest valid divisor
+        ppseq = int(args[4].shape[-1])
+        blk = min(pages_per_compute_block, ppseq)
+        while ppseq % blk:
+            blk -= 1
+
+        def fk(qa, ka, va, la, pa):
+            return _pa(qa * jnp.asarray(scale, qa.dtype), ka, va,
+                       la.astype(jnp.int32), pa.astype(jnp.int32),
+                       pages_per_compute_block=blk)
+        return call_op(fk, args, op_name="paged_attention")
+
+    def fr(qa, ka, va, la, pa):
+        return paged_attention_ref(qa, ka, va, la.astype(jnp.int32),
+                                   pa.astype(jnp.int32))
+    return call_op(fr, args, op_name="paged_attention")
+
+
+class PagedKVCache:
+    """Page-pool KV cache for serving-style batched decode.
+
+    ref role: the block cache behind block_multihead_attention
+    (PaddleNLP serving) — fixed-size pages, per-sequence page tables, a
+    free list; appending a token never reallocates, finishing a
+    sequence returns its pages to the pool.
+
+    The pool is device-resident (functional updates via ``.at[]``);
+    the page tables and lengths are small host-side state the scheduler
+    mutates freely.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, num_kv_heads: int,
+                 head_dim: int, max_pages_per_seq: int,
+                 dtype: str = "float32"):
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.k_pages = jnp.zeros(
+            (num_kv_heads, num_pages, page_size, head_dim), dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self._free: List[int] = list(range(num_pages))[::-1]
+        # seq id -> (page id list, current length)
+        self._seqs: dict = {}
+
+    # -- scheduling ------------------------------------------------------
+    def allocate(self, seq_id) -> None:
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        self._seqs[seq_id] = ([], 0)
+
+    def free(self, seq_id) -> None:
+        pages, _ = self._seqs.pop(seq_id)
+        self._free.extend(reversed(pages))
+
+    def length(self, seq_id) -> int:
+        return self._seqs[seq_id][1]
+
+    def _page_for_next_token(self, seq_id) -> Tuple[int, int]:
+        pages, length = self._seqs[seq_id]
+        slot = length % self.page_size
+        if slot == 0:   # need a fresh page
+            if len(pages) >= self.max_pages_per_seq:
+                raise RuntimeError(
+                    f"sequence {seq_id!r} exceeds max_pages_per_seq")
+            if not self._free:
+                raise RuntimeError("KV page pool exhausted")
+            pages.append(self._free.pop())
+        return pages[-1], slot
+
+    # -- writes ----------------------------------------------------------
+    def append(self, seq_id, k_tok, v_tok) -> None:
+        """Append one token's K/V ([num_kv_heads, head_dim]) to a
+        sequence."""
+        page, slot = self._page_for_next_token(seq_id)
+        k_tok = ensure_tensor(k_tok)._data
+        v_tok = ensure_tensor(v_tok)._data
+        self.k_pages = self.k_pages.at[:, page, slot].set(
+            k_tok.astype(self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[:, page, slot].set(
+            v_tok.astype(self.v_pages.dtype))
+        pages, length = self._seqs[seq_id]
+        self._seqs[seq_id] = (pages, length + 1)
+
+    def prefill(self, seq_id, k_seq, v_seq) -> None:
+        """Bulk-append a prompt's K/V ([T, num_kv_heads, head_dim]).
+
+        Writes page-at-a-time (one functional pool update per PAGE, not
+        per token): a T-token prompt costs ceil(T/page_size) pool
+        updates instead of T."""
+        k_seq = ensure_tensor(k_seq)._data
+        v_seq = ensure_tensor(v_seq)._data
+        t = 0
+        T = k_seq.shape[0]
+        while t < T:
+            page, slot = self._page_for_next_token(seq_id)
+            n = min(self.page_size - slot, T - t)
+            # [n, nkv, hd] -> [nkv, n, hd] into the page's slot range
+            kblk = jnp.swapaxes(k_seq[t:t + n], 0, 1)
+            vblk = jnp.swapaxes(v_seq[t:t + n], 0, 1)
+            self.k_pages = self.k_pages.at[:, page, slot:slot + n].set(
+                kblk.astype(self.k_pages.dtype))
+            self.v_pages = self.v_pages.at[:, page, slot:slot + n].set(
+                vblk.astype(self.v_pages.dtype))
+            pages, length = self._seqs[seq_id]
+            self._seqs[seq_id] = (pages, length + n)
+            t += n
+
+    # -- reads -----------------------------------------------------------
+    def batch_tables(self, seq_ids) -> Tuple[Tensor, Tensor]:
+        """(lengths i32[B], page_indices i32[B, max_pages_per_seq]) for
+        a decode batch.  Unused table slots point at page 0 and are
+        masked out by `lengths`."""
+        lengths = np.zeros((len(seq_ids),), "int32")
+        tables = np.zeros((len(seq_ids), self.max_pages_per_seq), "int32")
+        for i, sid in enumerate(seq_ids):
+            pages, length = self._seqs[sid]
+            lengths[i] = length
+            tables[i, :len(pages)] = pages
+        return Tensor(jnp.asarray(lengths)), Tensor(jnp.asarray(tables))
+
+    def attend(self, q, seq_ids) -> Tensor:
+        """Decode attention for a batch: q [B, num_heads, head_dim]."""
+        lengths, tables = self.batch_tables(seq_ids)
+        return paged_attention(q, Tensor(self.k_pages),
+                               Tensor(self.v_pages), lengths, tables)

@@ -1,0 +1,117 @@
+"""Paged KV-cache decode attention (ref: the serving block-cache behind
+incubate/nn/functional/block_multihead_attention.py; PAPERS.md ragged
+paged attention) — oracle: dense attention over each sequence's real
+context."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.paged_attention import (PagedKVCache, paged_attention,
+                                            paged_attention_ref)
+
+
+def _dense_oracle(q_i, k, v, nh):
+    nkv, hd = k.shape[1], k.shape[2]
+    kk = np.repeat(k.transpose(1, 0, 2), nh // nkv, axis=0)
+    vv = np.repeat(v.transpose(1, 0, 2), nh // nkv, axis=0)
+    s = np.einsum("hd,hld->hl", q_i, kk) / np.sqrt(hd)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("hl,hld->hd", p, vv)
+
+
+def test_paged_decode_matches_dense_ragged_lengths():
+    rs = np.random.RandomState(0)
+    nkv, hd, nh = 2, 16, 4
+    cache = PagedKVCache(num_pages=32, page_size=4, num_kv_heads=nkv,
+                         head_dim=hd, max_pages_per_seq=8)
+    dense = {}
+    for sid, L in [("a", 1), ("b", 4), ("c", 7), ("d", 29)]:
+        cache.allocate(sid)
+        k = rs.randn(L, nkv, hd).astype("float32")
+        v = rs.randn(L, nkv, hd).astype("float32")
+        cache.prefill(sid, Tensor(k), Tensor(v))
+        dense[sid] = (k, v)
+    sids = ["a", "b", "c", "d"]
+    q = rs.randn(len(sids), nh, hd).astype("float32")
+    out = cache.attend(Tensor(q), sids).numpy()
+    for i, sid in enumerate(sids):
+        k, v = dense[sid]
+        np.testing.assert_allclose(out[i], _dense_oracle(q[i], k, v, nh),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"seq {sid}")
+
+
+def test_incremental_decode_equals_prefill():
+    """Appending tokens one decode step at a time gives the same
+    attention as a bulk prefill of the same tokens."""
+    rs = np.random.RandomState(1)
+    nkv, hd, nh = 1, 8, 2
+    k = rs.randn(9, nkv, hd).astype("float32")
+    v = rs.randn(9, nkv, hd).astype("float32")
+    c1 = PagedKVCache(16, 4, nkv, hd, 4)
+    c1.allocate("s")
+    c1.prefill("s", Tensor(k), Tensor(v))
+    c2 = PagedKVCache(16, 4, nkv, hd, 4)
+    c2.allocate("s")
+    for t in range(9):
+        c2.append("s", Tensor(k[t]), Tensor(v[t]))
+    q = Tensor(rs.randn(1, nh, hd).astype("float32"))
+    np.testing.assert_allclose(c1.attend(q, ["s"]).numpy(),
+                               c2.attend(q, ["s"]).numpy(), rtol=1e-6)
+
+
+def test_page_pool_reuse_and_exhaustion():
+    cache = PagedKVCache(num_pages=2, page_size=2, num_kv_heads=1,
+                         head_dim=4, max_pages_per_seq=2)
+    rs = np.random.RandomState(2)
+
+    def tok():
+        return (Tensor(rs.randn(1, 4).astype("float32")),
+                Tensor(rs.randn(1, 4).astype("float32")))
+
+    cache.allocate("x")
+    for _ in range(4):
+        cache.append("x", *tok())
+    cache.allocate("y")
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cache.append("y", *tok())
+    cache.free("x")                    # pages return to the pool
+    for _ in range(4):
+        cache.append("y", *tok())
+    assert cache.length("y") == 4
+    with pytest.raises(RuntimeError, match="max_pages_per_seq"):
+        cache.append("y", *tok())
+
+
+def test_paged_attention_ref_masks_padding_pages():
+    """Entries past `lengths` (incl. whole unused table slots pointing
+    at page 0) must not contribute."""
+    rs = np.random.RandomState(3)
+    nkv, hd, nh, ps = 1, 8, 1, 4
+    k_pages = np.asarray(rs.randn(nkv, 4, ps, hd), "float32")
+    v_pages = np.asarray(rs.randn(nkv, 4, ps, hd), "float32")
+    # sequence of length 3 in page 2; table second slot points at junk
+    tables = np.asarray([[2, 0]], "int32")
+    lengths = np.asarray([3], "int32")
+    q = np.asarray(rs.randn(1, nh, hd), "float32")
+    out = paged_attention(Tensor(q), Tensor(k_pages), Tensor(v_pages),
+                          Tensor(lengths), Tensor(tables)).numpy()
+    k = k_pages[0, 2, :3][:, None, :]
+    v = v_pages[0, 2, :3][:, None, :]
+    np.testing.assert_allclose(out[0], _dense_oracle(q[0], k, v, nh),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grads_flow_through_query():
+    rs = np.random.RandomState(4)
+    q = Tensor(rs.randn(1, 2, 8).astype("float32"))
+    q.stop_gradient = False
+    kp = Tensor(rs.randn(1, 2, 4, 8).astype("float32"))
+    vp = Tensor(rs.randn(1, 2, 4, 8).astype("float32"))
+    out = paged_attention(q, kp, vp,
+                          Tensor(np.asarray([5], "int32")),
+                          Tensor(np.asarray([[0, 1]], "int32")))
+    out.sum().backward()
+    assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
