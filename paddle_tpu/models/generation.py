@@ -47,12 +47,29 @@ def _sample(logits_row, decode_strategy, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def _to_paged(past, batch, max_total):
+    """Convert a dense prefill cache (per-layer (k, v) of
+    [B, S, nkv, hd]) into per-layer page pools + views (ref role: the
+    serving block cache behind block_multihead_attention)."""
+    from ..ops.paged_attention import build_paged_caches
+    k0 = past[0][0]._data
+    nkv, hd = k0.shape[2], k0.shape[3]
+    views = build_paged_caches(len(past), batch, max_total, nkv, hd,
+                               dtype=str(k0.dtype))
+    for view, (k, v) in zip(views, past):
+        ka, va = k._data, v._data
+        for b in range(batch):
+            view.cache.prefill(b, Tensor(ka[b]), Tensor(va[b]))
+    return views
+
+
 def generate(model, input_ids, max_new_tokens: int = 20,
              max_length: Optional[int] = None,
              decode_strategy: str = "greedy_search",
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None,
-             use_cache: bool = True, **unused):
+             use_cache: bool = True, use_paged_cache: bool = False,
+             **unused):
     """Returns a Tensor [B, S_prompt + n_generated] of token ids."""
     import inspect
     ids = input_ids if isinstance(input_ids, Tensor) else Tensor(
@@ -86,6 +103,14 @@ def generate(model, input_ids, max_new_tokens: int = 20,
         if supports_cache:
             kw = {"last_logits_only": True} if last_only else {}
             logits, past = model(Tensor(arr), use_cache=True, **kw)
+            if use_paged_cache:
+                if not getattr(model, "supports_paged_cache", False):
+                    raise ValueError(
+                        f"{type(model).__name__} does not support "
+                        "use_paged_cache=True (its attention has no "
+                        "PagedLayerView dispatch)")
+                past = _to_paged(past, arr.shape[0],
+                                 arr.shape[1] + int(max_new_tokens))
         else:
             logits = model(Tensor(arr))
         for _ in range(int(max_new_tokens)):

@@ -98,11 +98,21 @@ class MultiHeadAttention(nn.Layer):
 
     def forward(self, x, training: bool = True, past=None,
                 use_cache: bool = False):
+        from ..ops.paged_attention import PagedLayerView
         B, S, H = x.shape
         qkv = self.qkv_proj(x)                     # [B, S, 3H] (mp-sharded)
         # flash layout [B, S, nh, hd]; heads are the mp-sharded dim
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if isinstance(past, PagedLayerView):
+            # serving decode against the page pool (no rope in GPT —
+            # positions live in the embeddings)
+            if S != 1:
+                raise ValueError("paged decode feeds one token per step")
+            out = past.append_and_attend(q, k, v)  # [B, nh, hd]
+            out = out.reshape([B, 1, H])
+            out = self.out_proj(out)
+            return (out, past) if use_cache else out
         if past is not None:
             k = paddle.concat([past[0], k], axis=1)
             v = paddle.concat([past[1], v], axis=1)
@@ -197,8 +207,17 @@ class GPTModel(nn.Layer):
         self.final_ln = nn.LayerNorm(config.hidden_size, epsilon=1e-5)
 
     def forward(self, input_ids, past=None, use_cache: bool = False):
+        from ..ops.paged_attention import PagedLayerView
         c = self.config
-        pos0 = past[0][0].shape[1] if past is not None else 0
+        if past is not None and isinstance(past[0], PagedLayerView):
+            lens = past[0].lengths_np()
+            if len(set(lens.tolist())) != 1:
+                raise ValueError(
+                    "GPT's learned position embedding uses one batch-"
+                    "wide offset; paged decode needs uniform lengths")
+            pos0 = int(lens[0])
+        else:
+            pos0 = past[0][0].shape[1] if past is not None else 0
         x = self.embeddings(input_ids, pos_offset=pos0)
         # dp over batch; the sequence dim is sharded between blocks by
         # whichever long-context mechanism is live: sep/cp axis from the
@@ -233,6 +252,8 @@ class GPTModel(nn.Layer):
 
 class GPTForPretraining(nn.Layer):
     """LM head (tied to the word embedding) + loss."""
+
+    supports_paged_cache = True   # attention dispatches on PagedLayerView
 
     def __init__(self, config: GPTConfig):
         super().__init__()

@@ -146,7 +146,31 @@ class LlamaAttention(nn.Layer):
         XLA bottom-right causal mask both consume sq < sk directly).
         With ``use_cache`` returns (out, (k_full, v_full))."""
         from ..incubate.nn.functional import fused_rotary_position_embedding
+        from ..ops.paged_attention import PagedLayerView
         B, S, H = x.shape
+        if isinstance(past, PagedLayerView):
+            # serving decode: one token per sequence against the page
+            # pool — per-row rope positions (lengths differ), append to
+            # the pages, attend through paged_attention
+            if S != 1:
+                raise ValueError("paged decode feeds one token per step")
+            lens = past.lengths_np()
+            if int(lens.max()) + 1 > self._cos.shape[0]:
+                raise ValueError(
+                    f"sequence position {int(lens.max()) + 1} exceeds "
+                    f"max_position_embeddings {self._cos.shape[0]}")
+            q = self.q_proj(x).reshape([B, S, self.num_heads,
+                                        self.head_dim])
+            k = self.k_proj(x).reshape([B, S, self.num_kv, self.head_dim])
+            v = self.v_proj(x).reshape([B, S, self.num_kv, self.head_dim])
+            cos = Tensor(self._cos[lens][:, None])     # [B, 1, D]
+            sin = Tensor(self._sin[lens][:, None])
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, sin=sin, cos=cos, use_neox_rotary_style=False)
+            out = past.append_and_attend(q, k, v)      # [B, nh, hd]
+            out = out.reshape([B, 1, self.num_heads * self.head_dim])
+            out = self.o_proj(out)
+            return (out, past) if use_cache else out
         pos0 = past[0].shape[1] if past is not None else 0
         if pos0 + S > self._cos.shape[0]:
             raise ValueError(
@@ -267,6 +291,8 @@ class LlamaModel(nn.Layer):
 
 class LlamaForCausalLM(nn.Layer):
     """ref: modeling.LlamaForCausalLM — lm_head + criterion."""
+
+    supports_paged_cache = True   # attention dispatches on PagedLayerView
 
     def __init__(self, config: LlamaConfig):
         super().__init__()

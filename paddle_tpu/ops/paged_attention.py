@@ -27,7 +27,8 @@ from ..core.dispatch import call_op
 from ..core.tensor import Tensor
 from ..tensor._helpers import ensure_tensor
 
-__all__ = ["paged_attention", "paged_attention_ref", "PagedKVCache"]
+__all__ = ["paged_attention", "paged_attention_ref", "PagedKVCache",
+           "PagedLayerView", "build_paged_caches"]
 
 
 def _use_tpu_kernel() -> bool:
@@ -167,6 +168,26 @@ class PagedKVCache:
         pages, length = self._seqs[seq_id]
         self._seqs[seq_id] = (pages, length + 1)
 
+    def append_batch(self, seq_ids, k_batch, v_batch) -> None:
+        """Append one token per sequence with a SINGLE scatter per pool
+        (the decode hot path: one update instead of B).
+        k/v_batch: [B, num_kv_heads, head_dim]."""
+        pages, slots = [], []
+        for sid in seq_ids:
+            page, slot = self._page_for_next_token(sid)
+            pages.append(page)
+            slots.append(slot)
+            ps, length = self._seqs[sid]
+            self._seqs[sid] = (ps, length + 1)
+        pages = jnp.asarray(pages)
+        slots = jnp.asarray(slots)
+        kb = jnp.swapaxes(ensure_tensor(k_batch)._data, 0, 1)  # [nkv,B,hd]
+        vb = jnp.swapaxes(ensure_tensor(v_batch)._data, 0, 1)
+        self.k_pages = self.k_pages.at[:, pages, slots].set(
+            kb.astype(self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[:, pages, slots].set(
+            vb.astype(self.v_pages.dtype))
+
     def prefill(self, seq_id, k_seq, v_seq) -> None:
         """Bulk-append a prompt's K/V ([T, num_kv_heads, head_dim]).
 
@@ -209,3 +230,50 @@ class PagedKVCache:
         lengths, tables = self.batch_tables(seq_ids)
         return paged_attention(q, Tensor(self.k_pages),
                                Tensor(self.v_pages), lengths, tables)
+
+
+class PagedLayerView:
+    """One layer's handle on a PagedKVCache for a fixed decode batch.
+
+    Passed through a model's ``past`` slot: the attention layer
+    type-dispatches on it — instead of concatenating dense (k, v), it
+    appends the new token's K/V to the pages and attends through
+    ``paged_attention``.  The view is its own ``new_past`` (the pages
+    mutate in place from the model's perspective)."""
+
+    def __init__(self, cache: PagedKVCache, seq_ids):
+        self.cache = cache
+        self.seq_ids = list(seq_ids)
+
+    def lengths_np(self) -> np.ndarray:
+        return np.asarray([self.cache.length(s) for s in self.seq_ids],
+                          "int32")
+
+    def append_and_attend(self, q, k, v) -> Tensor:
+        """q [B, 1, nh, hd]; k/v [B, 1, nkv, hd] (post-rope) ->
+        [B, nh, hd] attention over each sequence's full context
+        including the token being appended."""
+        k_arr = ensure_tensor(k)._data
+        v_arr = ensure_tensor(v)._data
+        self.cache.append_batch(self.seq_ids, Tensor(k_arr[:, 0]),
+                                Tensor(v_arr[:, 0]))
+        q2 = ensure_tensor(q)
+        q2 = Tensor(q2._data[:, 0])
+        return self.cache.attend(q2, self.seq_ids)
+
+
+def build_paged_caches(n_layers: int, batch: int, max_len: int,
+                       num_kv_heads: int, head_dim: int,
+                       page_size: int = 16, dtype: str = "float32"):
+    """Per-layer caches + views for a decode batch of ``batch``
+    sequences bounded by ``max_len`` tokens each."""
+    ppseq = -(-int(max_len) // int(page_size))
+    views = []
+    for _ in range(n_layers):
+        cache = PagedKVCache(num_pages=batch * ppseq, page_size=page_size,
+                             num_kv_heads=num_kv_heads, head_dim=head_dim,
+                             max_pages_per_seq=ppseq, dtype=dtype)
+        for b in range(batch):
+            cache.allocate(b)
+        views.append(PagedLayerView(cache, range(batch)))
+    return views

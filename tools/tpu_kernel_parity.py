@@ -214,6 +214,30 @@ def adamw():
     check("adamw.p", np_, pr, 1e-5)
 
 
+def paged():
+    """Kernel vs jnp reference for paged decode attention (the kernel
+    only exists on TPU — no interpret mode, so hardware is the first
+    place the two paths can be compared)."""
+    from paddle_tpu.ops.paged_attention import paged_attention_ref
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as _pa)
+    import numpy as np
+    rs = np.random.RandomState(0)
+    nkv, nh, hd, ps, pages = 2, 8, 128, 16, 32
+    for dtype, tol in ((jnp.float32, 2e-5), (jnp.bfloat16, 2e-2)):
+        q = jnp.asarray(rs.randn(4, nh, hd), dtype)
+        kp = jnp.asarray(rs.randn(nkv, pages, ps, hd), dtype)
+        vp = jnp.asarray(rs.randn(nkv, pages, ps, hd), dtype)
+        lengths = jnp.asarray([5, 40, 63, 64], jnp.int32)
+        tables = jnp.asarray(rs.permutation(pages)[:16].reshape(4, 4),
+                             jnp.int32)
+        scale = 1.0 / np.sqrt(float(hd))
+        got = _pa(q * jnp.asarray(scale, dtype), kp, vp, lengths, tables,
+                  pages_per_compute_block=4)
+        want = paged_attention_ref(q, kp, vp, lengths, tables)
+        check(f"paged_attention.{dtype.__name__}", got, want, tol)
+
+
 def main():
     ds = jax.devices()
     info = {"platform": ds[0].platform,
@@ -228,6 +252,7 @@ def main():
     run("rope", rope)
     run("adamw", adamw)
     run("flash_attention", flash)
+    run("paged_attention", paged)
     n_ok = sum(1 for r in RESULTS if r.get("ok"))
     summary = {"summary": True, "ok": n_ok, "total": len(RESULTS),
                "all_ok": n_ok == len(RESULTS), **info}
