@@ -24,7 +24,8 @@ from typing import Dict, Optional
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["llama_from_hf", "bert_from_hf"]
+__all__ = ["llama_from_hf", "bert_from_hf", "gpt2_from_hf",
+           "mistral_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -72,6 +73,13 @@ def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
         sd = {"model." + k if not k.startswith("lm_head") else k: v
               for k, v in sd.items()}
 
+    hd = getattr(config, "head_dim", None)
+    if hd is not None and hd != config.hidden_size // config.num_attention_heads:
+        raise ValueError(
+            f"checkpoint sets head_dim={hd} != hidden_size//num_heads="
+            f"{config.hidden_size // config.num_attention_heads}; this "
+            "architecture (decoupled head_dim, e.g. Mistral-Nemo) is "
+            "not representable by LlamaAttention's fused layout")
     tie = bool(getattr(config, "tie_word_embeddings", False))
     cfg = LlamaConfig(
         vocab_size=config.vocab_size,
@@ -248,4 +256,39 @@ def gpt2_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
 
     model.gpt.final_ln.weight._data = cast(sd["ln_f.weight"])
     model.gpt.final_ln.bias._data = cast(sd["ln_f.bias"])
+    return model
+
+
+def mistral_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                    config=None, dtype: str = "float32"):
+    """Build a LlamaForCausalLM carrying a transformers Mistral
+    checkpoint.  Mistral's architecture is the LLaMA stack (RMSNorm,
+    rope, SwiGLU, GQA) with a sliding attention window; the state-dict
+    layout is key-identical, so the conversion delegates to
+    llama_from_hf.  NOTE: sliding-window masking is not applied —
+    outputs match the reference exactly for sequences shorter than
+    config.sliding_window (4096 for the released checkpoints), which
+    covers logits-parity validation; beyond the window the dense-causal
+    mask attends further back than Mistral would."""
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sw = getattr(config, "sliding_window", None)
+    model = llama_from_hf(state_dict=state_dict, config=config,
+                          dtype=dtype)
+    model._mistral_sliding_window = sw
+    if sw is not None:
+        import warnings
+        orig_forward = model.forward
+
+        def forward(input_ids, *a, **k):
+            if input_ids.shape[-1] > sw:
+                warnings.warn(
+                    f"sequence length {input_ids.shape[-1]} exceeds "
+                    f"Mistral's sliding window {sw}; the dense-causal "
+                    "mask attends further back than the reference "
+                    "would — logits diverge past the window")
+            return orig_forward(input_ids, *a, **k)
+
+        model.forward = forward   # instance attr: Layer.__call__ uses it
     return model

@@ -138,3 +138,23 @@ def test_gpt2_kv_cache_decode_matches_full_forward():
     step, _ = ours(Tensor(ids[:, -1:]), past=past, use_cache=True)
     np.testing.assert_allclose(np.asarray(step.numpy())[:, 0],
                                full[:, -1], rtol=1e-4, atol=1e-5)
+
+
+def test_mistral_logits_match_transformers():
+    """Mistral = LLaMA stack + sliding window; below the window the
+    converted model must match transformers' Mistral exactly."""
+    from paddle_tpu.models.convert import mistral_from_hf
+    torch.manual_seed(5)
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=4096, attn_implementation="eager")
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    ids = np.array([[3, 17, 42, 9, 55, 21]], "int64")
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    ours = mistral_from_hf(hf)
+    ours.eval()
+    got = np.asarray(ours(Tensor(ids)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
