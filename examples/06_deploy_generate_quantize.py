@@ -46,6 +46,16 @@ def main():
                              decode_strategy="sampling", top_k=20,
                              top_p=0.9, temperature=0.8)
     print("sampled:", sampled.numpy()[0].tolist())
+    # serving-style decode: same tokens through the paged KV cache
+    # (fixed-size page pool, the block-cache design production decode
+    # uses — see ops/paged_attention.py)
+    paddle.seed(7)
+    paged = model.generate(prompt, max_new_tokens=6,
+                           decode_strategy="sampling", top_k=20,
+                           top_p=0.9, temperature=0.8,
+                           use_paged_cache=True)
+    assert paged.numpy()[0].tolist() == sampled.numpy()[0].tolist()
+    print("paged decode reproduces the dense cache token-for-token")
 
     # 2. PTQ an MLP classifier head -------------------------------------
     from paddle_tpu.quantization import (PTQ, QuantConfig,
