@@ -3581,6 +3581,117 @@ def _np_mnms(bx, sc):
             np.asarray([0], "int64"), np.asarray([1], "int32"))
 
 
+# ---- wave 10c: geometric message passing + CSR sparse attention ----
+
+def _geo_case(seed=240):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [(rs.randn(4, 3).astype("float32"),
+                 np.asarray([0, 1, 2, 0], "int64"),
+                 np.asarray([1, 2, 1, 0], "int64"))]
+    return gen
+
+
+def _np_scatter_edges(x, src, dst, reduce="sum", n=3):
+    out = np.zeros((n,) + x.shape[1:], "float32")
+    cnt = np.zeros((n,), "float32")
+    if reduce in ("max", "min"):
+        out[:] = -np.inf if reduce == "max" else np.inf
+    for s, d in zip(src, dst):
+        if reduce == "sum" or reduce == "mean":
+            out[d] += x[s]
+        elif reduce == "max":
+            out[d] = np.maximum(out[d], x[s])
+        else:
+            out[d] = np.minimum(out[d], x[s])
+        cnt[d] += 1
+    if reduce == "mean":
+        out /= np.maximum(cnt, 1)[:, None]
+    if reduce in ("max", "min"):
+        out[cnt == 0] = 0.0
+    return out
+
+
+def _np_segment(data, ids, reduce):
+    src = np.arange(len(ids))
+    return _np_scatter_edges(data, src, ids, reduce, n=int(ids.max()) + 1)
+
+
+def _seg_case(seed=241):
+    def gen():
+        rs = np.random.RandomState(seed)
+        return [(rs.randn(5, 2).astype("float32"),
+                 np.asarray([0, 0, 1, 2, 2], "int64"))]
+    return gen
+
+
+def _sparse_attn_case(seed=242):
+    def gen():
+        rs = np.random.RandomState(seed)
+        S = 4
+        q, k, v = (rs.randn(1, 2, S, 8).astype("float32")
+                   for _ in range(3))
+        offs = np.tile(np.cumsum([0] + list(range(1, S + 1)))
+                       .astype("int32"), (1, 2, 1))
+        cols = np.tile(np.concatenate(
+            [np.arange(i + 1) for i in range(S)]).astype("int32"),
+            (1, 2, 1))
+        # second case: irregular global-token pattern (row i sees {0, i})
+        cl = [[0] if i == 0 else [0, i] for i in range(S)]
+        offs2 = np.tile(np.cumsum([0] + [len(c) for c in cl])
+                        .astype("int32"), (1, 2, 1))
+        cols2 = np.tile(np.concatenate(cl).astype("int32"), (1, 2, 1))
+        return [(q, k, v, offs, cols),
+                (q, k, v, offs2, cols2)]
+    return gen
+
+
+def _np_sparse_attn_causal(q, k, v, offs, cols):
+    """Oracle derives the mask FROM the CSR inputs (an implementation
+    that ignores them and hardcodes causal must fail on other
+    patterns)."""
+    B, H, S, D = q.shape
+    mask = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        for h in range(H):
+            for i in range(S):
+                mask[b, h, i, cols[b, h, offs[b, h, i]:
+                                   offs[b, h, i + 1]]] = True
+    s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return (p @ v).astype("float32")
+
+
+_PARITY += [
+    P("geometric.send_u_recv", _geo_case(),
+      lambda x, s, d: _np_scatter_edges(x, s, d, "sum")),
+    P("geometric.send_ue_recv", lambda: [(
+        np.random.RandomState(247).randn(3, 2).astype("float32"),
+        np.random.RandomState(248).randn(4, 2).astype("float32"),
+        np.asarray([0, 1, 2, 1], "int64"),
+        np.asarray([1, 0, 1, 2], "int64"))],
+      lambda x, y, s, d: _np_scatter_edges(
+          (x[s] * y), np.arange(4), d, "sum"),
+      kwargs={"message_op": "mul"}, np_kwargs={}),
+    P("geometric.send_uv", _geo_case(243),
+      lambda x, s, d: (x[s] + x[d]).astype("float32"),
+      call=lambda x, s, d: __import__("paddle_tpu").geometric.send_uv(
+          x, x, s, d)),
+    P("geometric.segment_sum", _seg_case(),
+      lambda x, i: _np_segment(x, i, "sum")),
+    P("geometric.segment_mean", _seg_case(244),
+      lambda x, i: _np_segment(x, i, "mean")),
+    P("geometric.segment_max", _seg_case(245),
+      lambda x, i: _np_segment(x, i, "max")),
+    P("geometric.segment_min", _seg_case(246),
+      lambda x, i: _np_segment(x, i, "min")),
+    P("nn.functional.sparse_attention", _sparse_attn_case(),
+      _np_sparse_attn_causal, tol=1e-4),
+]
+
+
 _PARITY += [
     P("vision.ops.yolo_box",
       lambda: [(np.random.RandomState(231).randn(1, 7, 2, 2)

@@ -60,8 +60,8 @@ def test_registry_is_the_index():
     # round 4 wave 10: the entire indexed surface carries a real oracle
     # (sparse via densify-adapters, random via moment/frequency checks,
     # audio/vision via closed-form numpy references)
-    assert len(_PARITY_ROWS) >= 595, len(_PARITY_ROWS)
-    assert len(_GRAD_ROWS) >= 295, len(_GRAD_ROWS)
+    assert len(_PARITY_ROWS) >= 610, len(_PARITY_ROWS)
+    assert len(_GRAD_ROWS) >= 320, len(_GRAD_ROWS)
 
 
 @pytest.mark.parametrize("name", _PARITY_ROWS)
