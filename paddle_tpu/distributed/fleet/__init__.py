@@ -26,3 +26,12 @@ server_num = fleet.server_num
 barrier_worker = fleet.barrier_worker
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
+
+
+def __getattr__(name):
+    if name == "elastic":   # ref path: paddle.distributed.fleet.elastic
+        import importlib
+        mod = importlib.import_module(".elastic", __name__)
+        globals()["elastic"] = mod
+        return mod
+    raise AttributeError(name)

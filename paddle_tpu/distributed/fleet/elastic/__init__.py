@@ -253,3 +253,12 @@ class LauncherInterface:
         if codes and all(c == 0 for c in codes):
             return ElasticStatus.COMPLETED
         return None
+
+
+def __getattr__(name):
+    if name == "manager":   # ref import path: fleet.elastic.manager
+        import importlib
+        mod = importlib.import_module(".manager", __name__)
+        globals()["manager"] = mod
+        return mod
+    raise AttributeError(name)

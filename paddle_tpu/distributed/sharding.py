@@ -1,0 +1,28 @@
+"""paddle.distributed.sharding — ZeRO group-sharded user API (ref:
+python/paddle/distributed/sharding/group_sharded.py:
+group_sharded_parallel / save_group_sharded_model).
+
+The mechanics live in fleet.meta_parallel.sharding.group_sharded
+(stages as GSPMD sharding layouts); this module mirrors the reference's
+import path and adds the save helper."""
+from __future__ import annotations
+
+import os
+
+from .fleet.meta_parallel.sharding.group_sharded import (
+    GroupShardedStage2, GroupShardedStage3, group_sharded_parallel)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """ref: sharding.save_group_sharded_model — persist the WRAPPED
+    model's (gathered) weights + optimizer state under ``output``."""
+    from ..framework.io import save
+    os.makedirs(output, exist_ok=True)
+    target = model
+    # unwrap the sharded façade: state_dict on the wrapper already
+    # gathers full values, so saving it is topology-independent
+    save(target.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))
