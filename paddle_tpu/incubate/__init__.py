@@ -13,3 +13,15 @@ def softmax_mask_fuse_upper_triangle(x):
         import jax
         return jax.nn.softmax(jnp.where(mask, v, -1e30), axis=-1)
     return call_op(f, (x,), {}, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def __getattr__(name):
+    if name == "multiprocessing":
+        # ref path: paddle.incubate.multiprocessing (the tensor-IPC
+        # reductions lived in incubate before promotion) — alias of the
+        # promoted paddle.multiprocessing module
+        import importlib
+        mod = importlib.import_module("paddle_tpu.multiprocessing")
+        globals()["multiprocessing"] = mod
+        return mod
+    raise AttributeError(name)
