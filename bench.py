@@ -149,6 +149,35 @@ def _measure(preset, seq, batch, steps, warmup, on_tpu, devices):
     return res
 
 
+def _measure_decode(on_tpu):
+    """Decode tokens/sec through the paged KV cache (serving axis):
+    batch-8 greedy decode on a 125M-class decoder."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(0)
+    cfg = GPTConfig(num_layers=12, hidden_size=768, num_heads=12,
+                    vocab_size=50304, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    ids = Tensor(np.random.RandomState(0)
+                 .randint(0, 1000, (8, 32)).astype("int64"))
+    # warm once (compiles), then time
+    model.generate(ids, max_new_tokens=4, decode_strategy="greedy",
+                   use_paged_cache=True)
+    n_new = 16
+    t0 = time.perf_counter()
+    model.generate(ids, max_new_tokens=n_new, decode_strategy="greedy",
+                   use_paged_cache=True)
+    dt = time.perf_counter() - t0
+    return {"metric": "decode_tokens_per_sec",
+            "value": round(8 * n_new / dt, 2),
+            "batch": 8, "new_tokens": n_new,
+            "paged_cache": True}
+
+
 def run_bench():
     import jax
     if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -236,6 +265,13 @@ def run_bench():
                                                3, 1, on_tpu, devices)
             except Exception as e:  # noqa: BLE001
                 extras["gpt3-125M_error"] = str(e)[-200:]
+        # decode throughput (serving axis) — OPT-IN so the default
+        # driver run's budget is untouched
+        if left() > 120 and os.environ.get("BENCH_DECODE") == "1":
+            try:
+                extras["decode"] = _measure_decode(on_tpu)
+            except Exception as e:  # noqa: BLE001
+                extras["decode_error"] = str(e)[-200:]
         if extras:
             out["configs"] = extras
     print(json.dumps(out))
