@@ -175,6 +175,7 @@ def _measure_decode(on_tpu):
     return {"metric": "decode_tokens_per_sec",
             "value": round(8 * n_new / dt, 2),
             "batch": 8, "new_tokens": n_new,
+            "platform": "tpu" if on_tpu else "cpu",
             "paged_cache": True}
 
 

@@ -153,4 +153,4 @@ class ParallelCrossEntropy(Layer):
         input = sharding_constraint(input, *spec)
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index,
-                               soft_label=False)
+                               soft_label=False, _vocab_sharded=True)

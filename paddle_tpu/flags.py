@@ -104,6 +104,9 @@ define_flag("allocator_strategy", "auto_growth", "accepted for API parity")
 define_flag("fraction_of_gpu_memory_to_use", 0.92, "accepted for API parity")
 define_flag("use_pallas_attention", True,
             "route attention through the Pallas flash kernel on TPU")
+define_flag("use_pallas_softmax_ce", True,
+            "route hard-label last-axis cross_entropy through the "
+            "Pallas fused logsumexp+gather kernel on TPU")
 define_flag("use_pallas_paged_attention", True,
             "route paged KV-cache decode attention through the TPU "
             "Pallas kernel (jnp reference elsewhere)")

@@ -26,7 +26,11 @@ def _reduce(loss, reduction):
 def cross_entropy(input, label, weight=None, ignore_index: int = -100,
                   reduction: str = "mean", soft_label: bool = False,
                   axis: int = -1, use_softmax: bool = True,
-                  label_smoothing: float = 0.0, name=None):
+                  label_smoothing: float = 0.0, name=None,
+                  _vocab_sharded: bool = False):
+    """`_vocab_sharded` (internal): set by ParallelCrossEntropy when the
+    class axis is mp-sharded — the Pallas hot path must stay off so the
+    jnp logsumexp keeps its GSPMD psum-of-partials partitioning."""
     input = ensure_tensor(input)
     label = ensure_tensor(label)
     args = [input, label]
@@ -37,8 +41,15 @@ def cross_entropy(input, label, weight=None, ignore_index: int = -100,
     def f(logits, lab, *rest):
         ax = axis % logits.ndim
         n_classes = logits.shape[ax]
-        logp = (jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
-                if use_softmax else jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30)))
+
+        def _logp():
+            # computed lazily: the Pallas hot path below fuses the
+            # logsumexp and never needs the full log-softmax
+            return (jax.nn.log_softmax(logits.astype(jnp.float32),
+                                       axis=ax)
+                    if use_softmax
+                    else jnp.log(jnp.clip(logits.astype(jnp.float32),
+                                          1e-30)))
 
         is_soft = soft_label or label_smoothing > 0.0
         valid = None
@@ -56,7 +67,7 @@ def cross_entropy(input, label, weight=None, ignore_index: int = -100,
                                     axis=ax, dtype=jnp.float32)
             soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes
         if is_soft:
-            loss = -jnp.sum(soft * logp, axis=ax)
+            loss = -jnp.sum(soft * _logp(), axis=ax)
             if has_w:
                 # per-position weight = sum_c w_c * soft_c (reduces to w[label]
                 # for one-hot labels, generalizes for soft labels)
@@ -80,10 +91,22 @@ def cross_entropy(input, label, weight=None, ignore_index: int = -100,
             li = jnp.squeeze(li, axis=ax)
         li = li.astype(jnp.int32)
         valid = li != ignore_index
-        safe = jnp.clip(li, 0, n_classes - 1)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe, ax), axis=ax)
-        loss = -jnp.squeeze(picked, axis=ax)
+        # hard-label last-axis hot path: one fused Pallas pass computes
+        # logsumexp + picked logit (and its backward avoids a second
+        # softmax materialization) — the GPT-class LM-loss shape
+        from ...ops.pallas import softmax_ce as _psce
+        if (not has_w and use_softmax and ax == logits.ndim - 1
+                and not _vocab_sharded and _psce.available()):
+            from ...flags import get_flag
+            loss = _psce.softmax_ce_pallas(
+                logits.reshape(-1, n_classes), li.reshape(-1),
+                ignore_index, _psce.DEFAULT_BLOCK_N,
+                bool(get_flag("pallas_interpret"))).reshape(li.shape)
+        else:
+            safe = jnp.clip(li, 0, n_classes - 1)
+            picked = jnp.take_along_axis(
+                _logp(), jnp.expand_dims(safe, ax), axis=ax)
+            loss = -jnp.squeeze(picked, axis=ax)
         if has_w:
             w = rest[0].astype(jnp.float32)
             wsel = jnp.take(w, safe)

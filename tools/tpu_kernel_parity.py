@@ -214,6 +214,29 @@ def adamw():
     check("adamw.p", np_, pr, 1e-5)
 
 
+def softmax_ce():
+    from paddle_tpu.ops.pallas.softmax_ce import (softmax_ce_pallas,
+                                                  reference_softmax_ce)
+    import numpy as np
+    rs = np.random.RandomState(0)
+    for dtype, tol in ((jnp.float32, 2e-5), (jnp.bfloat16, 2e-2)):
+        x = jnp.asarray(rs.randn(256, 50304), dtype)
+        lab = jnp.asarray(rs.randint(0, 50304, 256), jnp.int32)
+        lab = lab.at[0].set(-100)
+        got = softmax_ce_pallas(x, lab)
+        want = reference_softmax_ce(x, lab)
+        check(f"softmax_ce.fwd.{dtype.__name__}", got, want, tol)
+
+        def lp(x):
+            return jnp.sum(softmax_ce_pallas(x, lab))
+
+        def lr(x):
+            return jnp.sum(reference_softmax_ce(x, lab))
+
+        check(f"softmax_ce.dx.{dtype.__name__}", jax.grad(lp)(x),
+              jax.grad(lr)(x), tol * 4)
+
+
 def paged():
     """Kernel vs jnp reference for paged decode attention (the kernel
     only exists on TPU — no interpret mode, so hardware is the first
@@ -249,6 +272,7 @@ def main():
         return 1
     run("rms_norm", rms_norm)
     run("layer_norm", layer_norm)
+    run("softmax_ce", softmax_ce)
     run("rope", rope)
     run("adamw", adamw)
     run("flash_attention", flash)
