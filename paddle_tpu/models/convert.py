@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["llama_from_hf", "bert_from_hf", "gpt2_from_hf",
-           "mistral_from_hf"]
+           "mistral_from_hf", "qwen2_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -92,6 +92,8 @@ def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
         max_position_embeddings=config.max_position_embeddings,
         rms_eps=config.rms_norm_eps,
         rope_theta=getattr(config, "rope_theta", 10000.0),
+        attention_bias=any(k.endswith("self_attn.q_proj.bias")
+                           for k in sd),
         tie_word_embeddings=tie,
     )
     model = LlamaForCausalLM(cfg)
@@ -110,6 +112,16 @@ def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
             sd[p + "self_attn.q_proj.weight"], cfg.num_heads).T)
         a.k_proj.weight._data = cast(_interleave_rope_rows(
             sd[p + "self_attn.k_proj.weight"], cfg.num_kv_heads).T)
+        if cfg.attention_bias:
+            # biases permute with the same per-head rope interleave as
+            # their projection's OUT rows
+            a.q_proj.bias._data = cast(_interleave_rope_rows(
+                sd[p + "self_attn.q_proj.bias"][:, None],
+                cfg.num_heads)[:, 0])
+            a.k_proj.bias._data = cast(_interleave_rope_rows(
+                sd[p + "self_attn.k_proj.bias"][:, None],
+                cfg.num_kv_heads)[:, 0])
+            a.v_proj.bias._data = cast(sd[p + "self_attn.v_proj.bias"])
         a.v_proj.weight._data = cast(sd[p + "self_attn.v_proj.weight"].T)
         a.o_proj.weight._data = cast(sd[p + "self_attn.o_proj.weight"].T)
         layer.mlp.gate_proj.weight._data = cast(
@@ -259,6 +271,42 @@ def gpt2_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
     return model
 
 
+def _install_window_warning(model, sw):
+    """Warn when a sequence exceeds a sliding-window checkpoint's
+    window: the dense-causal mask attends further back than the
+    reference would, so logits diverge past it."""
+    import warnings
+    orig_forward = model.forward
+
+    def forward(input_ids, *a, **k):
+        if input_ids.shape[-1] > sw:
+            warnings.warn(
+                f"sequence length {input_ids.shape[-1]} exceeds the "
+                f"checkpoint's sliding window {sw}; the dense-causal "
+                "mask attends further back than the reference — "
+                "logits diverge past the window")
+        return orig_forward(input_ids, *a, **k)
+
+    model.forward = forward   # instance attr: Layer.__call__ uses it
+
+
+def qwen2_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                  config=None, dtype: str = "float32"):
+    """Build a LlamaForCausalLM carrying a transformers Qwen2
+    checkpoint — the LLaMA stack plus q/k/v projection biases
+    (state-dict otherwise key-identical; the bias rows take the same
+    per-head rope interleave as their weights)."""
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    model = llama_from_hf(state_dict=state_dict, config=config,
+                          dtype=dtype)
+    sw = getattr(config, "sliding_window", None)
+    if getattr(config, "use_sliding_window", False) and sw:
+        _install_window_warning(model, sw)
+    return model
+
+
 def mistral_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
                     config=None, dtype: str = "float32"):
     """Build a LlamaForCausalLM carrying a transformers Mistral
@@ -278,17 +326,5 @@ def mistral_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
                           dtype=dtype)
     model._mistral_sliding_window = sw
     if sw is not None:
-        import warnings
-        orig_forward = model.forward
-
-        def forward(input_ids, *a, **k):
-            if input_ids.shape[-1] > sw:
-                warnings.warn(
-                    f"sequence length {input_ids.shape[-1]} exceeds "
-                    f"Mistral's sliding window {sw}; the dense-causal "
-                    "mask attends further back than the reference "
-                    "would — logits diverge past the window")
-            return orig_forward(input_ids, *a, **k)
-
-        model.forward = forward   # instance attr: Layer.__call__ uses it
+        _install_window_warning(model, sw)
     return model

@@ -53,6 +53,7 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_eps: float = 1e-5
     rope_theta: float = 10000.0
+    attention_bias: bool = False     # qkv biases (Qwen2-style)
     initializer_range: float = 0.02
     use_recompute: bool = False
     sequence_parallel: bool = False
@@ -124,15 +125,16 @@ class LlamaAttention(nn.Layer):
         self.head_dim = c.hidden_size // c.num_heads
         self.hidden_size = c.hidden_size
         init = ParamAttr(initializer=Normal(std=c.initializer_range))
+        qkv_bias = bool(getattr(c, "attention_bias", False))
         self.q_proj = ColumnParallelLinear(
             c.hidden_size, c.num_heads * self.head_dim, weight_attr=init,
-            has_bias=False, gather_output=False)
+            has_bias=qkv_bias, gather_output=False)
         self.k_proj = ColumnParallelLinear(
             c.hidden_size, self.num_kv * self.head_dim, weight_attr=init,
-            has_bias=False, gather_output=False)
+            has_bias=qkv_bias, gather_output=False)
         self.v_proj = ColumnParallelLinear(
             c.hidden_size, self.num_kv * self.head_dim, weight_attr=init,
-            has_bias=False, gather_output=False)
+            has_bias=qkv_bias, gather_output=False)
         self.o_proj = RowParallelLinear(
             c.num_heads * self.head_dim, c.hidden_size, weight_attr=init,
             has_bias=False, input_is_parallel=True)
