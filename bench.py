@@ -36,6 +36,28 @@ import numpy as np
 A100_PEAK_BF16 = 312e12
 A100_MFU_EST = 0.45
 
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO_ROOT)
+
+
+def _emit(out):
+    """Print the result line AND persist accelerator results immediately.
+
+    Artifact discipline (VERDICT r4 item 1): the axon tunnel has wedged
+    minutes after producing good numbers twice (NOTES_r4) — any TPU
+    result must hit the repo as a committed-able file the moment it
+    exists, not only at driver end-of-round capture.
+    """
+    print(json.dumps(out))
+    if out.get("platform") in ("cpu", "none", None):
+        return
+    from tools._artifact import round_tag, write_artifact
+    path = os.environ.get(
+        "BENCH_ARTIFACT",
+        os.path.join(_REPO_ROOT, f"BENCH_TPU_{round_tag(_REPO_ROOT)}.json"))
+    write_artifact(path, out)
+
+
 def _chip_peak_flops(device) -> float:
     """Peak bf16 FLOPs for the "mfu" diagnostic (never vs_baseline).
     Canonical table lives in paddle_tpu.device.chip_peak_flops."""
@@ -236,6 +258,11 @@ def run_bench():
         "pallas_attention": bool(
             __import__("paddle_tpu.flags", fromlist=["get_flag"])
             .get_flag("use_pallas_attention")),
+        # which ladder stage produced this line — a child-persisted
+        # artifact must say when it came from the degraded retry path
+        # even if the parent dies before enriching it with the error
+        # chain (code-review finding, r5)
+        "stage": os.environ.get("BENCH_STAGE", "tpu"),
     }
     if "mfu" in primary:
         out["mfu"] = primary["mfu"]
@@ -275,7 +302,7 @@ def run_bench():
                 extras["decode_error"] = str(e)[-200:]
         if extras:
             out["configs"] = extras
-    print(json.dumps(out))
+    _emit(out)
 
 
 def _run_child(extra_env, budget, mode=None):
@@ -349,7 +376,7 @@ def main():
         if line:
             out = json.loads(line)
             out["probe"] = probe
-            print(json.dumps(out))
+            _emit(out)
             return
         errors["tpu"] = err
         # retry smaller + cache off + NO custom Pallas kernels: a skewed
@@ -357,7 +384,7 @@ def main():
         # failure in the flash kernel must not zero the round — the XLA
         # attention path always compiles
         retry_env = {"BENCH_PRESET": "gpt3-350M", "BENCH_STEPS": "3",
-                     "BENCH_SEQ": "1024",
+                     "BENCH_SEQ": "1024", "BENCH_STAGE": "tpu-retry",
                      "FLAGS_use_pallas_attention": "0",
                      "FLAGS_use_pallas_rms_norm": "0",
                      "JAX_ENABLE_COMPILATION_CACHE": "false"}
@@ -366,7 +393,7 @@ def main():
             out = json.loads(line)
             out["probe"] = probe
             out["errors"] = errors
-            print(json.dumps(out))
+            _emit(out)
             return
         errors["tpu-retry"] = err
 
@@ -386,17 +413,19 @@ def main():
                                    + errors.get("tpu-retry", "")
                                    for t in transient):
             time.sleep(20)   # let the terminal-side fault clear
-            line, err = _run_child(retry_env,
-                                   int(min(t_tpu, remaining - 20)))
+            line, err = _run_child(
+                dict(retry_env, BENCH_STAGE="tpu-transient-retry"),
+                int(min(t_tpu, remaining - 20)))
             if line:
                 out = json.loads(line)
                 out["probe"] = probe
                 out["errors"] = errors
-                print(json.dumps(out))
+                _emit(out)
                 return
             errors["tpu-transient-retry"] = err
 
-    line, err = _run_child({"BENCH_FORCE_CPU": "1"}, 120)
+    line, err = _run_child({"BENCH_FORCE_CPU": "1", "BENCH_STAGE": "cpu"},
+                           120)
     if line:
         out = json.loads(line)
         if probe:

