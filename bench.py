@@ -52,9 +52,15 @@ def _emit(out):
     if out.get("platform") in ("cpu", "none", None):
         return
     from tools._artifact import round_tag, write_artifact
-    path = os.environ.get(
-        "BENCH_ARTIFACT",
-        os.path.join(_REPO_ROOT, f"BENCH_TPU_{round_tag(_REPO_ROOT)}.json"))
+    # degraded ladder stages persist to a stage-suffixed file so a
+    # retry can never overwrite the primary full-preset evidence (the
+    # wedge-after-good-numbers case writes tpu first, then hangs; the
+    # retry that follows must not clobber it)
+    stage = out.get("stage", "tpu")
+    name = (f"BENCH_TPU_{round_tag(_REPO_ROOT)}.json" if stage == "tpu"
+            else f"BENCH_TPU_{round_tag(_REPO_ROOT)}.{stage}.json")
+    path = os.environ.get("BENCH_ARTIFACT",
+                          os.path.join(_REPO_ROOT, name))
     write_artifact(path, out)
 
 

@@ -272,19 +272,44 @@ def gpt2_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
 
 
 def _install_window_warning(model, sw):
-    """Warn when a sequence exceeds a sliding-window checkpoint's
-    window: the dense-causal mask attends further back than the
-    reference would, so logits diverge past it."""
+    """Warn when the EFFECTIVE context exceeds a sliding-window
+    checkpoint's window: the dense-causal mask attends further back
+    than the reference would, so logits diverge past it.
+
+    Effective context counts the KV cache (ADVICE r4 medium): cached
+    decode passes one token per call, so the per-call prompt length
+    alone would never trip the guard even as total context grows far
+    past the window — the exact case it exists for.  Warns once per
+    generation stream (reset when the cache resets) to avoid
+    per-decode-step spam."""
     import warnings
     orig_forward = model.forward
+    state = {"warned": False}
+
+    def _past_len(past):
+        if past is None:
+            return 0
+        entry = past[0] if isinstance(past, (list, tuple)) and past else past
+        if isinstance(entry, (list, tuple)):          # dense (k, v) cache
+            return int(entry[0].shape[1])
+        lens = getattr(entry, "lengths_np", None)     # PagedLayerView
+        if lens is not None:
+            arr = lens()
+            return int(max(arr)) if len(arr) else 0
+        return 0
 
     def forward(input_ids, *a, **k):
-        if input_ids.shape[-1] > sw:
+        past = k.get("past", a[0] if a else None)
+        if past is None:
+            state["warned"] = False                   # new prompt stream
+        ctx = _past_len(past) + input_ids.shape[-1]
+        if ctx > sw and not state["warned"]:
+            state["warned"] = True
             warnings.warn(
-                f"sequence length {input_ids.shape[-1]} exceeds the "
-                f"checkpoint's sliding window {sw}; the dense-causal "
-                "mask attends further back than the reference — "
-                "logits diverge past the window")
+                f"effective context {ctx} exceeds the checkpoint's "
+                f"sliding window {sw}; the dense-causal mask attends "
+                "further back than the reference — logits diverge "
+                "past the window")
         return orig_forward(input_ids, *a, **k)
 
     model.forward = forward   # instance attr: Layer.__call__ uses it

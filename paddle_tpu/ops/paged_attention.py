@@ -59,6 +59,11 @@ def paged_attention_ref(q, k_pages, v_pages, lengths, page_indices):
     mask = pos < lengths[:, None, None]
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(scores, axis=-1)
+    # a zero-length sequence has NO attendable position: softmax over
+    # the all-masked row is uniform garbage — return zeros instead
+    # (ADVICE r4; the Pallas kernel path is only ever called with
+    # length >= 1 because decode appends before attending)
+    p = jnp.where(lengths[:, None, None] > 0, p, 0.0)
     return jnp.einsum("bht,bhtd->bhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
@@ -93,9 +98,15 @@ def paged_attention(q, k_pages, v_pages, lengths, page_indices,
             blk -= 1
 
         def fk(qa, ka, va, la, pa):
-            return _pa(qa * jnp.asarray(scale, qa.dtype), ka, va,
-                       la.astype(jnp.int32), pa.astype(jnp.int32),
-                       pages_per_compute_block=blk)
+            la = la.astype(jnp.int32)
+            out = _pa(qa * jnp.asarray(scale, qa.dtype), ka, va,
+                      la, pa.astype(jnp.int32),
+                      pages_per_compute_block=blk)
+            # match the reference's zero-length-row semantics (zeros,
+            # not kernel-defined garbage) for allocated-but-empty
+            # sequences reachable via PagedKVCache.attend
+            return jnp.where((la > 0)[:, None, None], out,
+                             jnp.zeros((), out.dtype))
         return call_op(fk, args, op_name="paged_attention")
 
     def fr(qa, ka, va, la, pa):

@@ -119,7 +119,11 @@ def _ce_bwd(ignore_index, block_n, interpret, res, g):
     g2d = g.astype(jnp.float32).reshape(-1, 1)
     dx = _bwd(logits2d, lab2d, lse, g2d, ignore_index, block_n,
               interpret)
-    return dx, jnp.zeros(lab2d.shape[0], lab2d.dtype)
+    # integer primals take float0 cotangents by JAX convention (ADVICE
+    # r4): an int32 zeros array only works under version-specific
+    # leniency of the pinned jax
+    import numpy as np
+    return dx, np.zeros(lab2d.shape[0], jax.dtypes.float0)
 
 
 softmax_ce_pallas.defvjp(_ce_fwd, _ce_bwd)

@@ -186,3 +186,35 @@ def test_qwen2_logits_match_transformers():
     da = (d[0] if isinstance(d, (tuple, list)) else d).numpy()
     pa = (p[0] if isinstance(p, (tuple, list)) else p).numpy()
     np.testing.assert_array_equal(np.asarray(da), np.asarray(pa))
+
+
+def test_sliding_window_warning_counts_cached_context():
+    """Cached decode passes one token per forward; the divergence
+    warning must trip on EFFECTIVE context (cache + new tokens), not
+    the per-call prompt length (ADVICE r4 medium), and must fire once
+    per stream rather than every decode step."""
+    import warnings
+    from paddle_tpu.models.convert import mistral_from_hf
+    torch.manual_seed(5)
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=8, attn_implementation="eager")
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    ours = mistral_from_hf(hf)
+    ours.eval()
+    ids = np.array([[3, 17, 42, 9, 55, 21]], "int64")  # 6 <= window 8
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out, past = ours(Tensor(ids), use_cache=True)   # no warning yet
+    # decode grows context to 7, 8 (ok), then 9 (past the window)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for tok in (4, 5, 6, 7):
+            _, past = ours(Tensor(np.array([[tok]], "int64")),
+                           past=past, use_cache=True)
+    msgs = [str(w.message) for w in rec if "sliding window" in
+            str(w.message)]
+    assert len(msgs) == 1, msgs          # fired once, not per step
+    assert "effective context 9" in msgs[0], msgs

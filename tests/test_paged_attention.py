@@ -167,3 +167,77 @@ def test_unsupported_model_raises_clearly():
     with pytest.raises(ValueError, match="does not support"):
         generate(Fake(), Tensor(np.array([[1, 2]], "int64")),
                  max_new_tokens=2, use_paged_cache=True)
+
+
+def test_zero_length_sequence_returns_zeros():
+    """A fully-masked row (length 0) must yield zeros, not the uniform
+    average of V that a softmax over all-finfo.min scores produces
+    (ADVICE r4)."""
+    rs = np.random.RandomState(1)
+    import jax.numpy as jnp
+    nkv, nh, hd, ps, pages = 2, 4, 8, 4, 8
+    q = jnp.asarray(rs.randn(3, nh, hd).astype("float32"))
+    kp = jnp.asarray(rs.randn(nkv, pages, ps, hd).astype("float32"))
+    vp = jnp.asarray(rs.randn(nkv, pages, ps, hd).astype("float32"))
+    lengths = jnp.asarray([0, 5, 0], "int32")
+    tables = jnp.asarray(rs.permutation(pages)[:6].reshape(3, 2), "int32")
+    out = np.asarray(paged_attention_ref(q, kp, vp, lengths, tables))
+    assert np.all(out[0] == 0) and np.all(out[2] == 0)
+    assert np.any(out[1] != 0)
+
+
+def test_tpu_kernel_route_contract(monkeypatch):
+    """The TPU kernel route (q-scale folding, compute-block clamp, i32
+    casts) is CI-verified against the reference through a shim with the
+    jax kernel's exact call contract: no internal softmax scaling, and
+    pages_per_compute_block must divide pages_per_seq (ADVICE r4 — the
+    real kernel has no interpret mode, so the route would otherwise
+    ship untested; on-hardware equivalence is tools/tpu_kernel_parity).
+    """
+    import jax.numpy as jnp
+    import paddle_tpu.ops.paged_attention as mod
+    from jax.experimental.pallas.ops.tpu import paged_attention as kmod
+
+    seen = {}
+
+    def shim(q, k_pages, v_pages, lengths, page_indices, *,
+             pages_per_compute_block, **kw):
+        # kernel contract checks the wrapper must honor
+        assert page_indices.shape[1] % pages_per_compute_block == 0
+        assert lengths.dtype == jnp.int32
+        assert page_indices.dtype == jnp.int32
+        seen["blk"] = pages_per_compute_block
+        # kernel semantics: softmax(q @ k) @ v with NO internal scale —
+        # emulate by cancelling the reference's 1/sqrt(hd); a real
+        # kernel returns GARBAGE for length-0 rows (the wrapper must
+        # mask it), so poison those rows explicitly
+        hd = q.shape[-1]
+        out = paged_attention_ref(q * np.sqrt(float(hd)), k_pages,
+                                  v_pages, lengths, page_indices)
+        return jnp.where((lengths == 0)[:, None, None],
+                         jnp.asarray(7.25, out.dtype), out)
+
+    monkeypatch.setattr(kmod, "paged_attention", shim)
+    monkeypatch.setattr(mod, "_use_tpu_kernel", lambda: True)
+
+    rs = np.random.RandomState(2)
+    nkv, nh, hd, ps, pages, ppseq = 2, 8, 16, 4, 16, 3  # ppseq prime
+    q = Tensor(rs.randn(4, nh, hd).astype("float32"))
+    kp = Tensor(rs.randn(nkv, pages, ps, hd).astype("float32"))
+    vp = Tensor(rs.randn(nkv, pages, ps, hd).astype("float32"))
+    lengths = Tensor(np.asarray([2, 7, 0, 9], "int64"))       # i64 in;
+    # row 2 is allocated-but-empty: the wrapper must zero it even
+    # though the raw kernel (shim) returns garbage for it
+    tables = Tensor(rs.permutation(pages)[:4 * ppseq]
+                    .reshape(4, ppseq).astype("int64"))
+    with paddle.no_grad():
+        got = paged_attention(q, kp, vp, lengths, tables,
+                              pages_per_compute_block=4).numpy()
+    assert seen["blk"] in (1, 3)  # clamped to a divisor of ppseq=3
+    import jax.numpy as jnp2
+    want = np.asarray(paged_attention_ref(
+        jnp2.asarray(q.numpy()), jnp2.asarray(kp.numpy()),
+        jnp2.asarray(vp.numpy()), jnp2.asarray(lengths.numpy(), "int32"),
+        jnp2.asarray(tables.numpy(), "int32")))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    assert np.all(got[2] == 0)
