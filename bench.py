@@ -57,10 +57,15 @@ def _emit(out):
     # wedge-after-good-numbers case writes tpu first, then hangs; the
     # retry that follows must not clobber it)
     stage = out.get("stage", "tpu")
-    name = (f"BENCH_TPU_{round_tag(_REPO_ROOT)}.json" if stage == "tpu"
-            else f"BENCH_TPU_{round_tag(_REPO_ROOT)}.{stage}.json")
-    path = os.environ.get("BENCH_ARTIFACT",
-                          os.path.join(_REPO_ROOT, name))
+    path = os.environ.get(
+        "BENCH_ARTIFACT",
+        os.path.join(_REPO_ROOT, f"BENCH_TPU_{round_tag(_REPO_ROOT)}.json"))
+    if stage != "tpu":
+        # the suffix applies to explicit BENCH_ARTIFACT overrides too —
+        # a degraded retry must never clobber the primary evidence,
+        # whichever path the driver chose
+        root, ext = os.path.splitext(path)
+        path = f"{root}.{stage}{ext}"
     write_artifact(path, out)
 
 

@@ -212,11 +212,21 @@ class GradScaler:
         optimizer = getattr(optimizer, "_inner_opt", optimizer)
         inv = 1.0 / self._scale
         found = jnp.asarray(False)
+        grads = []
         for p in optimizer._parameter_list:
             if p._grad is None:
                 continue
             g = p._grad._data.astype(jnp.float32) * inv
             found = found | jnp.any(~jnp.isfinite(g))
+            grads.append((p, g))
+        for p, g in grads:
+            # ZERO every grad on a non-finite step (the reference's
+            # static-AMP check_finite_and_unscale semantics): step()
+            # select-restores pre-existing state, but state CREATED on
+            # this step (bootstrap accumulators) has no old value to
+            # restore — with zeroed grads it's created at its clean
+            # init instead of inheriting nan moments
+            g = jnp.where(found, jnp.zeros((), g.dtype), g)
             p._grad._data = g.astype(p._grad._data.dtype) \
                 if p._grad._data.dtype != jnp.float32 else g
         self._found_inf = found

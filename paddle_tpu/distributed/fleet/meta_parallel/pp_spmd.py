@@ -58,7 +58,8 @@ def pipeline_spmd_forward(pre_fn: Callable, block_fn: Callable,
                           micro_inputs, micro_labels,
                           axis_name: str = "pp",
                           remat_blocks: bool = True,
-                          rng_key=None, n_chunks: int = 1):
+                          rng_key=None, n_chunks: int = 1,
+                          cond_io: bool = False):
     """Pipelined forward INSIDE shard_map scope → mean loss on every rank.
 
     - pre_fn(rep_params, x) -> activation          (stage 0)
@@ -147,12 +148,26 @@ def pipeline_spmd_forward(pre_fn: Callable, block_fn: Callable,
             tick_key = jax.random.fold_in(stage_key, t)
             default_generator.set_state(jax.random.fold_in(tick_key, v))
 
+            # inject/drain dispatch: with auto (GSPMD) axes live inside
+            # the ring, pre_fn/post_fn may lower to collectives over
+            # mp/dp; a device-varying lax.cond would have only some
+            # devices execute them, deadlocking the rendezvous
+            # (observed on the 8-dev CPU mesh: half the mesh waiting in
+            # ppermute op 1, half in op 18) — so those meshes run both
+            # UNCONDITIONALLY and where-select.  Pure-pp(+manual) meshes
+            # keep the cond so inner stages truly skip the pre/post
+            # compute at runtime (post_fn is lm_head+CE — P ranks times
+            # every tick would be a real regression, not noise).
             def inject(_):
                 return pre_fn(rep_params, jax.lax.dynamic_index_in_dim(
                     micro_inputs, inj_idx, axis=0, keepdims=False)
                 ).astype(act_dtype)
 
-            h0_in = jax.lax.cond(idx == 0, inject, lambda _: recv[0], None)
+            if cond_io:
+                h0_in = jax.lax.cond(idx == 0, inject,
+                                     lambda _: recv[0], None)
+            else:
+                h0_in = jnp.where(idx == 0, inject(None), recv[0])
             h_in = recv.at[0].set(h0_in)
 
             # all V chunks compute in one vmapped call (chunk k hosts
@@ -171,9 +186,12 @@ def pipeline_spmd_forward(pre_fn: Callable, block_fn: Callable,
                 return post_fn(rep_params, h_out[v - 1],
                                labels_t).astype(jnp.float32)
 
-            mb_loss = jax.lax.cond(valid, drain,
-                                   lambda _: jnp.zeros((), jnp.float32),
-                                   None)
+            if cond_io:
+                mb_loss = jax.lax.cond(
+                    valid, drain, lambda _: jnp.zeros((), jnp.float32),
+                    None)
+            else:
+                mb_loss = jnp.where(valid, drain(None), 0.0)
             loss_sum = loss_sum + mb_loss
             nloss = nloss + jnp.where(valid, 1.0, 0.0)
             permuted = jax.lax.ppermute(h_out, axis_name, perm)
@@ -209,7 +227,7 @@ class PipelineSpmdStep:
                  block_param_stacks: List[Tensor], optimizer, mesh: Mesh,
                  n_micro: int, axis_name: str = "pp", dp_axes=("dp",),
                  remat_blocks: bool = True, sync_fn: Optional[Callable] = None,
-                 n_chunks: int = 1):
+                 n_chunks: int = 1, scaler=None, autocast=None):
         self.pre_fn, self.block_fn, self.post_fn = pre_fn, block_fn, post_fn
         self.rep_params = rep_params
         self.block_stacks = block_param_stacks
@@ -223,34 +241,74 @@ class PipelineSpmdStep:
         self.dp_axes = tuple(a for a in dp_axes if mesh.shape.get(a, 1) > 1)
         self.remat = remat_blocks
         self.n_chunks = int(n_chunks)
+        # amp.GradScaler: dynamic loss scaling threaded through the step
+        # state, exactly as in the jit TrainStep engine
+        self.scaler = scaler if (scaler is not None
+                                 and scaler.is_enable()) else None
+        # zero-arg context-manager factory (e.g. functools.partial(
+        # amp.auto_cast, level="O2", dtype="bfloat16")) wrapped around
+        # the traced forward so AMP casting hooks are live in-trace
+        self.autocast = autocast
         self._jitted = None
 
     def _loss_fn(self, rep_v, blk_v, x_micro, y_micro, rng):
         axis = self.axis
-        dp = self.dp_axes
         v = self.n_chunks
 
-        def spmd(rep_v, blk_v, xm, ym, key):
-            loss = pipeline_spmd_forward(
+        # Half-precision REPLICATED params cross the shard_map boundary
+        # as f32: the transpose of a replicated-in is a psum in the
+        # param dtype, and the jax-emitted bf16 reduction computation
+        # (which carries a sharding custom-call under a mesh context)
+        # crashes XLA CPU's AllReducePromotion pass when cloned.  f32
+        # psums are never promoted; the down/up converts are exact for
+        # bf16 and fuse into neighbours on TPU.
+        rep_dts = [a.dtype for a in rep_v]
+        rep_in = [a.astype(jnp.float32)
+                  if a.dtype in (jnp.bfloat16, jnp.float16) else a
+                  for a in rep_v]
+
+        # inject/drain dispatch mode: batch axes (dp/sharding) are safe
+        # under lax.cond — every member of a dp group shares its pp
+        # index, so GSPMD's grouped all-reduces inside a branch are
+        # taken by whole groups (validated by the dp x pp test battery)
+        # and inner stages truly skip pre/post compute.  Tensor-ish
+        # axes (mp/sep/cp) insert RESHARDING collective-permutes whose
+        # rendezvous spans the full mesh; inside a device-varying
+        # branch those deadlock, so such meshes use the unconditional
+        # where-select form.
+        cond_io = not any(self.mesh.shape.get(a, 1) > 1
+                          for a in self.mesh.axis_names
+                          if a not in (axis, "dp", "sharding"))
+
+        def spmd(rep_f, blk_v, xm, ym, key):
+            rep_c = [a.astype(dt) for a, dt in zip(rep_f, rep_dts)]
+            return pipeline_spmd_forward(
                 self.pre_fn, self.block_fn, self.post_fn,
-                rep_v, blk_v, xm, ym, axis_name=axis,
-                remat_blocks=self.remat, rng_key=key, n_chunks=v)
-            if dp:
-                loss = jax.lax.pmean(loss, dp)
-            return loss
+                rep_c, blk_v, xm, ym, axis_name=axis,
+                remat_blocks=self.remat, rng_key=key, n_chunks=v,
+                cond_io=cond_io)
 
         rep = P()
         blk_spec = jax.tree.map(lambda _: P(axis), blk_v)
         rep_spec = jax.tree.map(lambda _: rep, rep_v)
-        data_spec = P(None, dp if dp else None)
+        # MANUAL over pp only: every other live axis (dp/sharding/mp/
+        # sep/cp) stays automatic, so GSPMD partitions each stage's
+        # compute over them — the batch rides the jit-level dp sharding
+        # and mp-annotated weights keep their layout INSIDE the ring
+        # (tp×pp×dp composes in one program instead of replicating mp).
+        # The microbatch mean is a global mean under auto-dp, which
+        # matches the single-process oracle exactly.
         f = jax.shard_map(
             spmd, mesh=self.mesh,
-            in_specs=(rep_spec, blk_spec, data_spec, data_spec, rep),
-            out_specs=rep, check_vma=False)
-        return f(rep_v, blk_v, x_micro, y_micro, rng)
+            in_specs=(rep_spec, blk_spec, rep, rep, rep),
+            out_specs=rep, axis_names=frozenset({axis}),
+            check_vma=False)
+        return f(rep_in, blk_v, x_micro, y_micro, rng)
 
     def _make_step(self):
         opt = self.optimizer
+        scaler = self.scaler
+        ctx = self.autocast
         all_params = self.rep_params + self.block_stacks
         n_rep = len(self.rep_params)
 
@@ -259,9 +317,26 @@ class PipelineSpmdStep:
             rep_v = vals[:n_rep]
             blk_v = vals[n_rep:]
             step_key, next_rng = jax.random.split(state["rng"])
-            loss, grads = jax.value_and_grad(
-                self._loss_fn, argnums=(0, 1))(rep_v, blk_v,
-                                               x_micro, y_micro, step_key)
+            if scaler is not None:
+                scaler._set_state_arrays(state["s"])
+                scaler._found_inf = jnp.asarray(False)
+                scaler._unscaled = False
+            scale = scaler._scale if scaler is not None else None
+
+            def fwd(rep_v, blk_v, xm, ym, key):
+                if ctx is not None:
+                    with ctx():
+                        loss = self._loss_fn(rep_v, blk_v, xm, ym, key)
+                else:
+                    loss = self._loss_fn(rep_v, blk_v, xm, ym, key)
+                if scale is not None:
+                    return loss * scale.astype(loss.dtype), loss
+                return loss, loss
+
+            (_, loss), grads = jax.value_and_grad(
+                fwd, argnums=(0, 1), has_aux=True)(rep_v, blk_v,
+                                                   x_micro, y_micro,
+                                                   step_key)
             flat_grads = list(grads[0]) + list(grads[1])
             opt._accumulators = defaultdict(
                 dict, {n: dict(v) for n, v in state["o"]["acc"].items()})
@@ -272,7 +347,13 @@ class PipelineSpmdStep:
                     p._data = v
                     p._grad = Tensor(g)
                     p._grad_node = None
-                opt.step()
+                if scaler is not None:
+                    # unscale + non-finite check + data-flow skip +
+                    # dynamic scale update, same semantics as eager
+                    scaler.step(opt)
+                    scaler.update()
+                else:
+                    opt.step()
                 new_vals = [p._data for p in all_params]
                 new_opt = {"acc": {n: dict(s) for n, s in
                                    opt._accumulators.items()},
@@ -281,23 +362,40 @@ class PipelineSpmdStep:
                 opt._lr_override = None
                 for p in all_params:
                     p._grad = None
-            return {"p": new_vals, "o": new_opt, "rng": next_rng}, loss
+            new_state = {"p": new_vals, "o": new_opt, "rng": next_rng}
+            if scaler is not None:
+                new_state["s"] = scaler._get_state_arrays()
+            return new_state, loss
 
         return step
 
     def _shardings(self, state):
+        from ....distributed.shard_utils import (largest_dim_spec,
+                                                 param_spec,
+                                                 resolve_shard_state_axis)
         rep = NamedSharding(self.mesh, P())
         n_rep = len(self.rep_params)
         pp = NamedSharding(self.mesh, P(self.axis))
+        all_params = self.rep_params + self.block_stacks
 
         def p_shard(i):
+            # annotated params (mp-sharded stacks/embeddings) keep their
+            # full spec; un-annotated stacks fall back to pp-leading
+            spec = param_spec(all_params[i])
+            if spec is not None:
+                return NamedSharding(self.mesh, P(*spec))
             return pp if i >= n_rep else rep
 
         p_sh = [p_shard(i) for i in range(len(state["p"]))]
-        all_params = self.rep_params + self.block_stacks
         by_key = {}
         for i, p in enumerate(all_params):
             by_key[p.name if p.name else f"param_{i}"] = (p, p_shard(i))
+
+        # ZeRO over the data axis: replicated params' optimizer states
+        # largest-dim shard over the configured axis (the
+        # DygraphShardingOptimizer split)
+        shard_axis, degree = resolve_shard_state_axis(self.optimizer,
+                                                      self.mesh)
 
         def acc_sharding(k, arr):
             ent = by_key.get(k)
@@ -305,14 +403,23 @@ class PipelineSpmdStep:
             # states stay replicated
             if ent is not None and hasattr(arr, "shape") and \
                     tuple(arr.shape) == tuple(ent[0]._data.shape):
-                return ent[1]
+                sh = ent[1]
+                if degree > 1 and arr.ndim and \
+                        all(s is None for s in (sh.spec or ())):
+                    s2 = largest_dim_spec(arr.shape, shard_axis, degree)
+                    if s2 is not None:
+                        return NamedSharding(self.mesh, P(*s2))
+                return sh
             return rep
 
         o_sh = {"acc": {n: {k: acc_sharding(k, v) for k, v in s.items()}
                         for n, s in state["o"]["acc"].items()},
                 "master": {k: acc_sharding(k, v)
                            for k, v in state["o"]["master"].items()}}
-        return {"p": p_sh, "o": o_sh, "rng": rep}
+        out = {"p": p_sh, "o": o_sh, "rng": rep}
+        if self.scaler is not None:
+            out["s"] = {"scale": rep, "incr": rep, "decr": rep}
+        return out
 
     def __call__(self, inputs, labels):
         x = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
@@ -331,12 +438,19 @@ class PipelineSpmdStep:
                                self.optimizer._accumulators.items()},
                        "master": dict(self.optimizer._master_weights)},
                  "rng": default_generator.get_state()}
+        if self.scaler is not None:
+            state["s"] = self.scaler._get_state_arrays()
         key = tuple(sorted(state["o"]["acc"]))
         if self._jitted is None or self._jitted[0] != key:
             step = self._make_step()
             sh = self._shardings(state)
             rep = NamedSharding(self.mesh, P())
-            kw = {"in_shardings": (sh, rep, rep, rep),
+            # microbatches shard over the data axes at the jit level;
+            # inside the (pp-manual) ring they stay auto-dp-sharded
+            dsh = NamedSharding(
+                self.mesh, P(None, self.dp_axes if self.dp_axes
+                             else None))
+            kw = {"in_shardings": (sh, rep, dsh, dsh),
                   "donate_argnums": (0,)}
             if state["o"]["acc"]:
                 kw["out_shardings"] = (sh, rep)
@@ -350,6 +464,8 @@ class PipelineSpmdStep:
         self.optimizer._accumulators = defaultdict(
             dict, {n: dict(v) for n, v in new_state["o"]["acc"].items()})
         self.optimizer._master_weights = dict(new_state["o"]["master"])
+        if self.scaler is not None:
+            self.scaler._set_state_arrays(new_state["s"])
         # advance the host generator past this step's stream; decommit
         # from the step's mesh so later eager work isn't mesh-pinned
         default_generator.set_state(
@@ -374,7 +490,8 @@ def make_transformer_pipeline_step(blocks, rep_tensors, pre_fn, post_fn,
                                    dp_axes=("dp", "sharding"),
                                    remat_blocks: bool = True,
                                    n_chunks: int = 1,
-                                   stack_prefix: str = "pp_stack"):
+                                   stack_prefix: str = "pp_stack",
+                                   scaler=None, autocast=None):
     """Shared builder for model-family pipeline adapters (GPT/LLaMA/...).
 
     Owns the parts every adapter must agree on: the interleaved (VPP)
@@ -407,10 +524,17 @@ def make_transformer_pipeline_step(blocks, rep_tensors, pre_fn, post_fn,
 
     stacks = stack_params([[p._data for p in blocks[i].parameters()]
                            for i in order])
+    from ....distributed.shard_utils import annotate_param, param_spec
     stack_tensors = []
     for i, arr in enumerate(stacks):
         t = Tensor(arr, stop_gradient=False)
         t.name = f"{stack_prefix}_{i}"
+        # stacking must not lose the template's mp annotations: the
+        # stacked layout is pp on the leading (layer) axis plus the
+        # block param's own per-dim spec (auto axes inside the ring)
+        tspec = param_spec(t_params[i])
+        annotate_param(t, (axis_name,) + (tuple(tspec) if tspec
+                                          else (None,) * (arr.ndim - 1)))
         stack_tensors.append(t)
     for i, p in enumerate(rep_tensors):
         if not p.name:
@@ -442,7 +566,8 @@ def make_transformer_pipeline_step(blocks, rep_tensors, pre_fn, post_fn,
                             stack_tensors, opt, mesh, n_micro,
                             axis_name=axis_name, dp_axes=dp_axes,
                             remat_blocks=remat_blocks,
-                            sync_fn=sync_to_model, n_chunks=n_chunks)
+                            sync_fn=sync_to_model, n_chunks=n_chunks,
+                            scaler=scaler, autocast=autocast)
 
 
 # ---------------------------------------------------------------------------
@@ -451,8 +576,8 @@ def make_transformer_pipeline_step(blocks, rep_tensors, pre_fn, post_fn,
 
 def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
                       axis_name: str = "pp", dp_axes=("dp", "sharding"),
-                      remat_blocks: bool = True,
-                      n_chunks: int = 1) -> PipelineSpmdStep:
+                      remat_blocks: bool = True, n_chunks: int = 1,
+                      scaler=None, autocast=None) -> PipelineSpmdStep:
     """Build a PipelineSpmdStep from a GPTForPretraining model.
 
     Stage split: pre = embeddings (stage 0), blocks = the L GPTBlocks
@@ -492,4 +617,4 @@ def gpt_pipeline_step(model, optimizer, mesh: Mesh, n_micro: int,
         gpt.layers, rep_tensors, pre_fn, post_fn, optimizer, mesh,
         n_micro, axis_name=axis_name, dp_axes=dp_axes,
         remat_blocks=remat_blocks, n_chunks=n_chunks,
-        stack_prefix="pp_block_stack")
+        stack_prefix="pp_block_stack", scaler=scaler, autocast=autocast)

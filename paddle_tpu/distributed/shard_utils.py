@@ -47,6 +47,21 @@ def _mesh_axes_active(mesh: Mesh, spec) -> bool:
     return False
 
 
+def resolve_shard_state_axis(optimizer, mesh: Mesh):
+    """(axis, degree) for ZeRO optimizer-state sharding — the single
+    resolution rule shared by the jit TrainStep engine and the pipeline
+    step: the optimizer's ``_shard_state_axis`` marker, with 'sharding'
+    falling back to 'dp' when only dp ranks back the sharding group
+    (the reference's sharding-overlapping-dp configuration)."""
+    axis = getattr(optimizer, "_shard_state_axis", None) \
+        if optimizer is not None else None
+    degree = mesh.shape.get(axis, 1) if (axis and mesh is not None) else 1
+    if degree <= 1 and axis == "sharding" and mesh is not None:
+        axis = "dp"
+        degree = mesh.shape.get("dp", 1)
+    return axis, degree
+
+
 def largest_dim_spec(shape, axis: str, degree: int):
     """Largest-divisible-dim sharding rule — the single source of truth
     for ZeRO-style layouts (used by both stage-3 param sharding and the
@@ -94,7 +109,15 @@ def _constrain(v, sh):
       different device sets (e.g. the step engine pins the RNG key to
       device 0, committing everything derived from it)."""
     if _is_staged(v):
-        return jax.lax.with_sharding_constraint(v, sh)
+        try:
+            return jax.lax.with_sharding_constraint(v, sh)
+        except ValueError:
+            # partial-manual shard_map region (e.g. the pp ring with
+            # auto mp/dp axes): a NamedSharding on the global mesh is
+            # rejected because the context mesh marks the manual axes;
+            # a bare PartitionSpec resolves against the context mesh
+            # and constrains the auto axes only
+            return jax.lax.with_sharding_constraint(v, sh.spec)
     return v
 
 

@@ -111,12 +111,9 @@ class TrainStep:
         # optimizer accumulators follow their parameter's layout; with a
         # sharding axis configured (ZeRO stage 1/2) un-annotated states get
         # largest-dim sharded over it (the DygraphShardingOptimizer split)
-        shard_axis = getattr(self.optimizer, "_shard_state_axis", None) \
-            if self.optimizer is not None else None
-        degree = self.mesh.shape.get(shard_axis, 1) if shard_axis else 1
-        if degree <= 1 and shard_axis == "sharding":
-            # strategy declared sharding via 'dp' axis only
-            shard_axis, degree = "dp", self.mesh.shape.get("dp", 1)
+        from ..distributed.shard_utils import resolve_shard_state_axis
+        shard_axis, degree = resolve_shard_state_axis(self.optimizer,
+                                                      self.mesh)
         key_of = {}
         for i, p in enumerate(self.params):
             key_of[p.name if p.name else f"param_{i}"] = p

