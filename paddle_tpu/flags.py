@@ -126,6 +126,17 @@ define_flag("sot_relax_guards", False,
             "outputs.  UNSOUND if a host-read value steers python "
             "control flow near a threshold the demonstrations did not "
             "cross — enable only when host reads are logging-only")
+define_flag("while_capture_max_iters", 100000,
+            "fuel cap for CONSTRUCTION-TIME evaluation of a captured "
+            "static.nn.while_loop (placeholder values may never satisfy "
+            "the exit condition); the recorded program always runs the "
+            "true unbounded lax.while_loop")
+define_flag("sot_error_on_fallback", False,
+            "SOT-lite: raise instead of silently running eager when a "
+            "signature stops compiling (specialization cap, oversized "
+            "guard, RNG during recording).  Use to make every silent "
+            "de-optimization loud in perf-critical runs; "
+            "paddle.jit.sot.stats() shows the same information passively")
 define_flag("pallas_interpret", False,
             "run Pallas kernels in interpreter mode (CPU tests)")
 define_flag("pallas_autotune", False,

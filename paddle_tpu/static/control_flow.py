@@ -1,0 +1,319 @@
+"""Static control flow: cond / while_loop / case / switch_case.
+
+TPU-native re-design of ref: python/paddle/static/nn/control_flow.py
+(ConditionalBlock + While ops interpreted by the executor).  Here each
+construct is ONE dispatched op whose body embeds ``jax.lax.cond`` /
+``jax.lax.while_loop`` / ``jax.lax.switch``: a data-dependent branch or
+trip count compiles into a single XLA program instead of SOT-lite
+per-path specializations (VERDICT r4 item 5).
+
+Mode split mirrors the reference exactly:
+
+- **dygraph (eager, concrete predicate)**: plain Python ``if`` /
+  ``while`` — the reference's dygraph fallback.  Differentiable through
+  the tape (the taken branch / unrolled iterations are ordinary ops)
+  and free of XLA's static-shape rules.
+- **static capture or traced predicate** (inside ``jax.jit`` — a
+  TrainStep, ``to_static``, SOT-lite segment, Program build): the
+  callables are traced ONCE through the op-capture chokepoint
+  (``capture_ops``) into pure replay functions, and the whole construct
+  dispatches through ``call_op`` so autograd/AMP/profiler hooks and
+  Program recording all apply.  ``cond``/``case``/``switch_case`` are
+  reverse-differentiable (jax differentiates ``lax.cond``/``switch``);
+  ``while_loop`` is forward-only under trace, as on any XLA backend
+  (reverse through a dynamic trip count needs the tape's eager loop —
+  use the dygraph path for that).
+
+XLA constraints surfaced loudly rather than hidden: branch outputs must
+match in structure/shape/dtype, and a traced ``while_loop`` body must
+keep loop-var shapes/dtypes invariant.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op
+from ..core.tensor import Tensor
+from ..tensor._helpers import ensure_tensor
+from .capture import Program, capture_ops
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_traced(t: Tensor) -> bool:
+    return isinstance(t._data, jax.core.Tracer)
+
+
+def _flatten_out(out):
+    """Normalize a branch's return into (list_of_tensors, rebuild)."""
+    if out is None:
+        return [], lambda vals: None
+    if isinstance(out, Tensor):
+        return [out], lambda vals: vals[0]
+    if isinstance(out, (list, tuple)):
+        seq = [ensure_tensor(o) for o in out]
+        ctor = type(out) if isinstance(out, tuple) else list
+        return list(seq), lambda vals: ctor(vals)
+    return [ensure_tensor(out)], lambda vals: vals[0]
+
+
+def _trace_callable(fn: Callable, args: Sequence[Tensor], what: str):
+    """Run ``fn(*args)`` once under op capture; return
+    (outs, rebuild, pure, externals) where
+    ``pure(arg_arrays, ext_arrays) -> tuple of out arrays``."""
+    sub = Program()
+    for i, t in enumerate(args):
+        sub.add_placeholder(f"__cf_arg{i}", t)
+    with capture_ops(sub):
+        raw = fn(*args)
+    outs, rebuild = _flatten_out(raw)
+    names = [f"__cf_arg{i}" for i in range(len(args))]
+    pure, externals = sub.build_replay(names, outs)
+    return outs, rebuild, pure, externals
+
+
+def _check_same_structure(a: List[Tensor], b: List[Tensor], what: str):
+    if len(a) != len(b):
+        raise ValueError(
+            f"{what}: branches returned different numbers of outputs "
+            f"({len(a)} vs {len(b)})")
+    for i, (x, y) in enumerate(zip(a, b)):
+        if tuple(x.shape) != tuple(y.shape) or x.dtype != y.dtype:
+            raise ValueError(
+                f"{what}: output {i} mismatch — {tuple(x.shape)}/"
+                f"{x.dtype} vs {tuple(y.shape)}/{y.dtype}; XLA requires "
+                "both branches to produce identical shapes and dtypes")
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name=None,
+         return_names=None):
+    """ref: static/nn/control_flow.py cond.
+
+    Dygraph with a concrete scalar pred: runs the chosen callable (the
+    reference's dygraph behavior).  Static capture / traced pred: both
+    branches trace once and lower to a single ``jax.lax.cond`` —
+    gradients flow to every tensor either branch closes over."""
+    pred = ensure_tensor(pred)
+    from .capture import in_static_capture
+    if not in_static_capture() and not _is_traced(pred):
+        taken = true_fn if bool(pred._data.reshape(())) else false_fn
+        return taken() if taken is not None else None
+
+    t_outs, rebuild, t_pure, t_ext = _trace_callable(
+        true_fn or (lambda: None), (), "cond/true_fn")
+    f_outs, _, f_pure, f_ext = _trace_callable(
+        false_fn or (lambda: None), (), "cond/false_fn")
+    _check_same_structure(t_outs, f_outs, "cond")
+    if not t_outs:
+        return None
+    n_t = len(t_ext)
+
+    def op_fn(p, *ext):
+        et, ef = ext[:n_t], ext[n_t:]
+        return jax.lax.cond(
+            jnp.asarray(p).reshape(()).astype(bool),
+            lambda ops: t_pure((), ops[0]),
+            lambda ops: f_pure((), ops[1]),
+            (et, ef))
+
+    outs = call_op(op_fn, [pred] + t_ext + f_ext, multi_out=True,
+                   op_name="cond")
+    return rebuild(list(outs))
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence, is_test: bool = False, name=None):
+    """ref: static/nn/control_flow.py while_loop.
+
+    Dygraph: a Python while over eager ops (differentiable, dynamic
+    shapes allowed).  Static capture / traced inputs: one
+    ``jax.lax.while_loop`` with a data-dependent trip count inside one
+    compiled program (forward-only under trace — XLA cannot reverse
+    through a dynamic trip count)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("while_loop: loop_vars must be a non-empty "
+                        "list/tuple")
+    lvs = [ensure_tensor(v) for v in loop_vars]
+    from .capture import in_static_capture
+    if not in_static_capture() and not any(_is_traced(v) for v in lvs):
+        vals = list(lvs)
+        while bool(ensure_tensor(cond_fn(*vals))._data.reshape(())):
+            out = body_fn(*vals)
+            out = out if isinstance(out, (list, tuple)) else [out]
+            if len(out) != len(vals):
+                raise ValueError(
+                    f"while_loop: body returned {len(out)} vars, "
+                    f"expected {len(vals)}")
+            vals = [ensure_tensor(v) for v in out]
+        return list(vals)
+
+    c_outs, _, c_pure, c_ext = _trace_callable(cond_fn, lvs,
+                                               "while_loop/cond")
+    if len(c_outs) != 1:
+        raise ValueError("while_loop: cond must return one scalar bool")
+    b_outs, _, b_pure, b_ext = _trace_callable(body_fn, lvs,
+                                               "while_loop/body")
+    if len(b_outs) != len(lvs):
+        raise ValueError(
+            f"while_loop: body returned {len(b_outs)} vars, expected "
+            f"{len(lvs)}")
+    for i, (v, o) in enumerate(zip(lvs, b_outs)):
+        if tuple(v.shape) != tuple(o.shape) or v.dtype != o.dtype:
+            raise ValueError(
+                f"while_loop: loop var {i} changes {tuple(v.shape)}/"
+                f"{v.dtype} -> {tuple(o.shape)}/{o.dtype}; a traced "
+                "while_loop must keep shapes/dtypes invariant (XLA "
+                "static shapes) — restructure with a padded buffer, or "
+                "run in dygraph mode")
+    n_c = len(c_ext)
+    n_v = len(lvs)
+
+    def op_fn(*all_in):
+        vals = all_in[:n_v]
+        ec = all_in[n_v:n_v + n_c]
+        eb = all_in[n_v + n_c:]
+
+        def wcond(carry):
+            return jnp.asarray(
+                c_pure(carry, ec)[0]).reshape(()).astype(bool)
+
+        def wbody(carry):
+            return tuple(b_pure(carry, eb))
+
+        return jax.lax.while_loop(wcond, wbody, tuple(vals))
+
+    all_in = list(lvs) + c_ext + b_ext
+    if not any(_is_traced(t) for t in all_in):
+        # STATIC CAPTURE on concrete values: the recorded op is the true
+        # unbounded while_loop, but capture-time evaluation must not
+        # hang when the loop does not terminate on placeholder values
+        # (static.data holds zeros — `while v.sum() < L` never exits on
+        # them).  Execute a FUEL-CAPPED twin for the construction-time
+        # arrays (exact whenever the real loop finishes within the
+        # fuel), then record op_fn through the observer by hand.
+        from ..flags import get_flag
+        fuel = int(get_flag("while_capture_max_iters"))
+
+        def op_fn_capped(*xs):
+            vals = xs[:n_v]
+            ec = xs[n_v:n_v + n_c]
+            eb = xs[n_v + n_c:]
+
+            def wcond(carry):
+                vs, k = carry
+                live = jnp.asarray(
+                    c_pure(vs, ec)[0]).reshape(()).astype(bool)
+                return live & (k < fuel)
+
+            def wbody(carry):
+                vs, k = carry
+                return tuple(b_pure(vs, eb)), k + 1
+
+            out, _ = jax.lax.while_loop(
+                wcond, wbody, (tuple(vals), jnp.asarray(0)))
+            return out
+
+        res = op_fn_capped(*(t._data for t in all_in))
+        outs = [Tensor(r) for r in res]
+        from ..core import dispatch as _dispatch
+        if _dispatch._op_observer is not None:
+            _dispatch._op_observer(op_fn, {}, all_in, outs, True,
+                                   "while_loop")
+        return outs
+
+    outs = call_op(op_fn, all_in, multi_out=True, op_name="while_loop",
+                   nondiff_out=tuple(range(n_v)))
+    return list(outs)
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None, name=None):
+    """ref: static/nn/control_flow.py case — first true pred wins;
+    ``default`` (or the last pair's fn, per the reference) otherwise.
+    Lowers to a nested ``cond`` chain under capture/trace."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise TypeError("case: pred_fn_pairs must be non-empty")
+    for p in pairs:
+        if not (isinstance(p, (list, tuple)) and len(p) == 2
+                and callable(p[1])):
+            raise TypeError("case: each entry must be a (pred, fn) pair")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    def chain(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, chain(i + 1))
+
+    return chain(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """ref: static/nn/control_flow.py switch_case — dispatch on an int
+    scalar.  Lowers to a single ``jax.lax.switch`` under capture/trace."""
+    branch_index = ensure_tensor(branch_index)
+    fns = list(branch_fns.items()) if isinstance(branch_fns, dict) \
+        else list(branch_fns)
+    if fns and callable(fns[0]):
+        fns = list(enumerate(fns))
+    keys = []
+    for k, f in fns:
+        if not callable(f):
+            raise TypeError("switch_case: branch fns must be callable")
+        if k in keys:
+            raise ValueError(f"switch_case: duplicate branch index {k}")
+        keys.append(int(k))
+    if default is None:
+        default = fns[-1][1]
+
+    from .capture import in_static_capture
+    if not in_static_capture() and not _is_traced(branch_index):
+        bi = int(branch_index._data.reshape(()))
+        for k, f in fns:
+            if bi == int(k):
+                return f()
+        return default()
+
+    traces = [_trace_callable(f, (), f"switch_case/branch{k}")
+              for k, f in fns]
+    if default is fns[-1][1]:
+        # the reference's implicit default IS the last branch — reuse
+        # its trace instead of compiling the body twice into the switch
+        traces.append(traces[-1])
+    else:
+        traces.append(_trace_callable(default, (), "switch_case/default"))
+    outs0, rebuild = traces[0][0], traces[0][1]
+    for t in traces[1:]:
+        _check_same_structure(outs0, t[0], "switch_case")
+    exts = [t[3] for t in traces]
+    sizes = [len(e) for e in exts]
+    pures = [t[2] for t in traces]
+
+    def op_fn(bi, *ext):
+        chunks = []
+        off = 0
+        for s in sizes:
+            chunks.append(ext[off:off + s])
+            off += s
+        sel = jnp.asarray(len(pures) - 1)           # default position
+        b = jnp.asarray(bi).reshape(()).astype(jnp.int32)
+        for i, k in enumerate(keys):
+            sel = jnp.where(b == k, i, sel)
+        branches = [
+            (lambda j: lambda ops: pures[j]((), ops[j]))(j)
+            for j in range(len(pures))]
+        return jax.lax.switch(sel, branches, tuple(chunks))
+
+    flat_ext = [t for e in exts for t in e]
+    outs = call_op(op_fn, [branch_index] + flat_ext, multi_out=True,
+                   op_name="switch_case")
+    return rebuild(list(outs))

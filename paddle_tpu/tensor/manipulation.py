@@ -516,9 +516,21 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
     return x._inplace_assign(out)
 
 
+def overwrite_inplace_(x, make_new, op_name):
+    """Shared in-place OVERWRITE pattern (fill_/zero_/random _-ops):
+    the new value does not depend on the old one, so the tape must
+    record a zero-vjp op (torch/paddle FillBackward semantics) — NOT
+    keep the stale producer node attached, which would leak the
+    pre-overwrite gradient through the overwritten tensor (bug found by
+    the r5 grad triage: fill_ propagated identity grads)."""
+    from ._helpers import _inplace_op
+    return _inplace_op(
+        x, lambda s: call_op(make_new, (s,), {}, op_name=op_name))
+
+
 def fill_(x, value):
-    x._replace_value(jnp.full_like(x._data, value))
-    return x
+    return overwrite_inplace_(
+        x, lambda v: jnp.full_like(v, value), "fill_")
 
 
 def zero_(x):

@@ -37,10 +37,11 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    x._replace_value(uniform(x.shape, dtype=x.dtype, min=min, max=max,
-                             seed=seed)._data)
-    x._grad_node = None
-    return x
+    from .manipulation import overwrite_inplace_
+    x._check_inplace_autograd()   # before the draw: a raise must not
+    new = uniform(x.shape, dtype=x.dtype,  # desync the RNG stream
+                  min=min, max=max, seed=seed)
+    return overwrite_inplace_(x, lambda v: new._data, "uniform_")
 
 
 def randn(shape, dtype=None, name=None):
@@ -70,10 +71,12 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 
 def normal_(x, mean=0.0, std=1.0, name=None):
+    from .manipulation import overwrite_inplace_
+    x._check_inplace_autograd()   # before the draw (RNG stream sync)
     key = random_state.next_key()
-    x._replace_value(mean + std * jax.random.normal(key, tuple(x.shape),
-                                                    dtype=x._data.dtype))
-    return x
+    new = mean + std * jax.random.normal(key, tuple(x.shape),
+                                         dtype=x._data.dtype)
+    return overwrite_inplace_(x, lambda v: new, "normal_")
 
 
 def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
@@ -140,10 +143,12 @@ def bernoulli(x, name=None):
 
 
 def bernoulli_(x, p=0.5, name=None):
+    from .manipulation import overwrite_inplace_
+    x._check_inplace_autograd()   # before the draw (RNG stream sync)
     key = random_state.next_key()
-    x._replace_value(jax.random.bernoulli(key, p, tuple(x.shape)).astype(
-        x._data.dtype))
-    return x
+    new = jax.random.bernoulli(key, p, tuple(x.shape)).astype(
+        x._data.dtype)
+    return overwrite_inplace_(x, lambda v: new, "bernoulli_")
 
 
 def poisson(x, name=None):
@@ -154,10 +159,12 @@ def poisson(x, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
+    from .manipulation import overwrite_inplace_
+    x._check_inplace_autograd()   # before the draw (RNG stream sync)
     key = random_state.next_key()
-    x._replace_value(jax.random.exponential(
-        key, tuple(x.shape), dtype=x._data.dtype) / lam)
-    return x
+    new = jax.random.exponential(
+        key, tuple(x.shape), dtype=x._data.dtype) / lam
+    return overwrite_inplace_(x, lambda v: new, "exponential_")
 
 
 def binomial(count, prob, name=None):

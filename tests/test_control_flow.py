@@ -1,0 +1,176 @@
+"""static.nn control flow (ref: python/paddle/static/nn/control_flow.py)
+— cond/while_loop/case/switch_case lowering to lax.cond/while_loop/
+switch so data-dependent control flow compiles into ONE program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static import Executor, Program, program_guard
+from paddle_tpu.static.control_flow import (case, cond, switch_case,
+                                            while_loop)
+
+
+def test_exposed_on_static_nn():
+    from paddle_tpu import static
+    assert static.nn.cond is cond and static.nn.while_loop is while_loop
+    assert static.nn.case is case and static.nn.switch_case is switch_case
+
+
+def test_cond_eager_picks_branch():
+    x = paddle.to_tensor([1.0, 2.0])
+    out = cond(paddle.to_tensor(True), lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+    out = cond(paddle.to_tensor(False), lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [0.0, 1.0])
+    # sequence returns
+    a, b = cond(paddle.to_tensor(1.0) < 2.0,
+                lambda: (x * 2, x * 3), lambda: (x, x))
+    np.testing.assert_allclose(b.numpy(), [3.0, 6.0])
+
+
+def test_cond_one_program_two_predicates():
+    """The point of the lowering: ONE captured program embeds BOTH
+    branches behind lax.cond — different pred feeds flip the branch
+    with no recapture."""
+    import paddle_tpu.static as static
+    prog = Program()
+    with program_guard(prog):
+        p = static.data("p", [], "bool")
+        x = static.data("x", [3], "float32")
+        out = cond(p, lambda: x * 2.0, lambda: x - 1.0)
+    exe = Executor()
+    xv = np.array([1.0, 2.0, 3.0], "float32")
+    r_t = exe.run(prog, feed={"p": np.array(True), "x": xv},
+                  fetch_list=[out])[0]
+    r_f = exe.run(prog, feed={"p": np.array(False), "x": xv},
+                  fetch_list=[out])[0]
+    np.testing.assert_allclose(r_t, xv * 2.0)
+    np.testing.assert_allclose(r_f, xv - 1.0)
+
+
+def test_grad_through_cond():
+    """Gradients flow to tensors captured by EITHER branch of a lowered
+    cond (jax differentiates lax.cond)."""
+    prog = Program()
+    with program_guard(prog):
+        x = paddle.to_tensor([1.0, 3.0], stop_gradient=False)
+        for pv, want in ((True, [2.0, 2.0]), (False, [2.0, 6.0])):
+            y = cond(paddle.to_tensor(pv),
+                     lambda: (x * 2.0).sum(), lambda: (x * x).sum())
+            y.backward()
+            np.testing.assert_allclose(x.grad.numpy(), want)
+            x.clear_grad()
+
+
+def test_while_loop_eager_differentiable():
+    """Dygraph while_loop is the reference's python loop — dynamic trip
+    count, fully differentiable through the tape."""
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0.0)
+
+    def body(i, s):
+        return [i + 1, s + x * x]
+
+    i2, s2 = while_loop(lambda i, s: i < 3, body, [i, s])
+    assert int(i2) == 3
+    np.testing.assert_allclose(float(s2), 12.0)
+    s2.backward()
+    np.testing.assert_allclose(float(x.grad), 12.0)  # 3 * 2x
+
+
+def test_while_loop_one_program_dynamic_trip_count():
+    """A tensor-dependent trip count runs inside ONE compiled program —
+    the exact thing SOT-lite specialization cannot express."""
+    import paddle_tpu.static as static
+    prog = Program()
+    with program_guard(prog):
+        limit = static.data("limit", [], "float32")
+        v = static.data("v", [], "float32")
+        out = while_loop(lambda x: x < limit, lambda x: [x * 2.0], [v])
+    exe = Executor()
+    r1 = exe.run(prog, feed={"limit": np.float32(10.0),
+                             "v": np.float32(1.0)}, fetch_list=out)[0]
+    r2 = exe.run(prog, feed={"limit": np.float32(100.0),
+                             "v": np.float32(1.0)}, fetch_list=out)[0]
+    assert float(r1) == 16.0     # 1->2->4->8->16
+    assert float(r2) == 128.0    # 7 doublings, same program
+
+
+def test_while_loop_shape_change_raises_clearly():
+    prog = Program()
+    with program_guard(prog):
+        v = paddle.to_tensor([1.0])
+        with pytest.raises(ValueError, match="invariant"):
+            while_loop(lambda x: x.sum() < 10,
+                       lambda x: [paddle.concat([x, x])], [v])
+
+
+def test_case_and_switch_case_eager():
+    x = paddle.to_tensor(3.0)
+    out = case([(x < 1.0, lambda: x * 10.0), (x < 5.0, lambda: x + 1.0)],
+               default=lambda: x)
+    np.testing.assert_allclose(float(out), 4.0)
+    out = switch_case(paddle.to_tensor(2), {1: lambda: x * 10.0,
+                                            2: lambda: x + 1.0},
+                      default=lambda: x)
+    np.testing.assert_allclose(float(out), 4.0)
+    # unmatched index -> default
+    out = switch_case(paddle.to_tensor(9), {1: lambda: x * 10.0,
+                                            2: lambda: x + 1.0},
+                      default=lambda: x - 1.0)
+    np.testing.assert_allclose(float(out), 2.0)
+
+
+def test_switch_case_one_program():
+    import paddle_tpu.static as static
+    prog = Program()
+    with program_guard(prog):
+        bi = static.data("bi", [], "int32")
+        x = static.data("x", [2], "float32")
+        out = switch_case(bi, {0: lambda: x + 1.0, 2: lambda: x * 3.0},
+                          default=lambda: x * 0.0)
+    exe = Executor()
+    xv = np.array([1.0, 2.0], "float32")
+    np.testing.assert_allclose(
+        exe.run(prog, feed={"bi": np.int32(0), "x": xv},
+                fetch_list=[out])[0], xv + 1.0)
+    np.testing.assert_allclose(
+        exe.run(prog, feed={"bi": np.int32(2), "x": xv},
+                fetch_list=[out])[0], xv * 3.0)
+    np.testing.assert_allclose(
+        exe.run(prog, feed={"bi": np.int32(7), "x": xv},
+                fetch_list=[out])[0], xv * 0.0)
+
+
+def test_cond_inside_jitted_step():
+    """A traced predicate (inside jax.jit via the train-step engine's
+    trace machinery) routes to lax.cond automatically."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(xa):
+        x = Tensor(xa)
+        out = cond(x.sum() > 0.0, lambda: x * 2.0, lambda: -x)
+        return out._data
+
+    j = jax.jit(f)
+    np.testing.assert_allclose(
+        np.asarray(j(jnp.asarray([1.0, 2.0]))), [2.0, 4.0])
+    np.testing.assert_allclose(
+        np.asarray(j(jnp.asarray([-1.0, -2.0]))), [1.0, 2.0])
+
+
+def test_while_loop_inside_jitted_step():
+    import jax
+    import jax.numpy as jnp
+
+    def f(xa):
+        v = Tensor(xa)
+        out = while_loop(lambda x: x < 50.0, lambda x: [x * 3.0], [v])[0]
+        return out._data
+
+    j = jax.jit(f)
+    assert float(j(jnp.asarray(1.0))) == 81.0
+    assert float(j(jnp.asarray(30.0))) == 90.0

@@ -94,12 +94,25 @@ def test_forward_smoke(name):
                 assert np.isfinite(o).all(), f"op {name} non-finite"
 
 
+def test_grad_coverage_is_total():
+    """VERDICT r4 item 3: every testable row either grad-checks or is
+    EXPLICITLY marked non-differentiable with a reason."""
+    unmarked = sorted(
+        name for name, row in REGISTRY.items()
+        if row.gen_cases is not None and row.paddle_fn is not None
+        and not row.grad and not row.nondiff_reason)
+    assert not unmarked, (
+        f"{len(unmarked)} testable ops neither grad-checked nor "
+        f"marked non-differentiable: {unmarked[:20]}")
+    assert len(_GRAD_ROWS) >= 400, len(_GRAD_ROWS)
+
+
 @pytest.mark.parametrize("name", _GRAD_ROWS)
 def test_numeric_grad(name):
     """check_grad oracle: analytic grad from the tape vs central
     difference on the op itself (ref: OpTest.check_grad)."""
     row = REGISTRY[name]
-    arrays = row.gen_cases()[0]
+    arrays = (row.grad_cases or row.gen_cases)()[0]
     # analytic
     tensors = [Tensor(a) for a in arrays]
     for t in tensors:
@@ -126,13 +139,17 @@ def test_numeric_grad(name):
     for i, a in enumerate(arrays):
         if not np.issubdtype(np.asarray(a).dtype, np.floating):
             continue
-        num = np.zeros_like(a, dtype="float64")
-        flat = a.reshape(-1)
+        # C-order explicitly: zeros_like inherits a non-contiguous
+        # layout from qr/transpose-derived cases, making reshape(-1)
+        # return a COPY and silently zeroing the numeric grad
+        num = np.zeros(a.shape, dtype="float64")
+        flat = np.ascontiguousarray(a).reshape(-1)
         for j in range(flat.size):
             ap, am = [x.copy() for x in arrays], [x.copy() for x in arrays]
             ap[i].reshape(-1)[j] += eps
             am[i].reshape(-1)[j] -= eps
             num.reshape(-1)[j] = (f(ap) - f(am)) / (2 * eps)
+        rtol, atol = row.grad_tol or (5e-2, 5e-3)
         np.testing.assert_allclose(
-            analytic[i], num, rtol=5e-2, atol=5e-3,
+            analytic[i], num, rtol=rtol, atol=atol,
             err_msg=f"op {name} grad wrt arg {i}")

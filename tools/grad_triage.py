@@ -1,0 +1,120 @@
+"""Triage the registry's no-grad rows for VERDICT r5 item 3 (327→612).
+
+For every testable registry row without grad=True, attempt the exact
+numeric-vs-analytic check the generated test runs and classify:
+  pass        — candidate for grad=True
+  nondiff-out — output is int/bool (no gradient exists)
+  nondiff-in  — no floating input to differentiate
+  complex     — complex in/out (the float central-difference harness
+                does not apply; handled separately)
+  nograd-path — backward produced no/None grads (inspect: stop_gradient
+                by design, or a missing VJP = bug)
+  fail:<err>  — mismatch or exception (inspect: real bugs live here)
+
+Writes JSON lines to stdout; summary at the end.
+"""
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.tensor.op_registry import (REGISTRY,  # noqa: E402
+                                           build_full_registry)
+
+build_full_registry()
+
+
+def triage(name, row):
+    arrays = row.gen_cases()[0]
+    np_arrays = [np.asarray(a) for a in arrays]
+    if not any(np.issubdtype(a.dtype, np.floating) for a in np_arrays):
+        return "nondiff-in"
+    if any(np.issubdtype(a.dtype, np.complexfloating) for a in np_arrays):
+        return "complex"
+
+    def call(args):
+        ts = [Tensor(a) for a in args]
+        for t in ts:
+            t.stop_gradient = False
+        o = (row.paddle_fn(ts, **row.kwargs) if row.list_input
+             else row.paddle_fn(*ts, **row.kwargs))
+        if isinstance(o, (list, tuple)):
+            o = o[0]
+        return ts, o
+
+    ts, out = call(arrays)
+    o_np = np.asarray(out.numpy()) if isinstance(out, Tensor) \
+        else np.asarray(out)
+    if np.issubdtype(o_np.dtype, np.complexfloating):
+        return "complex"
+    if not np.issubdtype(o_np.dtype, np.floating):
+        return "nondiff-out"
+
+    out.sum().backward()
+    if all(t.grad is None for t in ts):
+        return "nograd-path"
+    analytic = [t.grad.numpy() if t.grad is not None
+                else np.zeros_like(a)
+                for t, a in zip(ts, np_arrays)]
+
+    eps = 1e-3
+
+    def f(args):
+        _, o = call(args)
+        return float(o.sum())
+
+    for i, a in enumerate(np_arrays):
+        if not np.issubdtype(a.dtype, np.floating):
+            continue
+        # C-order explicitly: zeros_like would inherit a non-contiguous
+        # layout (qr/transpose-derived cases), making reshape(-1) return
+        # a COPY and silently dropping every assignment
+        num = np.zeros(a.shape, dtype="float64")
+        flat = np.ascontiguousarray(a).reshape(-1)
+        for j in range(min(flat.size, 64)):
+            ap = [x.copy() for x in np_arrays]
+            am = [x.copy() for x in np_arrays]
+            ap[i].reshape(-1)[j] += eps
+            am[i].reshape(-1)[j] -= eps
+            num.reshape(-1)[j] = (f(ap) - f(am)) / (2 * eps)
+        an = np.asarray(analytic[i], dtype="float64").reshape(-1)
+        nu = num.reshape(-1)
+        k = min(flat.size, 64)
+        if not np.allclose(an[:k], nu[:k], rtol=5e-2, atol=5e-3):
+            return ("fail:mismatch arg%d max|d|=%.2e"
+                    % (i, float(np.max(np.abs(an[:k] - nu[:k])))))
+    return "pass"
+
+
+def main():
+    only = sys.argv[1:] or None
+    results = {}
+    for name in sorted(REGISTRY):
+        row = REGISTRY[name]
+        if row.gen_cases is None or row.paddle_fn is None or row.grad:
+            continue
+        if only and name not in only:
+            continue
+        try:
+            verdict = triage(name, row)
+        except Exception as e:  # noqa: BLE001
+            verdict = f"fail:{type(e).__name__}: {e}"[:160]
+            if os.environ.get("TRIAGE_TB"):
+                traceback.print_exc()
+        results[name] = verdict
+        print(json.dumps({"op": name, "verdict": verdict}), flush=True)
+    from collections import Counter
+    c = Counter(v.split(":")[0] for v in results.values())
+    print(json.dumps({"summary": dict(c)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
