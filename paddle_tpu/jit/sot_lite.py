@@ -58,6 +58,11 @@ _ALL_STATS: List = []   # weakrefs to every StaticFunction's SotStats
 def register_stats(stats: "SotStats"):
     import weakref
     _ALL_STATS.append(weakref.ref(stats))
+    # bound long-running processes that never call stats(): prune dead
+    # refs whenever the list doubles past a floor
+    if len(_ALL_STATS) > 64 and len(_ALL_STATS) > 2 * sum(
+            1 for r in _ALL_STATS if r() is not None):
+        _ALL_STATS[:] = [r for r in _ALL_STATS if r() is not None]
 
 
 def all_stats() -> Dict[str, dict]:

@@ -263,6 +263,8 @@ def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
     branch_index = ensure_tensor(branch_index)
     fns = list(branch_fns.items()) if isinstance(branch_fns, dict) \
         else list(branch_fns)
+    if not fns:
+        raise TypeError("switch_case: branch_fns must be non-empty")
     if fns and callable(fns[0]):
         fns = list(enumerate(fns))
     keys = []

@@ -197,3 +197,44 @@ def test_strategy_dict_config_merges_tuning():
     assert isinstance(s.tuning, TuningConfig)
     assert s.tuning.enable and s.tuning.profile
     assert s.tuning.candidates is None     # unspecified keys keep defaults
+
+
+def test_engine_tune_profile_topk_budget():
+    """tune(profile=True, top_k, budget_s) (VERDICT r4 item 9): the
+    roofline pre-rank limits MEASURED candidates to top_k, profile mode
+    takes a multi-rep median, the budget stops new candidates without
+    interrupting in-flight work, and pre-rank skips are reported."""
+    _fresh()
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.strategy import Strategy
+
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    eng = Engine(model, loss=lambda out, y: ((out - y) ** 2).mean(),
+                 optimizer=o, strategy=Strategy())
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 16).astype(np.float32)
+    y = rs.randn(8, 8).astype(np.float32)
+    got = eng.tune(x, y, candidates=[(8, 1, 1), (4, 2, 1), (2, 2, 2),
+                                     (1, 1, 8)],
+                   profile=True, top_k=2)
+    assert got["dp"] * got["sharding"] * got["mp"] == 8
+    measured = [e for e in eng.tuning_report if "step_s" in e]
+    skipped = [e for e in eng.tuning_report
+               if e.get("skipped", "").startswith("below top_k")]
+    assert len(measured) == 2, eng.tuning_report
+    assert len(skipped) == 2, eng.tuning_report
+
+    # zero budget: the first candidate still runs (a winner must
+    # exist), later ones are skipped by budget
+    _fresh()
+    eng2 = Engine(model, loss=lambda out, y: ((out - y) ** 2).mean(),
+                  optimizer=o, strategy=Strategy())
+    got2 = eng2.tune(x, y, candidates=[(8, 1, 1), (2, 2, 2), (1, 1, 8)],
+                     budget_s=0.0)
+    budget_skips = [e for e in eng2.tuning_report
+                    if e.get("skipped") == "tuning budget exhausted"]
+    assert len(budget_skips) == 2, eng2.tuning_report
+    assert got2["dp"] * got2["sharding"] * got2["mp"] == 8

@@ -193,3 +193,93 @@ def test_llama_adamw_global_norm_clip_matches_torch():
         assert abs(w - g) < tol, (
             f"step {i}: torch {w:.6f} vs ours {g:.6f}\n"
             f"torch: {want}\nours:  {got}")
+
+
+@pytest.mark.slow
+def test_gpt_10m_100step_curve_matches_torch():
+    """VERDICT r4 item 4: the loss-curve half of the north star at
+    non-toy scale — an ~8M-param GPT-2, 100 steps of AdamW + global-norm
+    clip + warmup/linear-decay LR schedule, dropout off, OUR side
+    through the jitted TrainStep engine — per-step loss must track the
+    transformers/torch run within a compounding-float tolerance."""
+    from paddle_tpu.jit import train_step
+    from paddle_tpu.models.convert import gpt2_from_hf
+
+    STEPS_L, WARM = 100, 10
+    torch.manual_seed(3)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=2000, n_positions=128, n_embd=320, n_layer=6,
+        n_head=8, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager")
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    ours = gpt2_from_hf(hf)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in {id(p): p for p in ours.parameters()}.values())
+    assert n_params > 7e6, n_params
+    ours.train()
+    hf.train()
+
+    rs = np.random.RandomState(11)
+    # 10 unique batches cycled 10x: uniform-random tokens sit AT the
+    # ln(vocab) entropy floor, so fresh data every step shows parity
+    # but no descent — cycling lets memorization pull the curve down,
+    # exercising the optimizer/schedule dynamics the test is about
+    uniq = [rs.randint(0, hf_cfg.vocab_size, (8, 128)).astype("int64")
+            for _ in range(10)]
+    batches = [uniq[i % 10] for i in range(STEPS_L)]
+
+    def lr_mult(step):          # warmup then linear decay
+        if step < WARM:
+            return (step + 1) / WARM
+        return max(0.1, 1.0 - (step - WARM) / (STEPS_L - WARM))
+
+    clip_norm = 1.0
+    base_lr = 3e-4
+
+    topt = torch.optim.AdamW(hf.parameters(), lr=base_lr,
+                             betas=(0.9, 0.999), eps=1e-8,
+                             weight_decay=0.01)
+    tsched = torch.optim.lr_scheduler.LambdaLR(topt, lr_mult)
+    want = []
+    for ids in batches:
+        t = torch.tensor(ids)
+        logits = hf(t).logits
+        loss = torch.nn.functional.cross_entropy(
+            logits[:, :-1].reshape(-1, logits.shape[-1]),
+            t[:, 1:].reshape(-1))
+        topt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(hf.parameters(), clip_norm)
+        topt.step()
+        tsched.step()
+        want.append(float(loss))
+
+    sched = popt.lr.LambdaDecay(base_lr, lr_mult)
+    oopt = popt.AdamW(learning_rate=sched, beta1=0.9, beta2=0.999,
+                      epsilon=1e-8, weight_decay=0.01,
+                      parameters=ours.parameters(),
+                      grad_clip=paddle.nn.ClipGradByGlobalNorm(clip_norm))
+
+    def step_fn(m, ids, labels):
+        logits = m(Tensor(ids))
+        flat = logits[:, :-1].reshape([-1, hf_cfg.vocab_size])
+        tgt = Tensor(labels)[:, 1:].reshape([-1])
+        return paddle.nn.functional.cross_entropy(flat, tgt,
+                                                  reduction="mean")
+
+    step = train_step(ours, None, oopt, step_fn=step_fn)
+    got = []
+    for ids in batches:
+        got.append(float(step(ids, ids)))
+        sched.step()
+
+    drift = [abs(w - g) for w, g in zip(want, got)]
+    for i, (w, g) in enumerate(zip(want, got)):
+        tol = 2e-3 * (i + 1) * max(abs(w), 1.0)
+        assert abs(w - g) < tol, (
+            f"step {i}: torch {w:.6f} vs ours {g:.6f} (tol {tol:.6f})\n"
+            f"first 10 torch: {want[:10]}\nfirst 10 ours:  {got[:10]}")
+    # training made real progress and the curves ended close
+    assert want[-1] < want[0] - 0.5
+    assert drift[-1] < 0.05 * max(abs(want[-1]), 1.0), (
+        drift[-1], want[-1], got[-1])
