@@ -126,6 +126,12 @@ define_flag("sot_relax_guards", False,
             "outputs.  UNSOUND if a host-read value steers python "
             "control flow near a threshold the demonstrations did not "
             "cross — enable only when host reads are logging-only")
+define_flag("pp_allow_axis_fallback", False,
+            "allow an EXPLICIT pipeline schedule_mode to fall back to "
+            "pure-pp host scheduling when mp/sharding/sep/cp axes are "
+            "live (default: raise — the requested schedule would "
+            "silently not run; the compiled shard_map ring composes "
+            "those axes)")
 define_flag("while_capture_max_iters", 100000,
             "fuel cap for CONSTRUCTION-TIME evaluation of a captured "
             "static.nn.while_loop (placeholder values may never satisfy "

