@@ -18,6 +18,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# persistent XLA compile cache: the suite compiles hundreds of small
+# programs, many identical across tests AND across runs — repeat runs
+# (the common local gate) skip most compiles entirely
+_cache_dir = os.environ.get(
+    "PYTEST_XLA_CACHE",
+    os.path.join(os.path.dirname(__file__), ".xla_cache"))
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
 assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
 assert jax.device_count() == 8
 

@@ -17,6 +17,7 @@ def _tiny_llama(seed=0):
         max_position_embeddings=64))
 
 
+@pytest.mark.slow   # the GPT variant keeps the default-gate cover
 def test_cached_decode_matches_full_recompute():
     """KV-cache decode must produce the SAME tokens as re-running the
     full prefix every step (greedy: exact match)."""

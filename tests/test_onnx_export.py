@@ -436,6 +436,7 @@ def test_runtime_gpt_tied_head_dynamic_batch(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-5)
 
 
+@pytest.mark.slow   # lenet + bert runtime roundtrips stay default
 def test_runtime_resnet18_roundtrip(tmp_path):
     """Vision flagship: resnet18 (conv/bn/maxpool/globalpool attr
     recovery at a symbolic batch) runs under the numpy ONNX runtime."""

@@ -125,8 +125,13 @@ def test_numeric_grad(name):
     analytic = [t.grad.numpy() if t.grad is not None
                 else np.zeros_like(a) for t, a in zip(tensors, arrays)]
 
-    # numeric: central difference, f = sum(op(x))
+    # numeric: central difference, f = sum(op(x)).  Large args are
+    # SAMPLED with an even stride (cap 96 pokes per arg): each poke is
+    # two full op evaluations, and checking every element of a
+    # 162-offset deform-conv case costs 90+ s for no additional
+    # failure-mode coverage beyond a strided sample
     eps = 1e-3
+    MAX_POKES = 96
 
     def f(args):
         ts = [Tensor(a) for a in args]
@@ -142,14 +147,18 @@ def test_numeric_grad(name):
         # C-order explicitly: zeros_like inherits a non-contiguous
         # layout from qr/transpose-derived cases, making reshape(-1)
         # return a COPY and silently zeroing the numeric grad
-        num = np.zeros(a.shape, dtype="float64")
         flat = np.ascontiguousarray(a).reshape(-1)
-        for j in range(flat.size):
+        stride = max(1, flat.size // MAX_POKES)
+        picks = np.arange(0, flat.size, stride)[:MAX_POKES]
+        num = np.zeros(picks.size, dtype="float64")
+        for n_, j in enumerate(picks):
             ap, am = [x.copy() for x in arrays], [x.copy() for x in arrays]
             ap[i].reshape(-1)[j] += eps
             am[i].reshape(-1)[j] -= eps
-            num.reshape(-1)[j] = (f(ap) - f(am)) / (2 * eps)
+            num[n_] = (f(ap) - f(am)) / (2 * eps)
         rtol, atol = row.grad_tol or (5e-2, 5e-3)
+        an = np.ascontiguousarray(np.asarray(analytic[i],
+                                             dtype="float64")).reshape(-1)
         np.testing.assert_allclose(
-            analytic[i], num, rtol=rtol, atol=atol,
+            an[picks], num, rtol=rtol, atol=atol,
             err_msg=f"op {name} grad wrt arg {i}")

@@ -117,6 +117,7 @@ def test_grads_flow_through_query():
     assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
 
 
+@pytest.mark.slow   # GPT + Qwen2-HF paged tests keep default cover
 def test_llama_paged_generation_matches_dense():
     """End-to-end: generate(use_paged_cache=True) routes every decode
     step through the page pool and must reproduce the dense KV-cache

@@ -75,6 +75,7 @@ def test_pp_loss_parity():
     np.testing.assert_allclose(base, pp, rtol=3e-4)
 
 
+@pytest.mark.slow   # degenerate pp=1 case; parity tests cover pp
 def test_pp_single_stage_matches():
     # pp=1 degenerates to plain microbatched training (microbatch size
     # must stay divisible by the dp degree)
