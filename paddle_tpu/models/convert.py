@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["llama_from_hf", "bert_from_hf", "gpt2_from_hf",
-           "mistral_from_hf", "qwen2_from_hf"]
+           "mistral_from_hf", "qwen2_from_hf", "gemma_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -57,10 +57,13 @@ def _interleave_rope_rows(w: np.ndarray, n_heads: int) -> np.ndarray:
 
 
 def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
-                  config=None, dtype: str = "float32"):
+                  config=None, dtype: str = "float32",
+                  cfg_overrides: Optional[Dict] = None):
     """Build a LlamaForCausalLM carrying a transformers Llama
     checkpoint's weights.  Pass either the HF model or
-    (state_dict, hf_config)."""
+    (state_dict, hf_config).  ``cfg_overrides`` lets sibling
+    architectures on the same stack (Gemma) adjust LlamaConfig fields
+    (hidden_act, embed_scale, tie_word_embeddings)."""
     from .llama import LlamaConfig, LlamaForCausalLM
 
     if hf_model is not None:
@@ -81,7 +84,7 @@ def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
             "architecture (decoupled head_dim, e.g. Mistral-Nemo) is "
             "not representable by LlamaAttention's fused layout")
     tie = bool(getattr(config, "tie_word_embeddings", False))
-    cfg = LlamaConfig(
+    cfg_kwargs = dict(
         vocab_size=config.vocab_size,
         hidden_size=config.hidden_size,
         intermediate_size=config.intermediate_size,
@@ -96,6 +99,9 @@ def llama_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
                            for k in sd),
         tie_word_embeddings=tie,
     )
+    cfg_kwargs.update(cfg_overrides or {})
+    cfg = LlamaConfig(**cfg_kwargs)
+    tie = cfg.tie_word_embeddings
     model = LlamaForCausalLM(cfg)
     ll = model.llama
     cast = lambda a: jnp.asarray(a, dtype=dtype)
@@ -330,6 +336,38 @@ def qwen2_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
     if getattr(config, "use_sliding_window", False) and sw:
         _install_window_warning(model, sw)
     return model
+
+
+def gemma_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                  config=None, dtype: str = "float32"):
+    """Build a LlamaForCausalLM carrying a transformers Gemma(-1)
+    checkpoint.  Gemma is the LLaMA stack with three deltas, all
+    absorbed at convert time / via config:
+
+    - RMSNorm computes ``x_norm * (1 + w)`` — fold by storing 1 + w;
+    - hidden states scale by sqrt(hidden_size) after the embedding
+      (``embed_scale``);
+    - the MLP activation is tanh-approximate GELU (``gelu_tanh``).
+
+    Embeddings are always tied.  Gemma-7b's decoupled head_dim
+    (256 != 3072/16) hits llama_from_hf's loud head_dim guard."""
+    import math as _math
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    # per-layer norms end with "layernorm.weight"; the FINAL norm may
+    # arrive as "model.norm.weight" OR prefix-stripped "norm.weight"
+    # (llama_from_hf accepts both layouts — the fold must too)
+    sd = {k: (v + 1.0 if k.endswith("layernorm.weight")
+              or k in ("norm.weight", "model.norm.weight") else v)
+          for k, v in sd.items()}
+    return llama_from_hf(
+        state_dict=sd, config=config, dtype=dtype,
+        cfg_overrides=dict(
+            hidden_act="gelu_tanh",
+            embed_scale=float(_math.sqrt(config.hidden_size)),
+            tie_word_embeddings=True))
 
 
 def mistral_from_hf(hf_model=None, state_dict: Optional[Dict] = None,

@@ -57,9 +57,16 @@ class LlamaConfig:
     initializer_range: float = 0.02
     use_recompute: bool = False
     sequence_parallel: bool = False
+    hidden_act: str = "silu"          # "silu" | "gelu_tanh" (Gemma)
+    embed_scale: float = 1.0          # Gemma multiplies by sqrt(hidden)
     tie_word_embeddings: bool = False
 
     def __post_init__(self):
+        if self.hidden_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"hidden_act={self.hidden_act!r} is not supported "
+                "('silu' or 'gelu_tanh'); HF 'gelu_pytorch_tanh' maps "
+                "to 'gelu_tanh'")
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
         if self.intermediate_size is None:
@@ -220,8 +227,13 @@ class LlamaMLP(nn.Layer):
             c.intermediate_size, c.hidden_size, weight_attr=init,
             has_bias=False, input_is_parallel=True)
 
+        self._act = config.hidden_act
+
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        g = self.gate_proj(x)
+        a = (F.gelu(g, approximate=True) if self._act == "gelu_tanh"
+             else F.silu(g))
+        return self.down_proj(a * self.up_proj(x))
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -261,6 +273,8 @@ class LlamaModel(nn.Layer):
     def forward(self, input_ids, past=None, use_cache: bool = False):
         c = self.config
         x = self.embed_tokens(input_ids)
+        if c.embed_scale != 1.0:
+            x = x * c.embed_scale
         from ..distributed.fleet.meta_parallel.segment_parallel import (
             active_seq_parallel_axis)
         seq_axis = active_seq_parallel_axis()
@@ -367,7 +381,10 @@ def llama_pipeline_step(model: LlamaForCausalLM, optimizer, mesh,
         [] if cfg.tie_word_embeddings else [model.lm_head_weight])
 
     def pre_fn(rep_v, ids):
-        return jnp.take(rep_v[0], ids, axis=0)
+        h = jnp.take(rep_v[0], ids, axis=0)
+        if cfg.embed_scale != 1.0:      # Gemma's sqrt(hidden) scaling
+            h = h * jnp.asarray(cfg.embed_scale, h.dtype)
+        return h
 
     def post_fn(rep_v, h, labels):
         nw = rep_v[1]

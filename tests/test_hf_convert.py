@@ -218,3 +218,36 @@ def test_sliding_window_warning_counts_cached_context():
             str(w.message)]
     assert len(msgs) == 1, msgs          # fired once, not per step
     assert "effective context 9" in msgs[0], msgs
+
+
+def test_gemma_logits_match_transformers():
+    """Gemma = the LLaMA stack + (1+w) RMSNorm folding + sqrt(hidden)
+    embedding scale + tanh-GELU MLP, all absorbed at convert time —
+    logits float-exact vs transformers, plus token-for-token greedy
+    decode (dense AND paged)."""
+    from paddle_tpu.models.convert import gemma_from_hf
+    torch.manual_seed(6)
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=8, max_position_embeddings=64,
+        attn_implementation="eager")
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+    ours = gemma_from_hf(hf)
+    ours.eval()
+    ids = np.array([[3, 17, 42, 9, 55]], "int64")
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(Tensor(ids)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    with torch.no_grad():
+        hf_gen = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                             do_sample=False)
+    d = ours.generate(Tensor(ids), max_new_tokens=6,
+                      decode_strategy="greedy")
+    p = ours.generate(Tensor(ids), max_new_tokens=6,
+                      decode_strategy="greedy", use_paged_cache=True)
+    da = (d[0] if isinstance(d, (tuple, list)) else d).numpy()
+    pa = (p[0] if isinstance(p, (tuple, list)) else p).numpy()
+    np.testing.assert_array_equal(np.asarray(da), hf_gen.numpy())
+    np.testing.assert_array_equal(np.asarray(da), np.asarray(pa))
