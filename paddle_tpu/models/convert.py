@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["llama_from_hf", "bert_from_hf", "gpt2_from_hf",
-           "mistral_from_hf", "qwen2_from_hf", "gemma_from_hf"]
+           "mistral_from_hf", "qwen2_from_hf", "gemma_from_hf",
+           "t5_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -368,6 +369,92 @@ def gemma_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
             hidden_act="gelu_tanh",
             embed_scale=float(_math.sqrt(config.hidden_size)),
             tie_word_embeddings=True))
+
+
+def t5_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+               config=None, dtype: str = "float32"):
+    """Build a T5ForConditionalGeneration carrying a transformers T5
+    checkpoint (encoder + decoder + shared embedding + relative
+    position biases)."""
+    from .t5 import T5Config, T5ForConditionalGeneration
+
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    cfg = T5Config(
+        vocab_size=config.vocab_size,
+        d_model=config.d_model,
+        d_kv=config.d_kv,
+        d_ff=config.d_ff,
+        num_layers=config.num_layers,
+        num_decoder_layers=getattr(config, "num_decoder_layers",
+                                   config.num_layers),
+        num_heads=config.num_heads,
+        relative_attention_num_buckets=
+        config.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(
+            config, "relative_attention_max_distance", 128),
+        layer_norm_epsilon=config.layer_norm_epsilon,
+        feed_forward_proj=config.feed_forward_proj,
+        tie_word_embeddings=bool(config.tie_word_embeddings),
+        pad_token_id=config.pad_token_id,
+        decoder_start_token_id=getattr(config, "decoder_start_token_id",
+                                       config.pad_token_id) or 0,
+    )
+    model = T5ForConditionalGeneration(cfg)
+    cast = lambda a: jnp.asarray(a, dtype=dtype)
+    model.shared.weight._data = cast(sd["shared.weight"])
+    if not cfg.tie_word_embeddings:
+        model.lm_head.weight._data = cast(sd["lm_head.weight"].T)
+
+    def load_stack(stack, side, n):
+        stack.final_norm.weight._data = cast(
+            sd[f"{side}.final_layer_norm.weight"])
+        for i in range(n):
+            blk = stack.blocks[i]
+            p = f"{side}.block.{i}.layer."
+            a = blk.self_attn
+            a.q.weight._data = cast(sd[p + "0.SelfAttention.q.weight"].T)
+            a.k.weight._data = cast(sd[p + "0.SelfAttention.k.weight"].T)
+            a.v.weight._data = cast(sd[p + "0.SelfAttention.v.weight"].T)
+            a.o.weight._data = cast(sd[p + "0.SelfAttention.o.weight"].T)
+            if a.rel_bias is not None:
+                a.rel_bias.weight._data = cast(
+                    sd[p + "0.SelfAttention.relative_attention_bias"
+                       ".weight"])
+            blk.ln_self.weight._data = cast(sd[p + "0.layer_norm.weight"])
+            li = 1
+            if blk.is_decoder:
+                ca = blk.cross_attn
+                ca.q.weight._data = cast(
+                    sd[p + "1.EncDecAttention.q.weight"].T)
+                ca.k.weight._data = cast(
+                    sd[p + "1.EncDecAttention.k.weight"].T)
+                ca.v.weight._data = cast(
+                    sd[p + "1.EncDecAttention.v.weight"].T)
+                ca.o.weight._data = cast(
+                    sd[p + "1.EncDecAttention.o.weight"].T)
+                blk.ln_cross.weight._data = cast(
+                    sd[p + "1.layer_norm.weight"])
+                li = 2
+            ff = blk.ff
+            if ff.gated:
+                ff.wi_0.weight._data = cast(
+                    sd[p + f"{li}.DenseReluDense.wi_0.weight"].T)
+                ff.wi_1.weight._data = cast(
+                    sd[p + f"{li}.DenseReluDense.wi_1.weight"].T)
+            else:
+                ff.wi.weight._data = cast(
+                    sd[p + f"{li}.DenseReluDense.wi.weight"].T)
+            ff.wo.weight._data = cast(
+                sd[p + f"{li}.DenseReluDense.wo.weight"].T)
+            blk.ln_ff.weight._data = cast(sd[p + f"{li}.layer_norm"
+                                             ".weight"])
+
+    load_stack(model.encoder, "encoder", cfg.num_layers)
+    load_stack(model.decoder, "decoder", cfg.num_decoder_layers)
+    return model
 
 
 def mistral_from_hf(hf_model=None, state_dict: Optional[Dict] = None,

@@ -1,0 +1,256 @@
+"""T5 encoder-decoder family (ref: PaddleNLP transformers/t5/modeling.py
+— T5 is the reference zoo's flagship encoder-decoder, exercising the
+two mechanisms the decoder-only families never touch: CROSS-attention
+and RELATIVE POSITION BIAS).
+
+TPU-native notes:
+- T5LayerNorm is exactly our fused RMSNorm (no mean subtraction, no
+  bias) — reused, not re-implemented;
+- attention is UNSCALED (no 1/sqrt(d) — T5 folds it into init) with a
+  learned [buckets, heads] bias shared from each stack's first block;
+  the bucket matrix is a static-shape numpy constant per (qlen, klen),
+  so under jit it is baked, never gathered dynamically;
+- everything flows through the call_op chokepoint (tape/AMP/capture),
+  so the stack trains, jits, and records like every other family.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["T5Config", "T5ForConditionalGeneration"]
+
+
+@dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"      # "relu" | "gated-gelu"
+    tie_word_embeddings: bool = True
+    pad_token_id: int = 0
+    decoder_start_token_id: int = 0
+
+    def __post_init__(self):
+        if self.num_decoder_layers is None:
+            self.num_decoder_layers = self.num_layers
+
+
+def _relative_position_bucket(rel_pos: np.ndarray, bidirectional: bool,
+                              num_buckets: int, max_distance: int):
+    """The T5 bucketing function (numpy, static shapes)."""
+    ret = np.zeros_like(rel_pos)
+    n = num_buckets
+    if bidirectional:
+        n //= 2
+        ret += (rel_pos > 0).astype(rel_pos.dtype) * n
+        rel = np.abs(rel_pos)
+    else:
+        rel = -np.minimum(rel_pos, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        np.log(np.maximum(rel, 1) / max_exact)
+        / np.log(max_distance / max_exact) * (n - max_exact)
+    ).astype(rel_pos.dtype)
+    large = np.minimum(large, n - 1)
+    return ret + np.where(is_small, rel, large)
+
+
+class T5LayerNorm(nn.Layer):
+    def __init__(self, d: int, eps: float):
+        super().__init__()
+        from ..nn.initializer import Constant
+        from ..framework.param_attr import ParamAttr
+        self.weight = self.create_parameter(
+            [d], attr=ParamAttr(initializer=Constant(1.0)))
+        self.eps = eps
+
+    def forward(self, x):
+        from ..incubate.nn.functional import fused_rms_norm
+        out, _ = fused_rms_norm(x, self.weight, epsilon=self.eps)
+        return out
+
+
+class T5Attention(nn.Layer):
+    def __init__(self, c: T5Config, has_rel_bias: bool, causal: bool):
+        super().__init__()
+        inner = c.num_heads * c.d_kv
+        self.q = nn.Linear(c.d_model, inner, bias_attr=False)
+        self.k = nn.Linear(c.d_model, inner, bias_attr=False)
+        self.v = nn.Linear(c.d_model, inner, bias_attr=False)
+        self.o = nn.Linear(inner, c.d_model, bias_attr=False)
+        self.n_heads, self.d_kv, self.causal = c.num_heads, c.d_kv, causal
+        self.cfg = c
+        self.rel_bias = None
+        if has_rel_bias:
+            self.rel_bias = nn.Embedding(
+                c.relative_attention_num_buckets, c.num_heads)
+
+    def _position_bias(self, qlen: int, klen: int) -> Tensor:
+        """[1, heads, qlen, klen] learned bias via static buckets."""
+        ctx = np.arange(qlen)[:, None]
+        mem = np.arange(klen)[None, :]
+        buckets = _relative_position_bucket(
+            mem - ctx, bidirectional=not self.causal,
+            num_buckets=self.cfg.relative_attention_num_buckets,
+            max_distance=self.cfg.relative_attention_max_distance)
+        b = self.rel_bias(Tensor(buckets.astype("int64")))  # [q, k, h]
+        return b.transpose([2, 0, 1]).unsqueeze(0)
+
+    def forward(self, x, kv=None, position_bias=None):
+        """x [B, Sq, D]; kv (cross-attention memory) [B, Sk, D].
+        Returns (out, position_bias) so the stack's first block shares
+        its bias with the rest (the T5 contract)."""
+        B, Sq = x.shape[0], x.shape[1]
+        mem = x if kv is None else kv
+        Sk = mem.shape[1]
+        h, dk = self.n_heads, self.d_kv
+        q = self.q(x).reshape([B, Sq, h, dk]).transpose([0, 2, 1, 3])
+        k = self.k(mem).reshape([B, Sk, h, dk]).transpose([0, 2, 1, 3])
+        v = self.v(mem).reshape([B, Sk, h, dk]).transpose([0, 2, 1, 3])
+        scores = paddle.matmul(q, k, transpose_y=True)   # UNSCALED
+        if position_bias is None and self.rel_bias is not None:
+            position_bias = self._position_bias(Sq, Sk)
+        if position_bias is not None:
+            scores = scores + position_bias
+        if self.causal and kv is None:
+            mask = np.triu(np.full((Sq, Sk), -1e9, "float32"),
+                           k=Sk - Sq + 1)
+            scores = scores + Tensor(mask[None, None])
+        probs = F.softmax(scores, axis=-1)
+        ctx = paddle.matmul(probs, v)                    # [B, h, Sq, dk]
+        ctx = ctx.transpose([0, 2, 1, 3]).reshape([B, Sq, h * dk])
+        return self.o(ctx), position_bias
+
+
+class T5FF(nn.Layer):
+    _ACTS = {"relu": F.relu, "gelu": lambda x: F.gelu(x, approximate=True),
+             "gelu_new": lambda x: F.gelu(x, approximate=True),
+             "silu": F.silu}
+
+    def __init__(self, c: T5Config):
+        super().__init__()
+        proj = c.feed_forward_proj
+        self.gated = proj.startswith("gated-")
+        act = proj[len("gated-"):] if self.gated else proj
+        if act not in self._ACTS:
+            raise ValueError(
+                f"feed_forward_proj={proj!r} is not supported "
+                f"(activations: {sorted(self._ACTS)}, optionally "
+                "'gated-' prefixed)")
+        self._act = self._ACTS[act]
+        if self.gated:
+            self.wi_0 = nn.Linear(c.d_model, c.d_ff, bias_attr=False)
+            self.wi_1 = nn.Linear(c.d_model, c.d_ff, bias_attr=False)
+        else:
+            self.wi = nn.Linear(c.d_model, c.d_ff, bias_attr=False)
+        self.wo = nn.Linear(c.d_ff, c.d_model, bias_attr=False)
+
+    def forward(self, x):
+        if self.gated:
+            return self.wo(self._act(self.wi_0(x)) * self.wi_1(x))
+        return self.wo(self._act(self.wi(x)))
+
+
+class T5Block(nn.Layer):
+    def __init__(self, c: T5Config, is_decoder: bool, has_rel_bias: bool):
+        super().__init__()
+        self.is_decoder = is_decoder
+        self.ln_self = T5LayerNorm(c.d_model, c.layer_norm_epsilon)
+        self.self_attn = T5Attention(c, has_rel_bias, causal=is_decoder)
+        if is_decoder:
+            self.ln_cross = T5LayerNorm(c.d_model, c.layer_norm_epsilon)
+            self.cross_attn = T5Attention(c, False, causal=False)
+        self.ln_ff = T5LayerNorm(c.d_model, c.layer_norm_epsilon)
+        self.ff = T5FF(c)
+
+    def forward(self, x, memory=None, position_bias=None):
+        a, position_bias = self.self_attn(self.ln_self(x),
+                                          position_bias=position_bias)
+        x = x + a
+        if self.is_decoder:
+            ca, _ = self.cross_attn(self.ln_cross(x), kv=memory)
+            x = x + ca
+        x = x + self.ff(self.ln_ff(x))
+        return x, position_bias
+
+
+class T5Stack(nn.Layer):
+    def __init__(self, c: T5Config, embed, is_decoder: bool):
+        super().__init__()
+        self.embed = embed
+        n = c.num_decoder_layers if is_decoder else c.num_layers
+        self.blocks = nn.LayerList(
+            [T5Block(c, is_decoder, has_rel_bias=(i == 0))
+             for i in range(n)])
+        self.final_norm = T5LayerNorm(c.d_model, c.layer_norm_epsilon)
+
+    def forward(self, ids, memory=None):
+        x = self.embed(ids)
+        bias = None
+        for blk in self.blocks:
+            x, bias = blk(x, memory=memory, position_bias=bias)
+        return self.final_norm(x)
+
+
+class T5ForConditionalGeneration(nn.Layer):
+    """ref: t5/modeling.py T5ForConditionalGeneration."""
+
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.shared = nn.Embedding(config.vocab_size, config.d_model)
+        self.encoder = T5Stack(config, self.shared, is_decoder=False)
+        self.decoder = T5Stack(config, self.shared, is_decoder=True)
+        if not config.tie_word_embeddings:
+            from ..framework.param_attr import ParamAttr
+            from ..nn.initializer import Normal
+            self.lm_head = nn.Linear(config.d_model, config.vocab_size,
+                                     bias_attr=False,
+                                     weight_attr=ParamAttr(
+                                         initializer=Normal(std=0.02)))
+
+    def _head(self, h):
+        if self.config.tie_word_embeddings:
+            # T5 scales the decoder output when the head is tied
+            h = h * (self.config.d_model ** -0.5)
+            return paddle.matmul(h, self.shared.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, decoder_input_ids):
+        memory = self.encoder(input_ids)
+        return self._head(self.decoder(decoder_input_ids, memory=memory))
+
+    def loss_fn(self, logits, labels):
+        V = self.config.vocab_size
+        return F.cross_entropy(logits.reshape([-1, V]),
+                               labels.reshape([-1]), ignore_index=-100,
+                               reduction="mean")
+
+    def generate(self, input_ids, max_new_tokens: int = 20):
+        """Greedy seq2seq decode (recompute each step — the oracle
+        path; serving uses the decoder-only families' cached stacks)."""
+        B = input_ids.shape[0]
+        dec = np.full((B, 1), self.config.decoder_start_token_id, "int64")
+        memory = self.encoder(input_ids)
+        for _ in range(max_new_tokens):
+            h = self.decoder(Tensor(dec), memory=memory)
+            logits = self._head(h[:, -1:])     # only the new position
+            nxt = np.asarray(logits[:, 0].numpy()).argmax(-1)
+            dec = np.concatenate([dec, nxt[:, None].astype("int64")], 1)
+        return Tensor(dec)
