@@ -47,6 +47,124 @@ def _sample(logits_row, decode_strategy, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def _reorder_past(past, beam_idx):
+    """Reorder a dense per-layer (k, v) cache along the batch axis (the
+    beam permutation after each step — ref: GenerationMixin
+    _reorder_cache)."""
+    out = []
+    for k, v in past:
+        out.append((Tensor(jnp.asarray(k._data)[beam_idx]),
+                    Tensor(jnp.asarray(v._data)[beam_idx])))
+    return out
+
+
+def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
+                 eos_token_id, supports_cache, last_only):
+    """HF-semantics beam search (ref: PaddleNLP GenerationMixin
+    beam_search + transformers BeamSearchScorer): per-batch
+    BeamHypotheses with score = sum_logprobs / len**length_penalty,
+    2*num_beams candidate expansion so eos candidates never starve the
+    live set, cache rows permuted by the chosen beam indices."""
+    B, prompt_len = int(arr.shape[0]), int(arr.shape[1])
+    nb = int(num_beams)
+    # expand each row to nb beams; first beam active, rest -inf so the
+    # first step picks nb DISTINCT continuations of the prompt
+    arr = jnp.repeat(arr, nb, axis=0)
+    beam_scores = jnp.full((B, nb), -1e9, jnp.float32).at[:, 0].set(0.0)
+    hyps = [[] for _ in range(B)]      # (score, token_array)
+    done = [False] * B                 # pool frozen (HF is_done)
+
+    past = None
+    if supports_cache:
+        kw = {"last_logits_only": True} if last_only else {}
+        logits, past = model(Tensor(arr), use_cache=True, **kw)
+    else:
+        logits = model(Tensor(arr))
+
+    for _ in range(int(max_new_tokens)):
+        logp = jax.nn.log_softmax(
+            jnp.asarray(logits._data)[:, -1, :].astype(jnp.float32), -1)
+        V = logp.shape[-1]
+        scores = beam_scores.reshape(B * nb, 1) + logp
+        scores = scores.reshape(B, nb * V)
+        top_s, top_i = jax.lax.top_k(scores, 2 * nb)
+        top_s = np.asarray(top_s)
+        top_i = np.asarray(top_i)
+        arr_np = np.asarray(arr)
+        beam_idx = np.zeros((B, nb), np.int64)
+        beam_tok = np.zeros((B, nb), np.int64)
+        new_scores = np.zeros((B, nb), np.float32)
+        for b in range(B):
+            if done[b]:
+                beam_idx[b, :] = b * nb
+                new_scores[b, :] = -1e9
+                continue
+            live = 0
+            for rank, (s, i) in enumerate(zip(top_s[b], top_i[b])):
+                src, tok = divmod(int(i), V)
+                if eos_token_id is not None and tok == eos_token_id:
+                    if rank >= nb:
+                        # HF BeamSearchScorer: an eos candidate outside
+                        # the top num_beams never forms a hypothesis
+                        continue
+                    seq = arr_np[b * nb + src]
+                    cur_len = seq.shape[0] + 1 - prompt_len
+                    hyps[b].append(
+                        (float(s) / (cur_len ** length_penalty),
+                         np.concatenate([seq, [eos_token_id]])))
+                    continue
+                if live < nb:
+                    beam_idx[b, live] = b * nb + src
+                    beam_tok[b, live] = tok
+                    new_scores[b, live] = s
+                    live += 1
+            if live < nb:          # pathological: pad with beam 0
+                beam_idx[b, live:] = b * nb
+                new_scores[b, live:] = -1e9
+            # is_done (early_stopping=False semantics): once nb
+            # hypotheses exist and the best live continuation cannot
+            # beat the worst of them, the pool freezes
+            if len(hyps[b]) >= nb:
+                cur_len = arr_np.shape[1] + 1 - prompt_len
+                best_live = float(new_scores[b].max()) / (
+                    cur_len ** length_penalty)
+                worst_kept = min(h[0] for h in hyps[b])
+                if worst_kept >= best_live:
+                    done[b] = True
+        if all(done):
+            break
+        flat_idx = jnp.asarray(beam_idx.reshape(-1))
+        arr = jnp.concatenate(
+            [jnp.asarray(arr)[flat_idx],
+             jnp.asarray(beam_tok.reshape(-1, 1), arr.dtype)], axis=1)
+        beam_scores = jnp.asarray(new_scores)
+        if supports_cache:
+            past = _reorder_past(past, flat_idx)
+            logits, past = model(Tensor(arr[:, -1:]), past=past,
+                                 use_cache=True)
+        else:
+            logits = model(Tensor(arr))
+
+    # finalize: UNDONE batches' live beams join the hypothesis pools
+    arr_np = np.asarray(arr)
+    bs = np.asarray(beam_scores)
+    gen_len = arr_np.shape[1] - prompt_len
+    for b in range(B):
+        if done[b]:
+            continue
+        for j in range(nb):
+            hyps[b].append(
+                (float(bs[b, j]) / (max(gen_len, 1) ** length_penalty),
+                 arr_np[b * nb + j]))
+    best = [max(h, key=lambda t: t[0])[1] for h in hyps]
+    width = max(len(s) for s in best)
+    pad = eos_token_id if eos_token_id is not None else 0
+    out = np.full((B, width), pad, arr_np.dtype)
+    for b, s in enumerate(best):
+        out[b, :len(s)] = s
+    return Tensor(jnp.asarray(out))
+
+
 def _to_paged(past, batch, max_total):
     """Convert a dense prefill cache (per-layer (k, v) of
     [B, S, nkv, hd]) into per-layer page pools + views (ref role: the
@@ -68,6 +186,7 @@ def generate(model, input_ids, max_new_tokens: int = 20,
              decode_strategy: str = "greedy_search",
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None,
+             num_beams: int = 1, length_penalty: float = 1.0,
              use_cache: bool = True, use_paged_cache: bool = False,
              **unused):
     """Returns a Tensor [B, S_prompt + n_generated] of token ids."""
@@ -98,6 +217,22 @@ def generate(model, input_ids, max_new_tokens: int = 20,
         model.eval()
     try:
         arr = jnp.asarray(ids._data)
+        if decode_strategy == "beam_search" or num_beams > 1:
+            if decode_strategy not in ("beam_search", "greedy_search",
+                                       "greedy"):
+                raise NotImplementedError(
+                    f"num_beams={num_beams} with decode_strategy="
+                    f"{decode_strategy!r}: beam-sampling is not "
+                    "implemented — temperature/top_k/top_p would be "
+                    "silently ignored")
+            if use_paged_cache:
+                raise ValueError(
+                    "beam search reorders cache rows every step; the "
+                    "page pool does not support row permutation — use "
+                    "the dense cache (use_paged_cache=False)")
+            return _beam_search(model, arr, max_new_tokens,
+                                max(num_beams, 2), length_penalty,
+                                eos_token_id, supports_cache, last_only)
         finished = jnp.zeros((arr.shape[0],), bool)
         past = None
         if supports_cache:

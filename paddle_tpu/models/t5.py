@@ -43,6 +43,7 @@ class T5Config:
     feed_forward_proj: str = "relu"      # "relu" | "gated-gelu"
     tie_word_embeddings: bool = True
     pad_token_id: int = 0
+    eos_token_id: int = 1
     decoder_start_token_id: int = 0
 
     def __post_init__(self):
@@ -112,10 +113,11 @@ class T5Attention(nn.Layer):
         b = self.rel_bias(Tensor(buckets.astype("int64")))  # [q, k, h]
         return b.transpose([2, 0, 1]).unsqueeze(0)
 
-    def forward(self, x, kv=None, position_bias=None):
-        """x [B, Sq, D]; kv (cross-attention memory) [B, Sk, D].
-        Returns (out, position_bias) so the stack's first block shares
-        its bias with the rest (the T5 contract)."""
+    def forward(self, x, kv=None, position_bias=None, key_mask=None):
+        """x [B, Sq, D]; kv (cross-attention memory) [B, Sk, D];
+        key_mask [B, Sk] 1=attend, 0=pad.  Returns (out, position_bias)
+        so the stack's first block shares its bias with the rest (the
+        T5 contract)."""
         B, Sq = x.shape[0], x.shape[1]
         mem = x if kv is None else kv
         Sk = mem.shape[1]
@@ -128,6 +130,9 @@ class T5Attention(nn.Layer):
             position_bias = self._position_bias(Sq, Sk)
         if position_bias is not None:
             scores = scores + position_bias
+        if key_mask is not None:
+            neg = (1.0 - key_mask.astype("float32")) * -1e9
+            scores = scores + neg.reshape([B, 1, 1, Sk])
         if self.causal and kv is None:
             mask = np.triu(np.full((Sq, Sk), -1e9, "float32"),
                            k=Sk - Sq + 1)
@@ -179,12 +184,15 @@ class T5Block(nn.Layer):
         self.ln_ff = T5LayerNorm(c.d_model, c.layer_norm_epsilon)
         self.ff = T5FF(c)
 
-    def forward(self, x, memory=None, position_bias=None):
+    def forward(self, x, memory=None, position_bias=None,
+                self_mask=None, memory_mask=None):
         a, position_bias = self.self_attn(self.ln_self(x),
-                                          position_bias=position_bias)
+                                          position_bias=position_bias,
+                                          key_mask=self_mask)
         x = x + a
         if self.is_decoder:
-            ca, _ = self.cross_attn(self.ln_cross(x), kv=memory)
+            ca, _ = self.cross_attn(self.ln_cross(x), kv=memory,
+                                    key_mask=memory_mask)
             x = x + ca
         x = x + self.ff(self.ln_ff(x))
         return x, position_bias
@@ -200,11 +208,13 @@ class T5Stack(nn.Layer):
              for i in range(n)])
         self.final_norm = T5LayerNorm(c.d_model, c.layer_norm_epsilon)
 
-    def forward(self, ids, memory=None):
+    def forward(self, ids, memory=None, self_mask=None,
+                memory_mask=None):
         x = self.embed(ids)
         bias = None
         for blk in self.blocks:
-            x, bias = blk(x, memory=memory, position_bias=bias)
+            x, bias = blk(x, memory=memory, position_bias=bias,
+                          self_mask=self_mask, memory_mask=memory_mask)
         return self.final_norm(x)
 
 
@@ -232,9 +242,14 @@ class T5ForConditionalGeneration(nn.Layer):
             return paddle.matmul(h, self.shared.weight, transpose_y=True)
         return self.lm_head(h)
 
-    def forward(self, input_ids, decoder_input_ids):
-        memory = self.encoder(input_ids)
-        return self._head(self.decoder(decoder_input_ids, memory=memory))
+    def forward(self, input_ids, decoder_input_ids,
+                attention_mask=None):
+        """``attention_mask`` [B, S_enc]: 1=token, 0=pad — masks both
+        the encoder self-attention and the decoder cross-attention
+        (the standard padded seq2seq batch)."""
+        memory = self.encoder(input_ids, self_mask=attention_mask)
+        return self._head(self.decoder(decoder_input_ids, memory=memory,
+                                       memory_mask=attention_mask))
 
     def loss_fn(self, logits, labels):
         V = self.config.vocab_size
@@ -242,15 +257,27 @@ class T5ForConditionalGeneration(nn.Layer):
                                labels.reshape([-1]), ignore_index=-100,
                                reduction="mean")
 
-    def generate(self, input_ids, max_new_tokens: int = 20):
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 attention_mask=None, eos_token_id=None):
         """Greedy seq2seq decode (recompute each step — the oracle
-        path; serving uses the decoder-only families' cached stacks)."""
+        path; serving uses the decoder-only families' cached stacks).
+        Rows that emit eos hold at pad, matching hf.generate."""
+        if eos_token_id is None:
+            eos_token_id = self.config.eos_token_id
+        pad = self.config.pad_token_id
         B = input_ids.shape[0]
         dec = np.full((B, 1), self.config.decoder_start_token_id, "int64")
-        memory = self.encoder(input_ids)
+        finished = np.zeros((B,), bool)
+        memory = self.encoder(input_ids, self_mask=attention_mask)
         for _ in range(max_new_tokens):
-            h = self.decoder(Tensor(dec), memory=memory)
+            h = self.decoder(Tensor(dec), memory=memory,
+                             memory_mask=attention_mask)
             logits = self._head(h[:, -1:])     # only the new position
             nxt = np.asarray(logits[:, 0].numpy()).argmax(-1)
+            nxt = np.where(finished, pad, nxt)
             dec = np.concatenate([dec, nxt[:, None].astype("int64")], 1)
+            if eos_token_id is not None:
+                finished |= nxt == eos_token_id
+                if finished.all():
+                    break
         return Tensor(dec)

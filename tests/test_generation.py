@@ -163,3 +163,52 @@ def test_gpt_past_without_use_cache_is_consumed():
     full = m(Tensor(ids)).numpy()
     np.testing.assert_allclose(scored.numpy()[:, 0], full[:, -1],
                                rtol=1e-4, atol=1e-5)
+
+
+def test_beam_search_matches_transformers():
+    """decode_strategy='beam_search' (ref: GenerationMixin beam_search):
+    HF-semantics scorer — 2*num_beams expansion, per-batch hypotheses
+    with length penalty, cache rows permuted by beam index — must match
+    transformers token for token, with and without eos."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.models.convert import gpt2_from_hf
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager", eos_token_id=None,
+        bos_token_id=None)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ours = gpt2_from_hf(hf)
+    ours.eval()
+    ids = np.array([[3, 9, 30, 4], [12, 40, 2, 5]], "int64")
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                           num_beams=3, do_sample=False,
+                           eos_token_id=None, pad_token_id=0).numpy()
+    got = np.asarray(ours.generate(
+        Tensor(ids), max_new_tokens=8, decode_strategy="beam_search",
+        num_beams=3).numpy())
+    np.testing.assert_array_equal(got, want)
+    with torch.no_grad():
+        want2 = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                            num_beams=3, do_sample=False,
+                            eos_token_id=17, pad_token_id=17).numpy()
+    got2 = np.asarray(ours.generate(
+        Tensor(ids), max_new_tokens=8, decode_strategy="beam_search",
+        num_beams=3, eos_token_id=17).numpy())
+    np.testing.assert_array_equal(got2[:, :want2.shape[1]], want2)
+
+
+def test_beam_search_rejects_paged_cache():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(0)
+    m = GPTForPretraining(GPTConfig(num_layers=1, hidden_size=32,
+                                    num_heads=4, vocab_size=64,
+                                    max_position_embeddings=32))
+    m.eval()
+    with pytest.raises(ValueError, match="page pool"):
+        m.generate(Tensor(np.array([[1, 2]], "int64")), max_new_tokens=2,
+                   decode_strategy="beam_search", num_beams=2,
+                   use_paged_cache=True)

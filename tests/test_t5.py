@@ -92,3 +92,32 @@ def test_t5_trains():
     # the relative bias actually learned (gradient reached it)
     rb = m.encoder.blocks[0].self_attn.rel_bias.weight
     assert float(paddle.abs(rb).sum()) > 0
+
+
+def test_t5_attention_mask_and_eos_generate():
+    """Padded encoder batches (attention_mask) and eos-terminated
+    greedy decode both match transformers."""
+    hf, ours = _pair(seed=3)
+    rs = np.random.RandomState(3)
+    enc = rs.randint(2, 64, (2, 10)).astype("int64")
+    mask = np.ones((2, 10), "int64")
+    mask[1, 6:] = 0
+    enc[1, 6:] = 0
+    dec = rs.randint(2, 64, (2, 5)).astype("int64")
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(enc),
+                  attention_mask=torch.tensor(mask),
+                  decoder_input_ids=torch.tensor(dec)).logits.numpy()
+    got = np.asarray(ours(Tensor(enc), Tensor(dec),
+                          attention_mask=Tensor(mask)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    with torch.no_grad():
+        wg = hf.generate(torch.tensor(enc),
+                         attention_mask=torch.tensor(mask),
+                         max_new_tokens=8, do_sample=False,
+                         eos_token_id=44, pad_token_id=0).numpy()
+    og = np.asarray(ours.generate(Tensor(enc), max_new_tokens=8,
+                                  attention_mask=Tensor(mask),
+                                  eos_token_id=44).numpy())
+    assert (wg == 44).any()            # eos actually fired in the oracle
+    np.testing.assert_array_equal(og[:, :wg.shape[1]], wg)
