@@ -59,7 +59,8 @@ def _reorder_past(past, beam_idx):
 
 
 def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
-                 eos_token_id, supports_cache, last_only):
+                 eos_token_id, supports_cache, last_only,
+                 pad_token_id=None):
     """HF-semantics beam search (ref: PaddleNLP GenerationMixin
     beam_search + transformers BeamSearchScorer): per-batch
     BeamHypotheses with score = sum_logprobs / len**length_penalty,
@@ -112,6 +113,10 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
                     hyps[b].append(
                         (float(s) / (cur_len ** length_penalty),
                          np.concatenate([seq, [eos_token_id]])))
+                    if len(hyps[b]) > nb:
+                        # HF BeamHypotheses: keep only the best nb
+                        hyps[b].remove(min(hyps[b],
+                                           key=lambda t: t[0]))
                     continue
                 if live < nb:
                     beam_idx[b, live] = b * nb + src
@@ -126,10 +131,12 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
             # beat the worst of them, the pool freezes
             if len(hyps[b]) >= nb:
                 cur_len = arr_np.shape[1] + 1 - prompt_len
-                best_live = float(new_scores[b].max()) / (
+                # HF is_done: best over ALL 2*nb candidates (incl. the
+                # eos ones) vs the worst KEPT hypothesis
+                best_possible = float(top_s[b][0]) / (
                     cur_len ** length_penalty)
                 worst_kept = min(h[0] for h in hyps[b])
-                if worst_kept >= best_live:
+                if worst_kept >= best_possible:
                     done[b] = True
         if all(done):
             break
@@ -158,7 +165,8 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
                  arr_np[b * nb + j]))
     best = [max(h, key=lambda t: t[0])[1] for h in hyps]
     width = max(len(s) for s in best)
-    pad = eos_token_id if eos_token_id is not None else 0
+    pad = pad_token_id if pad_token_id is not None else (
+        eos_token_id if eos_token_id is not None else 0)
     out = np.full((B, width), pad, arr_np.dtype)
     for b, s in enumerate(best):
         out[b, :len(s)] = s
@@ -187,6 +195,7 @@ def generate(model, input_ids, max_new_tokens: int = 20,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None,
              num_beams: int = 1, length_penalty: float = 1.0,
+             pad_token_id: Optional[int] = None,
              use_cache: bool = True, use_paged_cache: bool = False,
              **unused):
     """Returns a Tensor [B, S_prompt + n_generated] of token ids."""
@@ -217,7 +226,9 @@ def generate(model, input_ids, max_new_tokens: int = 20,
         model.eval()
     try:
         arr = jnp.asarray(ids._data)
-        if decode_strategy == "beam_search" or num_beams > 1:
+        # num_beams == 1 beam_search degenerates to greedy (the HF /
+        # PaddleNLP convention)
+        if num_beams > 1:
             if decode_strategy not in ("beam_search", "greedy_search",
                                        "greedy"):
                 raise NotImplementedError(
@@ -231,8 +242,9 @@ def generate(model, input_ids, max_new_tokens: int = 20,
                     "page pool does not support row permutation — use "
                     "the dense cache (use_paged_cache=False)")
             return _beam_search(model, arr, max_new_tokens,
-                                max(num_beams, 2), length_penalty,
-                                eos_token_id, supports_cache, last_only)
+                                num_beams, length_penalty,
+                                eos_token_id, supports_cache, last_only,
+                                pad_token_id=pad_token_id)
         finished = jnp.zeros((arr.shape[0],), bool)
         past = None
         if supports_cache:

@@ -121,3 +121,18 @@ def test_t5_attention_mask_and_eos_generate():
                                   eos_token_id=44).numpy())
     assert (wg == 44).any()            # eos actually fired in the oracle
     np.testing.assert_array_equal(og[:, :wg.shape[1]], wg)
+
+
+def test_t5_beam_search_matches_transformers():
+    """num_beams > 1 routes through the shared HF-semantics beam
+    scorer over the seq2seq decoder."""
+    hf, ours = _pair(seed=3)
+    enc = np.random.RandomState(3).randint(2, 64, (2, 10)).astype("int64")
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(enc), max_new_tokens=8,
+                           num_beams=3, do_sample=False,
+                           eos_token_id=44, pad_token_id=0).numpy()
+    got = np.asarray(ours.generate(Tensor(enc), max_new_tokens=8,
+                                   num_beams=3,
+                                   eos_token_id=44).numpy())
+    np.testing.assert_array_equal(got[:, :want.shape[1]], want)
