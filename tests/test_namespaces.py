@@ -522,6 +522,8 @@ def test_reference_api_spot_names_resolve():
     paths = [
         "nn.TransformerEncoder", "nn.MultiHeadAttention",
         "static.nn.fc", "static.nn.conv2d", "static.nn.batch_norm",
+        "static.nn.cond", "static.nn.while_loop", "static.nn.case",
+        "static.nn.switch_case", "jit.sot.stats",
         "vision.models.resnet50", "vision.ops.roi_align",
         "incubate.nn.FusedMultiHeadAttention",
         "incubate.nn.FusedFeedForward", "incubate.nn.FusedLinear",

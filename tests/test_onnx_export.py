@@ -562,3 +562,23 @@ def test_export_llama_qkv_bias(tmp_path):
     got = got[0] if isinstance(got, (list, tuple)) else got
     want = np.asarray(m(paddle.to_tensor(ids)).numpy())
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_export_llama_dynamic_batch(tmp_path):
+    """Twin-trace symbolic batch works through the transformer
+    lowerings (rope's constant rotation matmul is shape-agnostic)."""
+    from paddle_tpu.jit.to_static import InputSpec
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_config
+    paddle.seed(0)
+    cfg = llama_config("tiny", num_layers=2, hidden_size=32, num_heads=4,
+                       num_kv_heads=2, vocab_size=64,
+                       intermediate_size=64, max_position_embeddings=32)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    p = export(m, str(tmp_path / "llama_dyn"),
+               input_spec=[InputSpec([None, 16], "int64")])
+    ids = np.random.RandomState(1).randint(0, 64, (5, 16)).astype("int64")
+    got = run_model(p, ids)
+    got = got[0] if isinstance(got, (tuple, list)) else got
+    want = np.asarray(m(paddle.to_tensor(ids)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
