@@ -1,0 +1,197 @@
+"""BART encoder-decoder family (ref: PaddleNLP transformers/bart —
+the denoising seq2seq of the reference canon).  Complements T5 with the
+POST-layernorm convention, learned positions (the +2 offset), scaled
+attention with biased projections, and the final-logits bias.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["BartConfig", "BartForConditionalGeneration"]
+
+_POS_OFFSET = 2        # HF BartLearnedPositionalEmbedding offset
+
+
+@dataclass
+class BartConfig:
+    vocab_size: int = 50265
+    d_model: int = 768
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 12
+    decoder_attention_heads: int = 12
+    encoder_ffn_dim: int = 3072
+    decoder_ffn_dim: int = 3072
+    max_position_embeddings: int = 1024
+    activation_function: str = "gelu"
+    scale_embedding: bool = False
+    pad_token_id: int = 1
+    eos_token_id: int = 2
+    decoder_start_token_id: int = 2
+    forced_eos_token_id: Optional[int] = 2
+
+
+class _BartAttention(nn.Layer):
+    def __init__(self, d_model: int, n_heads: int, causal: bool):
+        super().__init__()
+        self.q_proj = nn.Linear(d_model, d_model)
+        self.k_proj = nn.Linear(d_model, d_model)
+        self.v_proj = nn.Linear(d_model, d_model)
+        self.out_proj = nn.Linear(d_model, d_model)
+        self.h = n_heads
+        self.dk = d_model // n_heads
+        self.causal = causal
+
+    def forward(self, x, kv=None, key_mask=None):
+        B, Sq = x.shape[0], x.shape[1]
+        mem = x if kv is None else kv
+        Sk = mem.shape[1]
+        h, dk = self.h, self.dk
+        q = self.q_proj(x).reshape([B, Sq, h, dk]).transpose([0, 2, 1, 3])
+        k = self.k_proj(mem).reshape([B, Sk, h, dk]) \
+            .transpose([0, 2, 1, 3])
+        v = self.v_proj(mem).reshape([B, Sk, h, dk]) \
+            .transpose([0, 2, 1, 3])
+        scores = paddle.matmul(q, k, transpose_y=True) * (dk ** -0.5)
+        if key_mask is not None:
+            neg = (1.0 - key_mask.astype("float32")) * -1e9
+            scores = scores + neg.reshape([B, 1, 1, Sk])
+        if self.causal and kv is None:
+            mask = np.triu(np.full((Sq, Sk), -1e9, "float32"),
+                           k=Sk - Sq + 1)
+            scores = scores + Tensor(mask[None, None])
+        probs = F.softmax(scores, axis=-1)
+        ctx = paddle.matmul(probs, v).transpose([0, 2, 1, 3]) \
+            .reshape([B, Sq, h * dk])
+        return self.out_proj(ctx)
+
+
+class _BartLayer(nn.Layer):
+    """POST-layernorm block: LN(residual + sublayer(x))."""
+
+    def __init__(self, c: BartConfig, is_decoder: bool):
+        super().__init__()
+        d = c.d_model
+        heads = (c.decoder_attention_heads if is_decoder
+                 else c.encoder_attention_heads)
+        ffn = c.decoder_ffn_dim if is_decoder else c.encoder_ffn_dim
+        self.is_decoder = is_decoder
+        self.self_attn = _BartAttention(d, heads, causal=is_decoder)
+        self.self_attn_layer_norm = nn.LayerNorm(d)
+        if is_decoder:
+            self.encoder_attn = _BartAttention(d, heads, causal=False)
+            self.encoder_attn_layer_norm = nn.LayerNorm(d)
+        self.fc1 = nn.Linear(d, ffn)
+        self.fc2 = nn.Linear(ffn, d)
+        self.final_layer_norm = nn.LayerNorm(d)
+        acts = {"gelu": lambda x: F.gelu(x), "relu": F.relu,
+                "silu": F.silu,
+                "gelu_new": lambda x: F.gelu(x, approximate=True),
+                "gelu_fast": lambda x: F.gelu(x, approximate=True)}
+        if c.activation_function not in acts:
+            raise ValueError(
+                f"activation_function={c.activation_function!r} is not "
+                f"supported ({sorted(acts)})")
+        self._act = acts[c.activation_function]
+
+    def forward(self, x, memory=None, self_mask=None, memory_mask=None):
+        x = self.self_attn_layer_norm(
+            x + self.self_attn(x, key_mask=self_mask))
+        if self.is_decoder:
+            x = self.encoder_attn_layer_norm(
+                x + self.encoder_attn(x, kv=memory, key_mask=memory_mask))
+        return self.final_layer_norm(x + self.fc2(self._act(self.fc1(x))))
+
+
+class _BartStack(nn.Layer):
+    def __init__(self, c: BartConfig, embed, is_decoder: bool):
+        super().__init__()
+        self.embed_tokens = embed
+        self.embed_positions = nn.Embedding(
+            c.max_position_embeddings + _POS_OFFSET, c.d_model)
+        self.layernorm_embedding = nn.LayerNorm(c.d_model)
+        n = c.decoder_layers if is_decoder else c.encoder_layers
+        self.layers = nn.LayerList([_BartLayer(c, is_decoder)
+                                    for _ in range(n)])
+        self.scale = (c.d_model ** 0.5) if c.scale_embedding else 1.0
+
+    def forward(self, ids, memory=None, self_mask=None, memory_mask=None):
+        S = ids.shape[1]
+        pos = Tensor(np.arange(_POS_OFFSET, S + _POS_OFFSET,
+                               dtype="int64"))
+        x = self.embed_tokens(ids) * self.scale \
+            + self.embed_positions(pos)
+        x = self.layernorm_embedding(x)
+        for layer in self.layers:
+            x = layer(x, memory=memory, self_mask=self_mask,
+                      memory_mask=memory_mask)
+        return x
+
+
+class BartForConditionalGeneration(nn.Layer):
+    """ref: bart/modeling.py BartForConditionalGeneration."""
+
+    def __init__(self, config: BartConfig):
+        super().__init__()
+        self.config = config
+        self.shared = nn.Embedding(config.vocab_size, config.d_model)
+        self.encoder = _BartStack(config, self.shared, is_decoder=False)
+        self.decoder = _BartStack(config, self.shared, is_decoder=True)
+        self.final_logits_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+        self.final_logits_bias.stop_gradient = True
+
+    def _head(self, h):
+        return paddle.matmul(h, self.shared.weight, transpose_y=True) \
+            + self.final_logits_bias
+
+    def forward(self, input_ids, decoder_input_ids, attention_mask=None):
+        memory = self.encoder(input_ids, self_mask=attention_mask)
+        return self._head(self.decoder(decoder_input_ids, memory=memory,
+                                       memory_mask=attention_mask))
+
+    def loss_fn(self, logits, labels):
+        V = self.config.vocab_size
+        return F.cross_entropy(logits.reshape([-1, V]),
+                               labels.reshape([-1]), ignore_index=-100,
+                               reduction="mean")
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 attention_mask=None, eos_token_id=None,
+                 num_beams: int = 1, length_penalty: float = 1.0):
+        """Greedy / beam seq2seq decode via the shared
+        generation.seq2seq_generate (HF-semantics beam scorer,
+        forced-eos final slot per BART's config default)."""
+        import jax.numpy as jnp
+        from .generation import seq2seq_generate
+        if eos_token_id is None:
+            eos_token_id = self.config.eos_token_id
+        B = input_ids.shape[0]
+        nb = max(int(num_beams), 1)
+        memory = self.encoder(input_ids, self_mask=attention_mask)
+        mask = attention_mask
+        if nb > 1:
+            memory = Tensor(jnp.repeat(jnp.asarray(memory._data), nb,
+                                       axis=0))
+            if mask is not None:
+                mask = Tensor(jnp.repeat(jnp.asarray(mask._data), nb,
+                                         axis=0))
+
+        def decode_step(dec_ids):
+            return self._head(self.decoder(dec_ids, memory=memory,
+                                           memory_mask=mask))
+
+        return seq2seq_generate(
+            decode_step, self.config.decoder_start_token_id, B,
+            max_new_tokens, eos_token_id, self.config.pad_token_id,
+            num_beams=nb, length_penalty=length_penalty,
+            forced_eos_token_id=self.config.forced_eos_token_id,
+            max_positions=self.config.max_position_embeddings)

@@ -26,7 +26,7 @@ import numpy as np
 
 __all__ = ["llama_from_hf", "bert_from_hf", "gpt2_from_hf",
            "mistral_from_hf", "qwen2_from_hf", "gemma_from_hf",
-           "t5_from_hf"]
+           "t5_from_hf", "bart_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -454,6 +454,87 @@ def t5_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
 
     load_stack(model.encoder, "encoder", cfg.num_layers)
     load_stack(model.decoder, "decoder", cfg.num_decoder_layers)
+    return model
+
+
+def bart_from_hf(hf_model=None, state_dict: Optional[Dict] = None,
+                 config=None, dtype: str = "float32"):
+    """Build a BartForConditionalGeneration carrying a transformers
+    BART checkpoint (post-LN stacks, learned +2-offset positions,
+    final logits bias)."""
+    from .bart import BartConfig, BartForConditionalGeneration
+
+    if hf_model is not None:
+        state_dict = hf_model.state_dict()
+        config = hf_model.config
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    cfg = BartConfig(
+        vocab_size=config.vocab_size,
+        d_model=config.d_model,
+        encoder_layers=config.encoder_layers,
+        decoder_layers=config.decoder_layers,
+        encoder_attention_heads=config.encoder_attention_heads,
+        decoder_attention_heads=config.decoder_attention_heads,
+        encoder_ffn_dim=config.encoder_ffn_dim,
+        decoder_ffn_dim=config.decoder_ffn_dim,
+        max_position_embeddings=config.max_position_embeddings,
+        activation_function=config.activation_function,
+        scale_embedding=bool(getattr(config, "scale_embedding", False)),
+        pad_token_id=config.pad_token_id,
+        eos_token_id=config.eos_token_id,
+        decoder_start_token_id=config.decoder_start_token_id,
+        forced_eos_token_id=getattr(config, "forced_eos_token_id", None),
+    )
+    model = BartForConditionalGeneration(cfg)
+    import jax.numpy as jnp
+    cast = lambda a: jnp.asarray(a, dtype=dtype)
+    model.shared.weight._data = cast(sd["model.shared.weight"])
+    model.final_logits_bias._data = cast(
+        sd["final_logits_bias"].reshape(-1))
+
+    def load_stack(stack, side, n):
+        stack.embed_positions.weight._data = cast(
+            sd[f"model.{side}.embed_positions.weight"])
+        stack.layernorm_embedding.weight._data = cast(
+            sd[f"model.{side}.layernorm_embedding.weight"])
+        stack.layernorm_embedding.bias._data = cast(
+            sd[f"model.{side}.layernorm_embedding.bias"])
+        for i in range(n):
+            lyr = stack.layers[i]
+            p = f"model.{side}.layers.{i}."
+
+            def ld(mod, name):
+                mod.weight._data = cast(sd[p + name + ".weight"].T)
+                mod.bias._data = cast(sd[p + name + ".bias"])
+
+            for attr, key in (("q_proj", "self_attn.q_proj"),
+                              ("k_proj", "self_attn.k_proj"),
+                              ("v_proj", "self_attn.v_proj"),
+                              ("out_proj", "self_attn.out_proj")):
+                ld(getattr(lyr.self_attn, attr), key)
+            lyr.self_attn_layer_norm.weight._data = cast(
+                sd[p + "self_attn_layer_norm.weight"])
+            lyr.self_attn_layer_norm.bias._data = cast(
+                sd[p + "self_attn_layer_norm.bias"])
+            if lyr.is_decoder:
+                for attr, key in (("q_proj", "encoder_attn.q_proj"),
+                                  ("k_proj", "encoder_attn.k_proj"),
+                                  ("v_proj", "encoder_attn.v_proj"),
+                                  ("out_proj", "encoder_attn.out_proj")):
+                    ld(getattr(lyr.encoder_attn, attr), key)
+                lyr.encoder_attn_layer_norm.weight._data = cast(
+                    sd[p + "encoder_attn_layer_norm.weight"])
+                lyr.encoder_attn_layer_norm.bias._data = cast(
+                    sd[p + "encoder_attn_layer_norm.bias"])
+            ld(lyr.fc1, "fc1")
+            ld(lyr.fc2, "fc2")
+            lyr.final_layer_norm.weight._data = cast(
+                sd[p + "final_layer_norm.weight"])
+            lyr.final_layer_norm.bias._data = cast(
+                sd[p + "final_layer_norm.bias"])
+
+    load_stack(model.encoder, "encoder", cfg.encoder_layers)
+    load_stack(model.decoder, "decoder", cfg.decoder_layers)
     return model
 
 

@@ -60,13 +60,13 @@ def _reorder_past(past, beam_idx):
 
 def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
                  eos_token_id, supports_cache, last_only,
-                 pad_token_id=None):
+                 pad_token_id=None, forced_eos_token_id=None):
     """HF-semantics beam search (ref: PaddleNLP GenerationMixin
     beam_search + transformers BeamSearchScorer): per-batch
     BeamHypotheses with score = sum_logprobs / len**length_penalty,
     2*num_beams candidate expansion so eos candidates never starve the
     live set, cache rows permuted by the chosen beam indices."""
-    B, prompt_len = int(arr.shape[0]), int(arr.shape[1])
+    B = int(arr.shape[0])
     nb = int(num_beams)
     # expand each row to nb beams; first beam active, rest -inf so the
     # first step picks nb DISTINCT continuations of the prompt
@@ -82,10 +82,16 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
     else:
         logits = model(Tensor(arr))
 
-    for _ in range(int(max_new_tokens)):
+    for it in range(int(max_new_tokens)):
         logp = jax.nn.log_softmax(
             jnp.asarray(logits._data)[:, -1, :].astype(jnp.float32), -1)
         V = logp.shape[-1]
+        if forced_eos_token_id is not None and \
+                it == int(max_new_tokens) - 1:
+            # HF ForcedEOSTokenLogitsProcessor (BART's config default):
+            # the last generated slot can only be eos, at logp 0
+            logp = jnp.full_like(logp, -1e9).at[
+                :, int(forced_eos_token_id)].set(0.0)
         scores = beam_scores.reshape(B * nb, 1) + logp
         scores = scores.reshape(B, nb * V)
         top_s, top_i = jax.lax.top_k(scores, 2 * nb)
@@ -109,7 +115,9 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
                         # the top num_beams never forms a hypothesis
                         continue
                     seq = arr_np[b * nb + src]
-                    cur_len = seq.shape[0] + 1 - prompt_len
+                    # HF normalizes by the STORED sequence length —
+                    # prompt/start included, the appended eos excluded
+                    cur_len = seq.shape[0]
                     hyps[b].append(
                         (float(s) / (cur_len ** length_penalty),
                          np.concatenate([seq, [eos_token_id]])))
@@ -130,7 +138,7 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
             # hypotheses exist and the best live continuation cannot
             # beat the worst of them, the pool freezes
             if len(hyps[b]) >= nb:
-                cur_len = arr_np.shape[1] + 1 - prompt_len
+                cur_len = arr_np.shape[1] + 1
                 # HF is_done: best over ALL 2*nb candidates (incl. the
                 # eos ones) vs the worst KEPT hypothesis
                 best_possible = float(top_s[b][0]) / (
@@ -155,13 +163,13 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
     # finalize: UNDONE batches' live beams join the hypothesis pools
     arr_np = np.asarray(arr)
     bs = np.asarray(beam_scores)
-    gen_len = arr_np.shape[1] - prompt_len
+    full_len = arr_np.shape[1]
     for b in range(B):
         if done[b]:
             continue
         for j in range(nb):
             hyps[b].append(
-                (float(bs[b, j]) / (max(gen_len, 1) ** length_penalty),
+                (float(bs[b, j]) / (max(full_len, 1) ** length_penalty),
                  arr_np[b * nb + j]))
     best = [max(h, key=lambda t: t[0])[1] for h in hyps]
     width = max(len(s) for s in best)
@@ -171,6 +179,48 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
     for b, s in enumerate(best):
         out[b, :len(s)] = s
     return Tensor(jnp.asarray(out))
+
+
+def seq2seq_generate(decode_step, start_token_id, batch, max_new_tokens,
+                     eos_token_id, pad_token_id, num_beams=1,
+                     length_penalty=1.0, forced_eos_token_id=None,
+                     max_positions=None):
+    """Shared seq2seq decode used by the encoder-decoder families
+    (T5/BART): ``decode_step(dec_ids_tensor) -> logits`` closes over
+    the (beam-expanded, if needed) encoder memory.  Greedy rows hold
+    at pad after eos; ``num_beams > 1`` runs the HF-semantics beam
+    scorer; ``forced_eos_token_id`` forces the final slot (BART's
+    config default)."""
+    if max_positions is not None and \
+            1 + int(max_new_tokens) > int(max_positions):
+        raise ValueError(
+            f"decoder length 1+{max_new_tokens} exceeds "
+            f"max_position_embeddings {max_positions}")
+    if num_beams > 1:
+        start = jnp.asarray(np.full((batch, 1), start_token_id,
+                                    "int64"))
+        return _beam_search(decode_step, start, max_new_tokens,
+                            int(num_beams), length_penalty,
+                            eos_token_id, supports_cache=False,
+                            last_only=False, pad_token_id=pad_token_id,
+                            forced_eos_token_id=forced_eos_token_id)
+    dec = np.full((batch, 1), start_token_id, "int64")
+    finished = np.zeros((batch,), bool)
+    for it in range(int(max_new_tokens)):
+        logits = decode_step(Tensor(dec))
+        if forced_eos_token_id is not None and \
+                it == int(max_new_tokens) - 1:
+            nxt = np.full((batch,), forced_eos_token_id, "int64")
+        else:
+            nxt = np.asarray(
+                jnp.asarray(logits._data)[:, -1, :].argmax(-1))
+        nxt = np.where(finished, pad_token_id, nxt)
+        dec = np.concatenate([dec, nxt[:, None].astype("int64")], 1)
+        if eos_token_id is not None:
+            finished |= nxt == eos_token_id
+            if finished.all():
+                break
+    return Tensor(jnp.asarray(dec))
 
 
 def _to_paged(past, batch, max_total):

@@ -260,54 +260,29 @@ class T5ForConditionalGeneration(nn.Layer):
     def generate(self, input_ids, max_new_tokens: int = 20,
                  attention_mask=None, eos_token_id=None,
                  num_beams: int = 1, length_penalty: float = 1.0):
-        """Greedy or beam seq2seq decode (recompute each step — the
-        oracle path; serving uses the decoder-only families' cached
-        stacks).  Rows that emit eos hold at pad, matching
-        hf.generate; ``num_beams > 1`` runs the shared HF-semantics
-        beam scorer over the decoder."""
+        """Greedy / beam seq2seq decode via the shared
+        generation.seq2seq_generate (recompute per step — the oracle
+        path; serving uses the decoder-only families' cached stacks)."""
+        import jax.numpy as jnp
+        from .generation import seq2seq_generate
         if eos_token_id is None:
             eos_token_id = self.config.eos_token_id
-        pad = self.config.pad_token_id
-        if num_beams > 1:
-            from .generation import _beam_search
-            import jax.numpy as jnp
-            nb = int(num_beams)
-            memory = self.encoder(input_ids, self_mask=attention_mask)
-            mem_rep = Tensor(jnp.repeat(jnp.asarray(memory._data),
-                                        nb, axis=0))
-            mask_rep = None
-            if attention_mask is not None:
-                mask_rep = Tensor(jnp.repeat(
-                    jnp.asarray(attention_mask._data), nb, axis=0))
-
-            outer = self
-
-            class _DecShim:
-                def __call__(self, dec_ids):
-                    h = outer.decoder(dec_ids, memory=mem_rep,
-                                      memory_mask=mask_rep)
-                    return outer._head(h)
-
-            B = input_ids.shape[0]
-            start = jnp.asarray(np.full(
-                (B, 1), self.config.decoder_start_token_id, "int64"))
-            return _beam_search(_DecShim(), start, max_new_tokens, nb,
-                                length_penalty, eos_token_id,
-                                supports_cache=False, last_only=False,
-                                pad_token_id=pad)
         B = input_ids.shape[0]
-        dec = np.full((B, 1), self.config.decoder_start_token_id, "int64")
-        finished = np.zeros((B,), bool)
+        nb = max(int(num_beams), 1)
         memory = self.encoder(input_ids, self_mask=attention_mask)
-        for _ in range(max_new_tokens):
-            h = self.decoder(Tensor(dec), memory=memory,
-                             memory_mask=attention_mask)
-            logits = self._head(h[:, -1:])     # only the new position
-            nxt = np.asarray(logits[:, 0].numpy()).argmax(-1)
-            nxt = np.where(finished, pad, nxt)
-            dec = np.concatenate([dec, nxt[:, None].astype("int64")], 1)
-            if eos_token_id is not None:
-                finished |= nxt == eos_token_id
-                if finished.all():
-                    break
-        return Tensor(dec)
+        mask = attention_mask
+        if nb > 1:
+            memory = Tensor(jnp.repeat(jnp.asarray(memory._data), nb,
+                                       axis=0))
+            if mask is not None:
+                mask = Tensor(jnp.repeat(jnp.asarray(mask._data), nb,
+                                         axis=0))
+
+        def decode_step(dec_ids):
+            return self._head(self.decoder(dec_ids, memory=memory,
+                                           memory_mask=mask))
+
+        return seq2seq_generate(
+            decode_step, self.config.decoder_start_token_id, B,
+            max_new_tokens, eos_token_id, self.config.pad_token_id,
+            num_beams=nb, length_penalty=length_penalty)

@@ -1,0 +1,100 @@
+"""BART encoder-decoder family (ref: PaddleNLP transformers/bart) —
+post-LN stacks, learned +2-offset positions, forced-eos generation —
+oracled against transformers/torch."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.models.bart import (BartConfig,  # noqa: E402
+                                    BartForConditionalGeneration)
+from paddle_tpu.models.convert import bart_from_hf  # noqa: E402
+
+
+def _pair(seed=0):
+    torch.manual_seed(seed)
+    cfg = transformers.BartConfig(
+        vocab_size=64, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0,
+        activation_dropout=0.0, attn_implementation="eager")
+    hf = transformers.BartForConditionalGeneration(cfg).eval()
+    ours = bart_from_hf(hf)
+    ours.eval()
+    return hf, ours
+
+
+def _masked_batch(seed=0):
+    rs = np.random.RandomState(seed)
+    enc = rs.randint(3, 64, (2, 10)).astype("int64")
+    mask = np.ones((2, 10), "int64")
+    mask[1, 7:] = 0
+    enc[1, 7:] = 1
+    dec = rs.randint(3, 64, (2, 6)).astype("int64")
+    return enc, mask, dec
+
+
+def test_bart_logits_match_transformers():
+    hf, ours = _pair()
+    enc, mask, dec = _masked_batch()
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(enc),
+                  attention_mask=torch.tensor(mask),
+                  decoder_input_ids=torch.tensor(dec)).logits.numpy()
+    got = np.asarray(ours(Tensor(enc), Tensor(dec),
+                          attention_mask=Tensor(mask)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_bart_generate_matches_transformers():
+    """Greedy AND beam decode, including BART's forced_eos_token_id
+    semantics (the last slot is forced to eos, as HF's config-default
+    ForcedEOSTokenLogitsProcessor does)."""
+    hf, ours = _pair()
+    enc, mask, _ = _masked_batch()
+    with torch.no_grad():
+        wg = hf.generate(torch.tensor(enc),
+                         attention_mask=torch.tensor(mask),
+                         max_new_tokens=6, do_sample=False,
+                         forced_bos_token_id=None).numpy()
+        wb = hf.generate(torch.tensor(enc),
+                         attention_mask=torch.tensor(mask),
+                         max_new_tokens=6, num_beams=3, do_sample=False,
+                         forced_bos_token_id=None).numpy()
+    og = np.asarray(ours.generate(Tensor(enc), attention_mask=Tensor(mask),
+                                  max_new_tokens=6).numpy())
+    ob = np.asarray(ours.generate(Tensor(enc), attention_mask=Tensor(mask),
+                                  max_new_tokens=6, num_beams=3).numpy())
+    np.testing.assert_array_equal(og[:, :wg.shape[1]], wg)
+    np.testing.assert_array_equal(ob[:, :wb.shape[1]], wb)
+    assert (wb[:, -1] == 2).all()      # the forced eos actually fired
+
+
+def test_bart_trains():
+    paddle.seed(0)
+    cfg = BartConfig(vocab_size=64, d_model=32, encoder_layers=2,
+                     decoder_layers=2, encoder_attention_heads=4,
+                     decoder_attention_heads=4, encoder_ffn_dim=64,
+                     decoder_ffn_dim=64, max_position_embeddings=64)
+    m = BartForConditionalGeneration(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    rs = np.random.RandomState(0)
+    enc = Tensor(rs.randint(3, 64, (4, 10)).astype("int64"))
+    dec = Tensor(rs.randint(3, 64, (4, 6)).astype("int64"))
+    lbl = Tensor(rs.randint(3, 64, (4, 6)).astype("int64"))
+    losses = []
+    for _ in range(5):
+        loss = m.loss_fn(m(enc, dec), lbl)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # the frozen logits bias must NOT have been trained
+    assert float(paddle.abs(m.final_logits_bias).sum()) == 0.0
