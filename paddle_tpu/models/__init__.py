@@ -12,4 +12,8 @@ from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
 from .ernie_moe import (ErnieMoEConfig, ErnieMoEModel,
                         ErnieMoEForPretraining, ernie_moe_config,
                         ERNIE_MOE_PRESETS)
-from .convert import bert_from_hf, llama_from_hf
+from .t5 import T5Config, T5ForConditionalGeneration
+from .bart import BartConfig, BartForConditionalGeneration
+from .convert import (bert_from_hf, llama_from_hf, gpt2_from_hf,
+                      mistral_from_hf, qwen2_from_hf, gemma_from_hf,
+                      t5_from_hf, bart_from_hf)
