@@ -98,3 +98,21 @@ def test_bart_trains():
     assert losses[-1] < losses[0]
     # the frozen logits bias must NOT have been trained
     assert float(paddle.abs(m.final_logits_bias).sum()) == 0.0
+
+
+def test_bart_stablehlo_save_load_roundtrip(tmp_path):
+    paddle.seed(0)
+    cfg = BartConfig(vocab_size=64, d_model=32, encoder_layers=2,
+                     decoder_layers=2, encoder_attention_heads=4,
+                     decoder_attention_heads=4, encoder_ffn_dim=64,
+                     decoder_ffn_dim=64, max_position_embeddings=64)
+    m = BartForConditionalGeneration(cfg)
+    m.eval()
+    rs = np.random.RandomState(0)
+    enc = Tensor(rs.randint(3, 64, (2, 10)).astype("int64"))
+    dec = Tensor(rs.randint(3, 64, (2, 6)).astype("int64"))
+    want = np.asarray(m(enc, dec).numpy())
+    paddle.jit.save(m, str(tmp_path / "bart"), input_spec=[enc, dec])
+    loaded = paddle.jit.load(str(tmp_path / "bart"))
+    got = np.asarray(loaded(enc, dec).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

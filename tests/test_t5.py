@@ -136,3 +136,23 @@ def test_t5_beam_search_matches_transformers():
                                    num_beams=3,
                                    eos_token_id=44).numpy())
     np.testing.assert_array_equal(got[:, :want.shape[1]], want)
+
+
+def test_t5_stablehlo_save_load_roundtrip(tmp_path):
+    """The deployment artifact (paddle.jit.save → StableHLO) carries
+    the encoder-decoder forward, relative biases included."""
+    paddle.seed(0)
+    cfg = T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4,
+                   relative_attention_num_buckets=8,
+                   relative_attention_max_distance=20)
+    m = T5ForConditionalGeneration(cfg)
+    m.eval()
+    rs = np.random.RandomState(0)
+    enc = Tensor(rs.randint(1, 64, (2, 10)).astype("int64"))
+    dec = Tensor(rs.randint(1, 64, (2, 6)).astype("int64"))
+    want = np.asarray(m(enc, dec).numpy())
+    paddle.jit.save(m, str(tmp_path / "t5"), input_spec=[enc, dec])
+    loaded = paddle.jit.load(str(tmp_path / "t5"))
+    got = np.asarray(loaded(enc, dec).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
