@@ -1,0 +1,64 @@
+"""paddle_tpu.analysis — the self-enforcing correctness layer.
+
+Three passes over the three places tracing hazards live, one CLI
+(``python -m paddle_tpu.analysis``), one finding model (PTL codes,
+severity levels, per-line ``# noqa: PTLxxx`` suppression, JSON output):
+
+* **lint** (PTL0xx) — tracing-safety AST linter over Python source:
+  host syncs inside ``@to_static``/surface code, Python control flow on
+  traced values, np-on-Tensor, in-place ops under capture, mutable
+  default args, impure host effects, float64 literals.  Stdlib-only.
+* **registry_check** (PTL1xx) — cross-validates every
+  ``tensor/op_registry.py`` row: coverage (or a reasoned exclusion),
+  np_ref/paddle_fn arity vs the generated cases, alias shadowing, grad
+  promises, and (deep mode) live tape reachability.
+* **graphcheck** (PTL2xx) — captured-graph hazards from live objects:
+  SOT-lite graph-break/guard/recompile inventories of a
+  ``StaticFunction``, op-stream host-transfer + float64-promotion
+  reports via the ``core.dispatch`` introspection hook, raw jaxpr
+  histograms.
+
+Import cost mirrors the passes: ``rules``/``lint`` import no jax; the
+other passes import the framework lazily inside their entry points.
+"""
+from .rules import (ERROR, INFO, RULES, WARNING, Finding, Rule,
+                    has_errors, make_finding, max_severity)
+from .lint import is_surface_path, lint_file, lint_paths, lint_source
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "RULES", "Rule", "Finding",
+    "make_finding", "max_severity", "has_errors",
+    "lint_source", "lint_file", "lint_paths", "is_surface_path",
+    "check_registry", "analyze", "inspect_static_fn", "stream_report",
+    "check_jaxpr", "main",
+]
+
+
+def check_registry(deep_sample: int = 8):
+    from .registry_check import check_registry as _impl
+    return _impl(deep_sample=deep_sample)
+
+
+def analyze(target, *args, **kwargs):
+    from .graphcheck import analyze as _impl
+    return _impl(target, *args, **kwargs)
+
+
+def inspect_static_fn(fn):
+    from .graphcheck import inspect_static_fn as _impl
+    return _impl(fn)
+
+
+def stream_report(fn, *args, **kwargs):
+    from .graphcheck import stream_report as _impl
+    return _impl(fn, *args, **kwargs)
+
+
+def check_jaxpr(jaxpr):
+    from .graphcheck import check_jaxpr as _impl
+    return _impl(jaxpr)
+
+
+def main(argv=None):
+    from .cli import main as _impl
+    return _impl(argv)

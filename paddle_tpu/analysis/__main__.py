@@ -1,0 +1,6 @@
+"""Entry point: ``python -m paddle_tpu.analysis``."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

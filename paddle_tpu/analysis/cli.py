@@ -1,0 +1,118 @@
+"""``python -m paddle_tpu.analysis`` — the analysis CLI.
+
+Text and JSON output, severity levels, exit code 1 iff any
+error-severity finding survives suppression.  The AST lint runs by
+default; ``--registry`` adds the op-registry consistency pass (imports
+the package + jax, so it is opt-in for speed).
+
+    python -m paddle_tpu.analysis paddle_tpu/            # lint, text
+    python -m paddle_tpu.analysis paddle_tpu/ --json     # machine output
+    python -m paddle_tpu.analysis --registry             # registry pass
+    python -m paddle_tpu.analysis examples/ --select PTL001,PTL006
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from .rules import ERROR, RULES, Finding, severity_rank
+
+JSON_SCHEMA_VERSION = 1
+
+
+def findings_to_json(findings: List[Finding]) -> dict:
+    by_sev = {"error": 0, "warning": 0, "info": 0}
+    for f in findings:
+        by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "findings": [f.to_dict() for f in findings],
+        "summary": {"total": len(findings), **by_sev},
+    }
+
+
+def findings_from_json(payload: dict) -> List[Finding]:
+    if payload.get("version") != JSON_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported analysis JSON version {payload.get('version')!r}")
+    return [Finding.from_dict(d) for d in payload["findings"]]
+
+
+def _parse_select(raw: Optional[str]):
+    if not raw:
+        return None
+    codes = {c.strip().upper() for c in raw.split(",") if c.strip()}
+    unknown = codes - set(RULES)
+    if unknown:
+        raise SystemExit(f"unknown rule code(s): {', '.join(sorted(unknown))}")
+    return codes
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu static analysis: tracing-safety lint "
+                    "(PTL0xx), op-registry consistency (PTL1xx), "
+                    "captured-graph hazards (PTL2xx via the API).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: none)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable JSON schema")
+    ap.add_argument("--select", metavar="CODES",
+                    help="comma-separated PTL codes to keep")
+    ap.add_argument("--registry", action="store_true",
+                    help="also run the op-registry consistency check "
+                         "(imports paddle_tpu + jax)")
+    ap.add_argument("--deep-registry", type=int, default=8,
+                    metavar="N",
+                    help="with --registry: probe N grad rows live for "
+                         "tape reachability (0 disables; default 8)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the PTL rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            r = RULES[code]
+            print(f"{code} [{r.severity:7s}] {r.name}: {r.summary}")
+        return 0
+
+    select = _parse_select(args.select)
+    findings: List[Finding] = []
+
+    if args.paths:
+        from .lint import lint_paths
+        findings.extend(lint_paths(args.paths, select=select))
+
+    if args.registry:
+        from .registry_check import check_registry
+        reg = check_registry(deep_sample=args.deep_registry)
+        if select is not None:
+            reg = [f for f in reg if f.code in select]
+        findings.extend(reg)
+
+    if not args.paths and not args.registry:
+        ap.print_usage()
+        print("nothing to do: give paths to lint and/or --registry")
+        return 2
+
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
+
+    if args.json:
+        print(json.dumps(findings_to_json(findings), indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == ERROR)
+        n_warn = sum(1 for f in findings if f.severity == "warning")
+        print(f"{len(findings)} finding(s): {n_err} error(s), "
+              f"{n_warn} warning(s), "
+              f"{len(findings) - n_err - n_warn} info")
+
+    return 1 if any(f.severity == ERROR for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

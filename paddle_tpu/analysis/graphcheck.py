@@ -1,0 +1,188 @@
+"""Captured-graph hazard analyzer (PTL2xx).
+
+Three entry points, one report shape:
+
+* ``inspect_static_fn(fn)`` — read a ``@to_static`` ``StaticFunction``'s
+  capture metadata (``StaticFunction.capture_report()``, wired into the
+  SOT-lite specialization records): graph-break count, guard inventory
+  (shape/dtype/value-vs-shape per guard), compiled-segment counts, and
+  per-specialization recompile (re-record) counts.
+* ``stream_report(fn, *args)`` — run any callable once under the
+  ``core.dispatch`` op-stream introspection hook and the host-read hook:
+  per-op histogram, host-transfer count, and accidental float64
+  promotion points (ops whose outputs are f64 from narrower inputs).
+* ``check_jaxpr(jaxpr)`` — primitive histogram + float64 vars of a raw
+  jaxpr (``jax.make_jaxpr(f)(*arrays)``) for array-level functions.
+
+Each report carries a ``hazards`` list of PTL2xx findings so the CLI and
+tests consume graph analysis the same way they consume lint findings.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .rules import Finding, make_finding
+
+
+def _hazards_from_capture(report: dict) -> List[Finding]:
+    name = report.get("name", "<fn>")
+    stats = report.get("stats", {})
+    out: List[Finding] = []
+    breaks = stats.get("graph_breaks", 0)
+    if breaks:
+        out.append(make_finding(
+            "PTL201",
+            f"'{name}' recorded {breaks} graph break(s) across "
+            f"{stats.get('records', 0)} recording run(s) — each break "
+            "is a host round-trip + guard check per step"))
+    n_value_guards = sum(
+        1 for spec in report.get("specializations", ())
+        for tr in spec.get("traces", ())
+        for g in tr.get("guards", ()) if g.get("check_value"))
+    if n_value_guards:
+        out.append(make_finding(
+            "PTL202",
+            f"'{name}' holds {n_value_guards} value-equality guard(s) — "
+            "a changing guarded value re-records until the "
+            "specialization cap"))
+    gave_up = [spec for spec in report.get("specializations", ())
+               if spec.get("gave_up")]
+    if report.get("broken") or gave_up or stats.get("eager_fallbacks"):
+        reasons = sorted({spec.get("gave_up_reason", "") for spec in
+                          gave_up if spec.get("gave_up_reason")}
+                         | set(stats.get("fallback_reasons", ())))
+        out.append(make_finding(
+            "PTL203",
+            f"'{name}' de-optimized to eager"
+            + (f" ({'; '.join(reasons)})" if reasons else "")
+            + f" — {stats.get('eager_fallbacks', 0)} eager call(s) on "
+            "the compiled path"))
+    return out
+
+
+def inspect_static_fn(fn) -> dict:
+    """Analyze a ``@to_static``-wrapped function's captures.  Returns
+    the capture metadata plus ``hazards`` (PTL2xx findings) and roll-up
+    counters the regression tests pin against SotStats."""
+    report = dict(fn.capture_report())
+    traces = [tr for spec in report["specializations"]
+              for tr in spec["traces"]]
+    report["trace_count"] = len(traces)
+    report["segment_count"] = sum(tr["segments"] for tr in traces)
+    report["guard_count"] = sum(len(tr["guards"]) for tr in traces)
+    report["graph_break_count"] = sum(tr["graph_breaks"] for tr in traces)
+    # recompiles per signature = recording runs beyond the first
+    report["recompile_count"] = max(0, report["stats"]["records"]
+                                    - report["sot_signatures"])
+    report["hazards"] = _hazards_from_capture(report)
+    return report
+
+
+def _is_f64(dtype_str: str) -> bool:
+    return dtype_str in ("float64", "complex128")
+
+
+def stream_report(fn: Callable, *args, **kwargs) -> dict:
+    """Run ``fn(*args, **kwargs)`` once, observing the dispatched op
+    stream: op histogram, host transfers (Tensor.numpy()/item()
+    concretizations), and float64 promotion points."""
+    from ..core import dispatch
+    from ..core import tensor as tensor_mod
+
+    events: List[Any] = []
+    host_reads = {"n": 0}
+
+    prev_hook = tensor_mod._host_read_hook
+
+    def host_hook(t):
+        host_reads["n"] += 1
+        if prev_hook is not None:
+            prev_hook(t)
+
+    tensor_mod._host_read_hook = host_hook
+    try:
+        with dispatch.observe_op_stream(events.append):
+            result = fn(*args, **kwargs)
+    finally:
+        tensor_mod._host_read_hook = prev_hook
+
+    histogram: Dict[str, int] = {}
+    promotions: List[dict] = []
+    for ev in events:
+        histogram[ev.op_name] = histogram.get(ev.op_name, 0) + 1
+        out_f64 = any(_is_f64(dt) for _, dt in ev.out_avals)
+        in_f64 = any(_is_f64(dt) for _, dt in ev.in_avals)
+        if out_f64 and not in_f64:
+            promotions.append({"op": ev.op_name,
+                               "out_avals": list(ev.out_avals)})
+
+    hazards: List[Finding] = []
+    if promotions:
+        ops = sorted({p["op"] for p in promotions})
+        hazards.append(make_finding(
+            "PTL204",
+            f"{len(promotions)} op(s) promote to float64 from narrower "
+            f"inputs: {', '.join(ops[:6])}"
+            + ("…" if len(ops) > 6 else "")))
+    if host_reads["n"]:
+        hazards.append(make_finding(
+            "PTL205",
+            f"op stream performed {host_reads['n']} host transfer(s) "
+            "(Tensor concretizations) — XLA cannot fuse or overlap "
+            "across them"))
+    return {
+        "ops": len(events),
+        "histogram": histogram,
+        "host_transfers": host_reads["n"],
+        "float64_promotions": promotions,
+        "hazards": hazards,
+        "result": result,
+    }
+
+
+def check_jaxpr(jaxpr) -> dict:
+    """Primitive histogram + float64 vars of a (Closed)Jaxpr."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    histogram: Dict[str, int] = {}
+    f64_vars: List[str] = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            histogram[eqn.primitive.name] = \
+                histogram.get(eqn.primitive.name, 0) + 1
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                if aval is not None and _is_f64(str(getattr(
+                        aval, "dtype", ""))):
+                    shape = tuple(getattr(aval, "shape", ()))
+                    f64_vars.append(
+                        f"{eqn.primitive.name}:{aval.dtype}{list(shape)}")
+            for sub in eqn.params.values():
+                sub_jaxpr = getattr(sub, "jaxpr", None)
+                if sub_jaxpr is not None and hasattr(sub_jaxpr, "eqns"):
+                    walk(sub_jaxpr)
+
+    walk(inner)
+    hazards: List[Finding] = []
+    if f64_vars:
+        hazards.append(make_finding(
+            "PTL204",
+            f"jaxpr carries {len(f64_vars)} float64 value(s): "
+            f"{', '.join(f64_vars[:5])}"
+            + ("…" if len(f64_vars) > 5 else "")))
+    return {"eqns": sum(histogram.values()), "histogram": histogram,
+            "float64_vars": f64_vars, "hazards": hazards}
+
+
+def analyze(target, *args, **kwargs) -> dict:
+    """Dispatching front door: StaticFunction → capture analysis,
+    jaxpr → jaxpr analysis, plain callable (+args) → stream analysis."""
+    if hasattr(target, "capture_report"):
+        return inspect_static_fn(target)
+    if hasattr(target, "eqns") or hasattr(target, "jaxpr"):
+        return check_jaxpr(target)
+    if callable(target):
+        return stream_report(target, *args, **kwargs)
+    raise TypeError(
+        f"graphcheck.analyze: unsupported target {type(target).__name__} "
+        "(expected a @to_static function, a jaxpr, or a callable)")

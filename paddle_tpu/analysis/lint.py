@@ -1,0 +1,501 @@
+"""Tracing-safety AST linter (PTL0xx).
+
+Walks Python source — the package itself, ``examples/``, or user model
+code — and flags TPU/JAX tracing hazards with ``PTL`` codes.  Stdlib
+only: linting must not import jax (or the package under analysis).
+
+Two notions of "traced region" drive context sensitivity:
+
+* **decorated**: any function decorated ``@to_static`` /
+  ``@paddle.jit.to_static`` / ``@train_step`` (and every function nested
+  inside one) is traced — host syncs there are definitive hazards.  A
+  trailing ``# ptl: traced`` comment on the ``def`` line opts a function
+  in explicitly (for callables passed to ``train_step``/``jax.jit`` by
+  reference).
+* **surface modules**: files matching ``SURFACE_GLOBS`` (the package's
+  op-surface — ``nn/functional``, ``tensor/*``, ``ops/``) hold functions
+  that execute *inside* user traces, so every function they define is
+  treated as traced.  This is what lets the linter find stray host syncs
+  on the package's own hot paths.
+
+Suppression: ``# noqa`` or ``# noqa: PTL001[,PTL006]`` on the flagged
+line.  The package self-lint (tests/test_analysis.py) holds the surface
+at zero error-severity findings.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .rules import ERROR, WARNING, Finding, make_finding
+
+# files whose functions run under user traces (relative-path globs,
+# matched with '/' separators against the path tail)
+SURFACE_GLOBS = (
+    "*/nn/functional/*.py",
+    "*/incubate/nn/functional/*.py",
+    "*/ops/*.py",
+    "*/ops/pallas/*.py",
+    "*/tensor/math.py",
+    "*/tensor/manipulation.py",
+    "*/tensor/creation.py",
+    "*/tensor/linalg.py",
+    "*/tensor/logic.py",
+    "*/tensor/search.py",
+    "*/tensor/stat.py",
+    "*/tensor/random.py",
+    "*/tensor/einsum.py",
+    "*/tensor/_helpers.py",
+)
+# surface files exempt from surface mode (their host-side code is the
+# point: test oracles, case generators, kernel benchmarking)
+SURFACE_EXEMPT = ("*/tensor/op_registry.py", "*/ops/pallas/autotune.py")
+
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
+_HOST_CASTS = {"float", "int", "bool"}
+_TRACED_DECORATORS = {"to_static", "train_step", "TrainStep"}
+# producers whose result is a Tensor (or traced array) wherever they
+# appear — the roots of the tensorish lattice
+_TENSOR_PRODUCERS = {"ensure_tensor", "to_tensor", "unwrap", "call_op",
+                     "call_op_custom_vjp"}
+# module roots whose function results are tensor-valued
+_TENSOR_ROOTS = {"paddle", "paddle_tpu", "F", "jnp"}
+# functions under those roots that return HOST values (dtype predicates,
+# static metadata) — their results are trace-safe to branch on
+_HOST_RESULT_FNS = {
+    "issubdtype", "iinfo", "finfo", "result_type", "can_cast", "isdtype",
+    "promote_types", "broadcast_shapes", "ndim", "shape", "size",
+    "is_complex", "is_floating_point", "is_integer", "is_tensor",
+    "in_dynamic_mode", "get_default_dtype",
+}
+# metadata attributes that yield host values (ints/strings), not
+# Tensors — tensorish propagation stops here (x.shape[-1] is static)
+_META_ATTRS = {"shape", "ndim", "dtype", "size", "name", "ndimension",
+               "stop_gradient", "place", "is_leaf", "itemsize"}
+# Tensor methods that return Tensors (chains like x.sum().mean())
+_TENSOR_METHODS = {
+    "sum", "mean", "max", "min", "prod", "abs", "norm", "std", "var",
+    "all", "any", "count_nonzero", "matmul", "mm", "dot", "reshape",
+    "transpose", "astype", "cast", "squeeze", "unsqueeze", "flatten",
+    "clip", "detach", "clone", "exp", "log", "sqrt", "tanh", "sigmoid",
+    "softmax", "argmax", "argmin", "cumsum", "t", "pow", "add",
+    "subtract", "multiply", "divide", "logsumexp",
+}
+_IMPURE_HOST_CALLS = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("random", "random"), ("random", "randint"), ("random", "uniform"),
+    ("random", "randrange"), ("random", "choice"), ("random", "shuffle"),
+    ("random", "gauss"),
+}
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", re.IGNORECASE)
+_TRACED_MARK_RE = re.compile(r"#\s*ptl:\s*traced", re.IGNORECASE)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _decorator_marks_traced(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    dotted = _dotted(dec)
+    if dotted is None:
+        return False
+    return dotted.split(".")[-1] in _TRACED_DECORATORS
+
+
+def _is_layer_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        dotted = _dotted(base) or ""
+        if dotted.split(".")[-1] == "Layer":
+            return True
+    return False
+
+
+class _Scope:
+    __slots__ = ("traced", "tensor_names", "in_layer", "func_name")
+
+    def __init__(self, traced: bool, in_layer: bool = False,
+                 func_name: str = ""):
+        self.traced = traced
+        self.tensor_names: Set[str] = set()
+        self.in_layer = in_layer
+        self.func_name = func_name
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, filename: str, source_lines: Sequence[str],
+                 surface: bool):
+        self.filename = filename
+        self.lines = source_lines
+        self.surface = surface
+        self.findings: List[Finding] = []
+        self._scopes: List[_Scope] = []
+        self._class_stack: List[ast.ClassDef] = []
+
+    # -- helpers ---------------------------------------------------------
+    @property
+    def scope(self) -> Optional[_Scope]:
+        return self._scopes[-1] if self._scopes else None
+
+    @property
+    def traced(self) -> bool:
+        return bool(self._scopes and self._scopes[-1].traced)
+
+    def emit(self, code: str, message: str, node: ast.AST,
+             severity: Optional[str] = None):
+        self.findings.append(make_finding(
+            code, message, file=self.filename,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0), severity=severity))
+
+    def _tensorish(self, node: ast.AST, depth: int = 0) -> bool:
+        """Lexical may-be-Tensor lattice (best effort, no type info)."""
+        if depth > 8 or node is None:
+            return False
+        if isinstance(node, ast.Name):
+            sc = self.scope
+            return bool(sc and node.id in sc.tensor_names)
+        if isinstance(node, ast.Call):
+            f = node.func
+            dotted = _dotted(f)
+            if dotted is not None:
+                leaf = dotted.split(".")[-1]
+                root = dotted.split(".")[0]
+                if leaf in _HOST_RESULT_FNS:
+                    return False
+                if leaf in _TENSOR_PRODUCERS:
+                    return True
+                if root in _TENSOR_ROOTS and "." in dotted:
+                    return True
+            if isinstance(f, ast.Attribute) and f.attr in _TENSOR_METHODS:
+                return self._tensorish(f.value, depth + 1)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _META_ATTRS:
+                return False
+            # x.T / x.real style — propagate from the base
+            return self._tensorish(node.value, depth + 1)
+        if isinstance(node, ast.BinOp):
+            return (self._tensorish(node.left, depth + 1)
+                    or self._tensorish(node.right, depth + 1))
+        if isinstance(node, ast.UnaryOp):
+            return self._tensorish(node.operand, depth + 1)
+        if isinstance(node, ast.Compare):
+            # identity tests (x is None) are host-safe on any object
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self._tensorish(node.left, depth + 1)
+                    or any(self._tensorish(c, depth + 1)
+                           for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self._tensorish(v, depth + 1) for v in node.values)
+        if isinstance(node, ast.Subscript):
+            return self._tensorish(node.value, depth + 1)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._tensorish(e, depth + 1) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self._tensorish(node.body, depth + 1)
+                    or self._tensorish(node.orelse, depth + 1))
+        return False
+
+    def _track_assign(self, targets: Iterable[ast.AST], value: ast.AST):
+        sc = self.scope
+        if sc is None:
+            return
+        is_t = self._tensorish(value)
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if is_t:
+                    sc.tensor_names.add(tgt.id)
+                else:
+                    sc.tensor_names.discard(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)) and is_t:
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        sc.tensor_names.add(e.id)
+
+    # -- function defs ---------------------------------------------------
+    def _check_mutable_defaults(self, node):
+        bad = (ast.List, ast.Dict, ast.Set)
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            hit = isinstance(d, bad)
+            if isinstance(d, ast.Call):
+                dotted = _dotted(d.func) or ""
+                hit = dotted in ("list", "dict", "set")
+            if not hit:
+                continue
+            in_layer = bool(self._class_stack
+                            and _is_layer_class(self._class_stack[-1]))
+            layer_hot = in_layer and node.name in ("__init__", "forward")
+            self.emit(
+                "PTL006",
+                f"mutable default argument on '{node.name}'"
+                + (" (Layer.%s: shared across instances and recompile "
+                   "caches)" % node.name if layer_hot else ""),
+                d, severity=ERROR)
+
+    def _visit_func(self, node):
+        self._check_mutable_defaults(node)
+        dec_traced = any(_decorator_marks_traced(d)
+                         for d in node.decorator_list)
+        line = self.lines[node.lineno - 1] if node.lineno - 1 < len(
+            self.lines) else ""
+        mark_traced = bool(_TRACED_MARK_RE.search(line))
+        traced = (dec_traced or mark_traced or self.traced
+                  or self.surface)
+        in_layer = bool(self._class_stack
+                        and _is_layer_class(self._class_stack[-1]))
+        sc = _Scope(traced, in_layer, node.name)
+        # parameters of traced functions are assumed tensor-carrying
+        # UNLESS this is surface mode, where most params are config
+        # scalars: there, only ensure_tensor/assignment marks them
+        if dec_traced or mark_traced:
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                if a.arg not in ("self", "cls"):
+                    sc.tensor_names.add(a.arg)
+        self._scopes.append(sc)
+        for child in node.body:
+            self.visit(child)
+        self._scopes.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_Lambda(self, node):
+        # lambdas inherit the enclosing traced-ness; no new scope
+        self.visit(node.body)
+
+    # -- statements ------------------------------------------------------
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        self._track_assign(node.targets, node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self._track_assign([node.target], node.value)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+
+    def visit_If(self, node):
+        if self.traced and self._tensorish(node.test):
+            self.emit("PTL003",
+                      "Python 'if' on a Tensor-valued condition under "
+                      "trace (host read; one SOT specialization per "
+                      "branch path)", node)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.traced and self._tensorish(node.test):
+            self.emit("PTL003",
+                      "Python 'while' on a Tensor-valued condition under "
+                      "trace (host read per iteration; unrolled capture)",
+                      node)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        if self.traced and self._tensorish(node.test):
+            self.emit("PTL003",
+                      "conditional expression on a Tensor-valued "
+                      "condition under trace (host read)", node)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        if self.traced and self._tensorish(node.iter):
+            self.emit("PTL008",
+                      "iteration over a Tensor under trace (per-element "
+                      "host reads; capture unrolls with data size)", node)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self.traced and self._tensorish(node.test):
+            self.emit("PTL002",
+                      "assert on a Tensor-valued expression under trace "
+                      "(bool() host read)", node)
+        self.generic_visit(node)
+
+    # -- calls -----------------------------------------------------------
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+
+        if self.traced:
+            # PTL001 host-sync methods
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HOST_SYNC_METHODS:
+                self.emit("PTL001",
+                          f".{node.func.attr}() host sync under trace "
+                          "(graph break + value guard on the SOT path; "
+                          "RuntimeError under whole-graph trace)",
+                          node)
+            # PTL002 host casts on tensorish args
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in _HOST_CASTS and node.args:
+                if self._tensorish(node.args[0]):
+                    self.emit("PTL002",
+                              f"{node.func.id}() on a Tensor-valued "
+                              "expression under trace (host "
+                              "concretization)", node)
+            # PTL004 np.* on tensorish args
+            if dotted is not None and \
+                    dotted.split(".")[0] in ("np", "numpy") and \
+                    len(dotted.split(".")) > 1:
+                if any(self._tensorish(a) for a in node.args):
+                    self.emit("PTL004",
+                              f"{dotted}() applied to a Tensor under "
+                              "trace (eager host materialization; "
+                              "falls off the captured graph)", node)
+            # PTL005 in-place *_ ops
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr.endswith("_") and not attr.endswith("__") and \
+                        not attr.startswith("_"):
+                    self.emit("PTL005",
+                              f".{attr}() in-place op inside a captured "
+                              "region (identity rebind mid-capture)",
+                              node)
+            # PTL007 impure host effects
+            if dotted is not None:
+                parts = tuple(dotted.split("."))
+                if parts in _IMPURE_HOST_CALLS or (
+                        len(parts) >= 3 and parts[-3] == "np"
+                        and parts[-2] == "random") or (
+                        parts[0] in ("np", "numpy")
+                        and len(parts) == 3 and parts[1] == "random"):
+                    self.emit("PTL007",
+                              f"{dotted}() under trace: the value is "
+                              "baked at record time and replayed "
+                              "verbatim", node)
+            # PTL009 print of a tensor
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "print" and \
+                    any(self._tensorish(a) for a in node.args):
+                self.emit("PTL009",
+                          "print() of a Tensor under trace (host sync "
+                          "per step; prints a tracer under whole-graph "
+                          "capture)", node)
+            # PTL010 float64 literals flowing into ops
+            for kw in node.keywords:
+                if kw.arg in ("dtype",) and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value == "float64":
+                    self.emit("PTL010",
+                              "dtype='float64' under trace (no fast TPU "
+                              "f64 path; promotion spreads through the "
+                              "segment)", kw.value)
+            for a in list(node.args):
+                if isinstance(a, ast.Constant) and a.value == "float64":
+                    self.emit("PTL010",
+                              "'float64' literal under trace (no fast "
+                              "TPU f64 path)", a)
+            if dotted in ("np.float64", "numpy.float64", "jnp.float64"):
+                self.emit("PTL010",
+                          f"{dotted} under trace (no fast TPU f64 path)",
+                          node)
+
+        self.generic_visit(node)
+
+
+def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> None (bare noqa: suppress all) | set of codes."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        codes = m.group(1)
+        if codes is None:
+            out[i] = None
+        else:
+            out[i] = {c.strip().upper() for c in codes.split(",")
+                      if c.strip()}
+    return out
+
+
+def is_surface_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    if any(fnmatch.fnmatch(p, g) for g in SURFACE_EXEMPT):
+        return False
+    return any(fnmatch.fnmatch(p, g) for g in SURFACE_GLOBS)
+
+
+def lint_source(source: str, filename: str = "<string>",
+                surface: Optional[bool] = None,
+                select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source blob.  ``surface=None`` infers from the path."""
+    if surface is None:
+        surface = is_surface_path(filename)
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [make_finding("PTL000",
+                             f"could not parse: {e.msg}",
+                             file=filename, line=e.lineno or 0,
+                             severity=WARNING)]
+    linter = _Linter(filename, source.splitlines(), surface)
+    linter.visit(tree)
+    noqa = _collect_noqa(source)
+    out = []
+    for f in linter.findings:
+        supp = noqa.get(f.line, "missing")
+        if supp is None:               # bare noqa
+            continue
+        if isinstance(supp, set) and f.code.upper() in supp:
+            continue
+        if select is not None and f.code not in select:
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.file, f.line, f.col, f.code))
+    return out
+
+
+def lint_file(path: str, select: Optional[Set[str]] = None,
+              surface: Optional[bool] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    return lint_source(src, filename=path, surface=surface, select=select)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git",
+                                        ".xla_cache")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str], select: Optional[Set[str]] = None,
+               surface: Optional[bool] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_file(f, select=select, surface=surface))
+    return findings

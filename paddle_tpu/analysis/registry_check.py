@@ -1,0 +1,215 @@
+"""Op-registry consistency checker (PTL1xx).
+
+``tensor/op_registry.py`` is the single queryable index of the 600+ op
+surface, and ``tests/test_op_registry.py`` generates the parity/grad
+matrix from it — so a spec row whose promise drifts from the op it
+describes silently *removes* coverage instead of failing a test.  This
+pass cross-validates every row:
+
+* **PTL101 uncovered-op** — an indexed row with no case generator and no
+  explicit ``untested_reason`` ships with zero coverage; public surface
+  callables excluded from the index must appear in the reasoned
+  ``_NOT_OPS`` table (surface drift).
+* **PTL102/PTL103 arity** — ``np_ref`` / ``paddle_fn`` must be callable
+  with the argument tuples ``gen_cases`` actually yields (checked with
+  ``inspect.Signature.bind`` — no op is executed).
+* **PTL104 alias-shadow** — an alias resolving to a different function
+  than the registry row of the same name is two ops answering one name.
+* **PTL105 grad-promise** — ``grad=True`` needs a runnable case and must
+  not co-exist with a nondiff mark.
+* **PTL106 backward-unreachable** (deep mode) — live probe: run a sample
+  of grad rows forward with ``stop_gradient=False`` inputs and assert a
+  tape edge was recorded.
+
+Heavy imports (jax, the package) happen lazily inside ``check_registry``
+so ``paddle_tpu.analysis.lint`` stays importable without them.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, List, Optional
+
+from .rules import Finding, make_finding
+
+_REGISTRY_FILE = "paddle_tpu/tensor/op_registry.py"
+
+
+def _can_bind(fn: Callable, n_pos: int, kwargs: dict) -> Optional[str]:
+    """None if fn(*n_pos args, **kwargs) binds, else the reason.  Ufuncs
+    and builtins without introspectable signatures are skipped (None)."""
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        return None
+    try:
+        sig.bind(*(object() for _ in range(n_pos)), **kwargs)
+        return None
+    except TypeError as e:
+        return str(e)
+
+
+def check_registry(deep_sample: int = 8) -> List[Finding]:
+    """Run all registry consistency checks.  ``deep_sample`` > 0 probes
+    that many grad=True rows live for tape reachability (PTL106)."""
+    from ..tensor.op_registry import (REGISTRY, _NOT_OPS,
+                                      build_full_registry,
+                                      _surface_modules)
+    build_full_registry()
+    findings: List[Finding] = []
+
+    def emit(code, msg):
+        findings.append(make_finding(code, msg, file=_REGISTRY_FILE))
+
+    # -- PTL101: coverage + surface drift --------------------------------
+    for name, row in sorted(REGISTRY.items()):
+        if row.gen_cases is None and not row.untested_reason:
+            emit("PTL101",
+                 f"op '{name}' is indexed but has no case generator and "
+                 "no untested_reason — it ships with zero parity/grad "
+                 "coverage")
+    # public callables on the surface modules that neither the registry
+    # nor the reasoned exclusion table accounts for
+    for prefix, mod in _surface_modules():
+        for k in dir(mod):
+            if k.startswith("_"):
+                continue
+            fn = getattr(mod, k)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            fn_mod = getattr(fn, "__module__", "") or ""
+            if not fn_mod.startswith("paddle_tpu"):
+                continue   # typing/stdlib re-exports are not surface
+            qual = prefix + k
+            if qual not in REGISTRY and k not in _NOT_OPS:
+                emit("PTL101",
+                     f"public surface callable '{qual}' is neither "
+                     "indexed in REGISTRY nor excluded (with a reason) "
+                     "in _NOT_OPS — surface drift")
+
+    # -- PTL102/PTL103: arity vs generated cases -------------------------
+    for name, row in sorted(REGISTRY.items()):
+        if row.gen_cases is None:
+            continue
+        try:
+            cases = row.gen_cases()
+        except Exception as e:
+            emit("PTL103", f"op '{name}': gen_cases() itself raised "
+                           f"{type(e).__name__}: {e}")
+            continue
+        if not cases:
+            emit("PTL103", f"op '{name}': gen_cases() returned no cases")
+            continue
+        args = cases[0]
+        if row.np_ref is not None:
+            np_kwargs = (row.np_kwargs if row.np_kwargs is not None
+                         else row.kwargs)
+            why = _can_bind(row.np_ref, len(args), np_kwargs or {})
+            if why is not None:
+                emit("PTL102",
+                     f"op '{name}': np_ref cannot accept the generated "
+                     f"case ({len(args)} positional args"
+                     + (f" + kwargs {sorted(np_kwargs)}" if np_kwargs
+                        else "") + f"): {why}")
+        if row.paddle_fn is not None:
+            n_pos = 1 if row.list_input else len(args)
+            why = _can_bind(row.paddle_fn, n_pos, row.kwargs or {})
+            if why is not None:
+                emit("PTL103",
+                     f"op '{name}': paddle_fn cannot accept the "
+                     f"generated case ({n_pos} positional args"
+                     + (f" + kwargs {sorted(row.kwargs)}" if row.kwargs
+                        else "") + f"): {why}")
+
+    # -- PTL104: duplicate / shadowed aliases ----------------------------
+    import paddle_tpu.tensor.op_registry as _regmod
+    for name, row in sorted(REGISTRY.items()):
+        for alias in row.aliases:
+            other = REGISTRY.get(alias)
+            if other is None or other is row:
+                continue
+            a = getattr(_regmod, alias, None)
+            mine = row.paddle_fn or getattr(_regmod, name, None)
+            theirs = other.paddle_fn or a
+            if theirs is not None and mine is not None and \
+                    theirs is not mine and \
+                    getattr(theirs, "__wrapped__", theirs) is not \
+                    getattr(mine, "__wrapped__", mine):
+                emit("PTL104",
+                     f"alias '{alias}' of op '{name}' is shadowed by a "
+                     f"distinct registry row — two ops answer one name")
+
+    # -- PTL105: grad promises -------------------------------------------
+    for name, row in sorted(REGISTRY.items()):
+        if row.grad and row.nondiff_reason:
+            emit("PTL105",
+                 f"op '{name}' is both grad=True and marked "
+                 f"non-differentiable ({row.nondiff_reason!r}) — the "
+                 "promises contradict")
+        if row.grad and (row.gen_cases is None and row.grad_cases is None):
+            emit("PTL105",
+                 f"op '{name}' promises grad=True but has no case "
+                 "generator — the gradient check silently never runs")
+        if row.grad and row.paddle_fn is None:
+            emit("PTL105",
+                 f"op '{name}' promises grad=True but resolves to no "
+                 "callable")
+
+    # -- PTL106: deep tape-reachability probe ----------------------------
+    if deep_sample > 0:
+        findings.extend(_probe_tape(deep_sample))
+
+    return findings
+
+
+# rows whose gradient flows but whose *probe* (first output of the
+# first generated case) is legitimately detached: adapter-called (call=
+# overlays invoke the op through host-side harness code), integer first
+# outputs, etc.  These still pass test_op_registry's full numeric grad
+# check — the probe just can't see the tape edge through the adapter.
+_PROBE_SKIP_PREFIXES = ("vision.", "audio.", "incubate.", "signal.",
+                        "distribution.", "text.", "geometric.")
+
+
+def _probe_tape(n: int) -> List[Finding]:
+    """Run up to ``n`` grad=True rows forward on live inputs and check a
+    GradNode was recorded (deterministic sample: first n by name)."""
+    from ..core.tensor import Tensor
+    from ..tensor.op_registry import REGISTRY
+    import numpy as np
+    findings: List[Finding] = []
+    picked = [(name, row) for name, row in sorted(REGISTRY.items())
+              if row.grad and row.gen_cases is not None
+              and row.paddle_fn is not None
+              and not name.startswith(_PROBE_SKIP_PREFIXES)
+              and not row.list_input][:n]
+    for name, row in picked:
+        try:
+            arrays = (row.grad_cases or row.gen_cases)()[0]
+            tensors = [Tensor(a) for a in arrays]
+            for t in tensors:
+                t.stop_gradient = False
+            out = row.paddle_fn(*tensors, **row.kwargs)
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            if not isinstance(out, Tensor):
+                continue
+            if not any(np.issubdtype(np.asarray(a).dtype, np.floating)
+                       for a in arrays):
+                continue
+            if out._grad_node is None and not out.stop_gradient:
+                findings.append(make_finding(
+                    "PTL106",
+                    f"op '{name}' (grad=True) produced no tape edge on "
+                    "a live probe — backward through it silently yields "
+                    "zeros", file=_REGISTRY_FILE))
+            elif out._grad_node is None and out.stop_gradient:
+                findings.append(make_finding(
+                    "PTL106",
+                    f"op '{name}' (grad=True) returned stop_gradient="
+                    "True output from inputs that require grad — the "
+                    "tape never sees it", file=_REGISTRY_FILE))
+        except Exception:
+            # a probe crash is the generated test's job to report, not
+            # the linter's — skip without masking the real failure
+            continue
+    return findings

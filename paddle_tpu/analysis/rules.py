@@ -1,0 +1,280 @@
+"""PTL rule registry + finding model for ``paddle_tpu.analysis``.
+
+The analysis subsystem's failure model is TPU-native: on a framework
+whose eager machinery runs under jax tracing, the classic bug is no
+longer a wrong kernel but a *silent tracing hazard* — a host sync that
+shatters a ``@to_static`` capture into guard-churning SOT segments
+(jit/sot_lite.py), a Python branch on a traced value, or a registry row
+whose promise drifts from the op it describes.  Every hazard class gets
+a stable ``PTL`` code so findings are suppressible per line
+(``# noqa: PTLxxx``) and machine-consumable (``--json``).
+
+Code space:
+  PTL0xx  tracing-safety lint rules (AST, see lint.py)
+  PTL1xx  op-registry consistency rules (registry_check.py)
+  PTL2xx  captured-graph hazard rules (graphcheck.py)
+
+This module is stdlib-only on purpose: the AST linter must run without
+importing jax (fast CI pre-pass, editors, cold containers).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEV_RANK = {ERROR: 2, WARNING: 1, INFO: 0}
+
+
+def severity_rank(sev: str) -> int:
+    return _SEV_RANK.get(sev, 0)
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str          # "PTL001"
+    name: str          # short kebab slug
+    severity: str      # default severity (emit sites may override)
+    summary: str       # one-line: what fired
+    rationale: str     # why this is a TPU/tracing hazard
+    fix: str           # the recommended remediation
+
+
+@dataclass
+class Finding:
+    code: str
+    severity: str
+    message: str
+    file: str = "<unknown>"
+    line: int = 0
+    col: int = 0
+    rule_name: str = ""
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "severity": self.severity,
+                "message": self.message, "file": self.file,
+                "line": self.line, "col": self.col,
+                "rule_name": self.rule_name}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(code=d["code"], severity=d["severity"],
+                   message=d["message"], file=d.get("file", "<unknown>"),
+                   line=int(d.get("line", 0)), col=int(d.get("col", 0)),
+                   rule_name=d.get("rule_name", ""))
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}:{self.col}: "
+                f"{self.code} [{self.severity}] {self.message}")
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _rule(code, name, severity, summary, rationale, fix):
+    RULES[code] = Rule(code, name, severity, summary, rationale, fix)
+
+
+# ---------------------------------------------------------------------------
+# PTL0xx — tracing-safety lint (AST)
+# ---------------------------------------------------------------------------
+
+_rule(
+    "PTL000", "parse-error", WARNING,
+    "source file could not be parsed",
+    "An unparseable file is invisible to every other rule.",
+    "Fix the syntax error.")
+_rule(
+    "PTL001", "host-sync-call", ERROR,
+    "host-sync call (.numpy()/.item()/.tolist()) in traced code",
+    "Under @to_static/jit tracing a host read either raises (whole-graph "
+    "trace) or becomes an SOT graph break + value guard — one break per "
+    "call, one recompile per new value (jit/sot_lite.py).",
+    "Keep the value on device (jnp math), or move the read outside the "
+    "traced function; if the sync is semantically required (static shape "
+    "from data), suppress with '# noqa: PTL001' and a reason comment.")
+_rule(
+    "PTL002", "host-cast", ERROR,
+    "float()/int()/bool() applied to a Tensor-valued expression in "
+    "traced code",
+    "The cast concretizes a traced value on host — same break/guard "
+    "churn as PTL001, but easier to miss because no method is named.",
+    "Compare/branch on device (jnp.where, lax.cond) or hoist the scalar "
+    "out of the traced region.")
+_rule(
+    "PTL003", "traced-branch", WARNING,
+    "Python if/while on a Tensor-valued condition in traced code",
+    "Python control flow on a traced value forces a host read per call "
+    "and one SOT specialization per branch path; a data-dependent loop "
+    "can hit the specialization cap and de-optimize to eager.",
+    "Use paddle.static.nn.cond / while_loop (lowers to lax.cond/"
+    "while_loop inside ONE program) or jnp.where for select semantics.")
+_rule(
+    "PTL004", "numpy-on-tensor", ERROR,
+    "np.* applied to a Tensor under trace",
+    "numpy eagerly materializes its input: on a traced Tensor it either "
+    "raises or silently falls off the graph (no gradient, no fusion, "
+    "host round-trip every step).",
+    "Use the jnp twin (paddle ops lower to jnp) so the op stays in the "
+    "captured graph.")
+_rule(
+    "PTL005", "inplace-under-trace", WARNING,
+    "in-place ('*_'-suffixed) op inside a captured region",
+    "In-place mutation rebinds tensor identity mid-capture; replayed "
+    "programs see the post-mutation value wherever the buffer is "
+    "reused, and leaf mutation raises under autograd.",
+    "Prefer the out-of-place twin inside traced code; in-place updates "
+    "belong in optimizer steps under no_grad.")
+_rule(
+    "PTL006", "mutable-default-arg", ERROR,
+    "mutable default argument on a function signature",
+    "One list/dict/set instance is shared across every call — on "
+    "Layer.__init__/forward this aliases layer config across model "
+    "instances and poisons recompile caches keyed on argument values.",
+    "Default to None and materialize inside the body.")
+_rule(
+    "PTL007", "impure-host-effect", WARNING,
+    "host side effect (time.time()/random.random()/np.random.*) in "
+    "traced code",
+    "The value is baked at trace time: every replay reuses the recorded "
+    "timestamp/sample instead of drawing a fresh one (the SOT recorder "
+    "refuses RNG ops for exactly this reason).",
+    "Use paddle.seed/default_generator-keyed ops for randomness; take "
+    "timestamps outside the compiled region.")
+_rule(
+    "PTL008", "tensor-iteration", WARNING,
+    "Python iteration over a Tensor in traced code",
+    "Iteration concretizes length on host and unrolls the loop into the "
+    "capture — N host reads and a program whose size scales with data.",
+    "Vectorize with jnp ops, or use paddle.static.nn.while_loop over a "
+    "device counter.")
+_rule(
+    "PTL009", "print-under-trace", INFO,
+    "print() of a Tensor in traced code",
+    "Printing forces a host sync (graph break) on every recorded call; "
+    "under whole-graph trace it prints a tracer, not a value.",
+    "Use jax.debug.print (stays in the graph) or log outside the traced "
+    "function; FLAGS_sot_relax_guards widens logging-only guards.")
+_rule(
+    "PTL010", "float64-literal", WARNING,
+    "float64 dtype literal in traced code",
+    "TPUs have no fast f64 path: an accidental float64 op silently "
+    "doubles memory traffic and falls off the MXU; XLA then propagates "
+    "the promotion through the whole segment.",
+    "Use float32/bfloat16, or paddle.set_default_dtype; check "
+    "graphcheck's float64-promotion report for where it spreads.")
+
+
+# ---------------------------------------------------------------------------
+# PTL1xx — op-registry consistency (registry_check)
+# ---------------------------------------------------------------------------
+
+_rule(
+    "PTL101", "uncovered-op", ERROR,
+    "public op absent from the tested registry surface",
+    "tests/test_op_registry.py only generates tests for rows with a "
+    "case generator — an uncovered row ships with zero parity/grad "
+    "coverage and drifts silently.",
+    "Add a _PARITY/gen_cases spec, or record an explicit exclusion "
+    "reason (OpDef.untested_reason / _NOT_OPS with a reason string).")
+_rule(
+    "PTL102", "np-ref-arity", ERROR,
+    "np_ref signature cannot accept the generated case arguments",
+    "The generated parity test calls np_ref(*case, **np_kwargs); an "
+    "arity mismatch makes the row fail at test time for a spec bug, "
+    "masking real parity regressions.",
+    "Align the np_ref signature (or np_kwargs) with the case tuples "
+    "gen_cases yields.")
+_rule(
+    "PTL103", "paddle-fn-arity", ERROR,
+    "registered paddle_fn cannot accept the generated case arguments",
+    "The row's own test would raise TypeError before touching the op — "
+    "coverage silently becomes a crash test.",
+    "Fix the row's kwargs/list_input flags or the case generator.")
+_rule(
+    "PTL104", "alias-shadow", ERROR,
+    "alias collides with a different registry row",
+    "Two ops answering to one name means the registry (and the test "
+    "matrix) covers one of them while users may get the other.",
+    "Rename the alias or merge the rows.")
+_rule(
+    "PTL105", "grad-promise", ERROR,
+    "grad=True row cannot run its gradient check",
+    "grad=True without a runnable case (or alongside a nondiff mark) is "
+    "a coverage promise the generated tests silently skip.",
+    "Give the row gen_cases/grad_cases, or drop grad and record a "
+    "nondiff_reason.")
+_rule(
+    "PTL106", "backward-unreachable", ERROR,
+    "grad=True op produced no tape edge on a live probe",
+    "The op's output did not connect to a GradNode even though inputs "
+    "required grad — .backward() through it silently yields zeros.",
+    "Route the op through call_op / call_op_custom_vjp so the tape "
+    "records it.")
+
+
+# ---------------------------------------------------------------------------
+# PTL2xx — captured-graph hazards (graphcheck)
+# ---------------------------------------------------------------------------
+
+_rule(
+    "PTL201", "graph-breaks", WARNING,
+    "captured function records graph breaks",
+    "Each break cuts the XLA program and inserts a host round-trip + "
+    "guard check per step on the hot path.",
+    "Remove the host reads (see PTL001/PTL002) or lower the control "
+    "flow with paddle.static.nn.cond/while_loop.")
+_rule(
+    "PTL202", "value-guards", INFO,
+    "value-equality guards active on a captured function",
+    "A changing guarded value re-records a specialization per distinct "
+    "value until the cap, then the signature runs eager.",
+    "If the host reads are logging-only, FLAGS_sot_relax_guards widens "
+    "them to shape-only after a demonstration run.")
+_rule(
+    "PTL203", "eager-deopt", ERROR,
+    "captured function de-optimized to eager",
+    "The signature stopped compiling (specialization cap, oversized "
+    "guard, RNG during recording) — every later call pays eager + "
+    "per-op dispatch on what was meant to be the compiled hot path.",
+    "paddle.jit.sot.stats() names the reason; restructure the break or "
+    "set FLAGS_sot_error_on_fallback to fail loudly.")
+_rule(
+    "PTL204", "float64-promotion", WARNING,
+    "op stream introduces float64 outputs from narrower inputs",
+    "A single f64-producing op poisons everything downstream of it; on "
+    "TPU that is a silent 2x memory + off-MXU penalty.",
+    "Find the introducing op in the report and pin its dtype.")
+_rule(
+    "PTL205", "host-transfers", WARNING,
+    "op stream performs host transfers",
+    "Device→host reads serialize the step: XLA cannot overlap or fuse "
+    "across them.",
+    "Batch the reads, move them off the step path, or keep the value "
+    "on device.")
+
+
+def get_rule(code: str) -> Rule:
+    return RULES[code]
+
+
+def make_finding(code: str, message: str, file: str = "<unknown>",
+                 line: int = 0, col: int = 0,
+                 severity: Optional[str] = None) -> Finding:
+    rule = RULES[code]
+    return Finding(code=code, severity=severity or rule.severity,
+                   message=message, file=file, line=line, col=col,
+                   rule_name=rule.name)
+
+
+def max_severity(findings: List[Finding]) -> Optional[str]:
+    if not findings:
+        return None
+    return max(findings, key=lambda f: severity_rank(f.severity)).severity
+
+
+def has_errors(findings: List[Finding]) -> bool:
+    return any(f.severity == ERROR for f in findings)

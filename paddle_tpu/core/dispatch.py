@@ -43,6 +43,62 @@ _op_observer = None
 # installed by paddle_tpu.profiler while recording: (op_name, t0, t1)
 _prof_op_hook = None
 
+# op-stream introspection (paddle_tpu.analysis.graphcheck): hooks called
+# with an OpEvent for every dispatched op.  A list (not a single slot)
+# so nested observers compose; kept empty on the hot path — the only
+# steady-state cost is one falsy check per call_op.
+_op_stream_hooks: List[Callable] = []
+
+
+class OpEvent:
+    """Lightweight per-op record for stream analysis: name + input/
+    output (shape, dtype) pairs.  Values are never retained."""
+
+    __slots__ = ("op_name", "in_avals", "out_avals")
+
+    def __init__(self, op_name, in_avals, out_avals):
+        self.op_name = op_name
+        self.in_avals = in_avals      # [(shape, dtype_str), ...]
+        self.out_avals = out_avals
+
+    def __repr__(self):
+        return (f"OpEvent({self.op_name!r}, in={self.in_avals}, "
+                f"out={self.out_avals})")
+
+
+def _aval(v):
+    try:
+        return (tuple(v.shape), str(v.dtype))
+    except Exception:
+        return ((), type(v).__name__)
+
+
+def _emit_op_event(op_name, arrays, outs, multi):
+    vals = list(outs) if multi and isinstance(outs, (tuple, list)) \
+        else [outs]
+    ev = OpEvent(op_name or "op", [_aval(a) for a in arrays],
+                 [_aval(o) for o in vals])
+    for h in list(_op_stream_hooks):
+        h(ev)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def observe_op_stream(hook: Callable):
+    """Register ``hook(OpEvent)`` for every op dispatched inside the
+    block (the graphcheck analyzer's entry point; composes with the
+    static-capture observer and nests)."""
+    _op_stream_hooks.append(hook)
+    try:
+        yield hook
+    finally:
+        try:
+            _op_stream_hooks.remove(hook)
+        except ValueError:
+            pass
+
 
 class GradNode:
     """One recorded op on the tape."""
@@ -152,6 +208,9 @@ def _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out, op_name,
             _op_observer(rec_fn, kwargs, tensor_args,
                          list(wrapped) if multi_out else [wrapped],
                          multi_out, op_name)
+        if _op_stream_hooks:
+            _emit_op_event(op_name or getattr(fn, "__name__", "op"),
+                           arrays, outs, multi_out)
         return wrapped
 
     f = lambda *xs: fn(*xs, **kwargs)
@@ -169,6 +228,8 @@ def _call_op_inner(fn, arrays, kwargs, tensor_args, multi_out, op_name,
         _op_observer(rec_fn, kwargs, tensor_args,
                      list(wrapped) if multi_out else [wrapped],
                      multi_out, op_name)
+    if _op_stream_hooks:
+        _emit_op_event(node.op_name, arrays, outs, multi_out)
     return wrapped
 
 
@@ -210,6 +271,9 @@ def call_op_custom_vjp(fwd_fn: Callable, bwd_fn: Callable,
         wrapped = _wrap_outputs(outs, multi_out, None, True)
         _observe_custom_vjp(fwd_fn, bwd_fn, kwargs, tensor_args, wrapped,
                             multi_out, op_name)
+        if _op_stream_hooks:
+            _emit_op_event(op_name or getattr(fwd_fn, "__name__", "op"),
+                           arrays, outs, multi_out)
         return wrapped
 
     n_in = len(arrays)
@@ -229,6 +293,9 @@ def call_op_custom_vjp(fwd_fn: Callable, bwd_fn: Callable,
     wrapped = _wrap_outputs(outs, multi_out, node, False)
     _observe_custom_vjp(fwd_fn, bwd_fn, kwargs, tensor_args, wrapped,
                         multi_out, op_name)
+    if _op_stream_hooks:
+        _emit_op_event(op_name or getattr(fwd_fn, "__name__", "op"),
+                       arrays, outs, multi_out)
     return wrapped
 
 

@@ -228,6 +228,13 @@ class SotTrace:
         self.out_leaf_ids = out_leaf_ids
         self.input_ids = input_ids
         self.spec_sig = _op_spec_sig(ops, recording.breaks)
+        # capture metadata read by paddle_tpu.analysis.graphcheck: total
+        # recorded ops, op-name stream, and the break positions that cut
+        # it (one guard group per boundary)
+        self.n_ops = len(ops)
+        self.op_names = [op.name or getattr(op.fn, "__name__", "op")
+                         for op in ops]
+        self.break_bounds = sorted({i for i, _, _ in recording.breaks})
         # set by replay(): None (ok) | "value" (all guard failures were
         # value-only at matching shapes — relaxation candidate) | "shape"
         self.last_fail: Optional[str] = None
@@ -342,6 +349,22 @@ class SotTrace:
                     if not force:
                         return None
         return self._rebuild(env)
+
+    def guard_inventory(self) -> List[dict]:
+        """Machine-readable guard list for the analyzer: one entry per
+        guard with its op-stream boundary, the recorded value's shape/
+        dtype, and whether the value (vs shape only) is still checked."""
+        out = []
+        for boundary in sorted(self.guards_at):
+            for _, expected, check_value in self.guards_at[boundary]:
+                out.append({
+                    "boundary": boundary,
+                    "shape": list(expected.shape),
+                    "dtype": str(expected.dtype),
+                    "check_value": bool(check_value),
+                    "elems": int(expected.size),
+                })
+        return out
 
     def relax_value_guards(self):
         """Flip every guard to shape-only (called once a probe run has

@@ -364,6 +364,36 @@ class StaticFunction:
                 RuntimeWarning)
         return out
 
+    # -- capture metadata (paddle_tpu.analysis.graphcheck) ---------------
+    def capture_report(self) -> dict:
+        """Machine-readable capture state: whole-graph signatures, SOT
+        specializations with per-trace segment/break/guard inventories,
+        and the cumulative SotStats counters.  Read-only; the analyzer
+        builds its graph-break / guard / recompile report from this."""
+        specializations = []
+        for cache in self._sot_cache.values():
+            specializations.append({
+                "traces": [{
+                    "segments": len(tr.segments),
+                    "ops": tr.n_ops,
+                    "op_names": list(tr.op_names),
+                    "graph_breaks": len(tr.break_bounds),
+                    "break_bounds": list(tr.break_bounds),
+                    "guards": tr.guard_inventory(),
+                } for tr in cache.traces],
+                "gave_up": cache.gave_up,
+                "gave_up_reason": cache.gave_up_reason,
+            })
+        return {
+            "name": self.__name__,
+            "broken": self._broken,
+            "full_graph": self._full_graph,
+            "whole_graph_signatures": len(self._cache),
+            "sot_signatures": len(self._sot_cache),
+            "stats": self._sot_stats.as_dict(),
+            "specializations": specializations,
+        }
+
     # -- reference API ----------------------------------------------------
     def concrete_program_specify_input_spec(self, *a, **kw):
         return None

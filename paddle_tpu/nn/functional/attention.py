@@ -177,7 +177,9 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         m = ml if ml is not None else int(v.max())
         return (jnp.arange(m)[None, :] < v[..., None]).astype(jdt)
     if maxlen is None:
-        m = int(x.numpy().max())
+        # output width = max(x): data-dependent shape, must be host-read
+        # before lowering (pass maxlen explicitly to stay trace-safe)
+        m = int(x.numpy().max())  # noqa: PTL001
         return call_op(lambda v: (jnp.arange(m)[None, :] < v[..., None]).astype(jdt),
                        (x,), {}, op_name="sequence_mask")
     return call_op(f, (x,), {}, op_name="sequence_mask")

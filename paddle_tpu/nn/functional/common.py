@@ -30,16 +30,48 @@ def linear(x, weight, bias=None, name=None):
                    op_name="linear")
 
 
+def _dropout_tensor_p(x, p, axis, training, mode):
+    """Tensor-valued rate: keep ``p`` on device.  bernoulli + the keep
+    scale both accept traced probabilities, so a Tensor p no longer
+    graph-breaks a @to_static capture (it used to host-sync via
+    ``p.item()``).  Range validation is skipped — it would itself be a
+    host read."""
+    p = ensure_tensor(p)
+    if not training:
+        if mode == "downscale_in_infer":
+            return call_op(lambda v, pp: v * (1.0 - pp), (x, p), {},
+                           op_name="dropout")
+        return x
+    key = next_key()
+    axes = None
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+
+    def f(v, pp):
+        keep = (1.0 - pp).astype(v.dtype)
+        mshape = list(v.shape)
+        if axes is not None:
+            mshape = [v.shape[i] if i in axes else 1 for i in range(v.ndim)]
+        mask = jax.random.bernoulli(key, keep, tuple(mshape))
+        out = jnp.where(mask, v, jnp.zeros((), v.dtype))
+        if mode == "upscale_in_train":
+            # p == 1 -> keep == 0: mask is all-False, the division guard
+            # keeps both the value and its vjp finite (0 / eps, not 0/0)
+            out = out / jnp.maximum(keep, jnp.asarray(1e-12, v.dtype))
+        return out
+    return call_op(f, (x, p), {}, op_name="dropout")
+
+
 def dropout(x, p: float = 0.5, axis=None, training: bool = True,
             mode: str = "upscale_in_train", name=None):
     """ref: nn/functional/common.py dropout — both modes preserved:
     'upscale_in_train' (scale by 1/keep in train) and 'downscale_in_infer'
     (scale by keep at infer)."""
     x = ensure_tensor(x)
+    if isinstance(p, Tensor):
+        return _dropout_tensor_p(x, p, axis, training, mode)
     if p == 0.0 and mode == "upscale_in_train":
         return x
-    if isinstance(p, Tensor):
-        p = float(p.item())
     if not 0 <= p <= 1:
         raise ValueError("dropout p must be in [0, 1]")
     keep = 1.0 - p
@@ -100,7 +132,10 @@ def _normalize_pad(pad, ndim, data_format):
     """paddle pad list is [last_dim_lo, last_dim_hi, 2nd_last_lo, ...]
     over the *spatial* dims when x is 3/4/5-D."""
     if isinstance(pad, Tensor):
-        pad = pad.numpy().reshape(-1).tolist()
+        # pad widths parameterize the program's shapes — they must be
+        # concrete before lowering (XLA static shapes); a Tensor pad
+        # spec is a documented graph-break point
+        pad = pad.numpy().reshape(-1).tolist()  # noqa: PTL001
     pad = [int(p) for p in pad]
     return pad
 
@@ -218,16 +253,19 @@ def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
                     else list(range(2, nd)))
     in_spatial = [x.shape[a] for a in spatial_axes]
 
+    # the output size parameterizes the program's shapes — a Tensor
+    # size/scale_factor must be concretized before lowering (XLA static
+    # shapes); these are documented graph-break points
     if size is not None:
         if isinstance(size, Tensor):
-            size = size.numpy().reshape(-1).tolist()
-        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s)
+            size = size.numpy().reshape(-1).tolist()  # noqa: PTL001
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s)  # noqa: PTL001
                        for s in (size if isinstance(size, (list, tuple)) else [size])]
     else:
         if isinstance(scale_factor, (int, float)):
             scale_factor = [scale_factor] * len(in_spatial)
         if isinstance(scale_factor, Tensor):
-            scale_factor = scale_factor.numpy().reshape(-1).tolist()
+            scale_factor = scale_factor.numpy().reshape(-1).tolist()  # noqa: PTL001
         out_spatial = [int(s * f) for s, f in zip(in_spatial, scale_factor)]
 
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",

@@ -85,7 +85,9 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     grid (N, H, W, 2) for grid_sample."""
     theta = ensure_tensor(theta)
     if isinstance(out_shape, Tensor):
-        out_shape = [int(v) for v in out_shape.numpy()]
+        # grid dims parameterize output shapes — must be concrete before
+        # lowering (XLA static shapes); documented graph-break point
+        out_shape = [int(v) for v in out_shape.numpy()]  # noqa: PTL001
     N, C, H, W = [int(s) for s in out_shape]
 
     def impl(th):

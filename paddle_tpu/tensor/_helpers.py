@@ -72,7 +72,9 @@ def make_reduction(jfn: Callable, name: str, default_keepdim: bool = False) -> C
         kw = {}
         if axis is not None:
             if isinstance(axis, Tensor):
-                axis = tuple(int(a) for a in axis.numpy().reshape(-1))
+                # reduction axes are program structure, not data — a
+                # Tensor axis must be concretized (graph-break point)
+                axis = tuple(int(a) for a in axis.numpy().reshape(-1))  # noqa: PTL001
             elif isinstance(axis, (list, tuple)):
                 axis = tuple(int(a) for a in axis)
             else:
@@ -93,7 +95,8 @@ def normalize_axis(axis, ndim: int):
     if axis is None:
         return None
     if isinstance(axis, Tensor):
-        axis = axis.numpy().reshape(-1).tolist()
+        # axes are program structure — concretize (graph-break point)
+        axis = axis.numpy().reshape(-1).tolist()  # noqa: PTL001
     if isinstance(axis, (list, tuple)):
         return tuple(int(a) % ndim if a < 0 else int(a) for a in axis)
     a = int(axis)
@@ -103,13 +106,16 @@ def normalize_axis(axis, ndim: int):
 def shape_list(shape) -> Sequence[int]:
     """Normalize a paddle shape argument (list/tuple/Tensor/ints)."""
     if isinstance(shape, Tensor):
-        return tuple(int(s) for s in shape.numpy().reshape(-1))
+        # shapes must be static under XLA — a Tensor shape argument is a
+        # documented graph-break point (jax.export dynamic dims flow
+        # through the symbolic branch below instead)
+        return tuple(int(s) for s in shape.numpy().reshape(-1))  # noqa: PTL001
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     out = []
     for s in shape:
         if isinstance(s, Tensor):
-            out.append(int(s.item()))
+            out.append(int(s.item()))  # noqa: PTL001 — static shape element
         elif isinstance(s, (int, np.integer)):
             out.append(int(s))
         else:

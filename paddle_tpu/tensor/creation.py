@@ -37,14 +37,17 @@ def ones(shape, dtype=None, name=None):
 
 def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        # keep the fill value on device: jnp.full takes a traced scalar,
+        # so a Tensor fill_value no longer host-syncs (.item()) under
+        # @to_static capture
+        fill_value = unwrap(fill_value)
     if dtype is None:
-        if isinstance(fill_value, bool):
+        if isinstance(fill_value, bool) or (
+                hasattr(fill_value, "dtype")
+                and fill_value.dtype == jnp.bool_):
             dtype = dtypes.bool_
-        elif isinstance(fill_value, int):
-            dtype = dtypes.default_float()  # paddle full defaults to float32
         else:
-            dtype = dtypes.default_float()
+            dtype = dtypes.default_float()  # paddle full defaults to float32
     return Tensor(jnp.full(shape_list(shape), fill_value, dtype=_dt(dtype)))
 
 
@@ -61,7 +64,7 @@ def ones_like(x, dtype=None, name=None):
 def full_like(x, fill_value, dtype=None, name=None):
     x = ensure_tensor(x)
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        fill_value = unwrap(fill_value)  # stays on device (trace-safe)
     return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype)))
 
 
@@ -92,7 +95,8 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
 def linspace(start, stop, num, dtype=None, name=None):
     start = unwrap(start) if isinstance(start, Tensor) else start
     stop = unwrap(stop) if isinstance(stop, Tensor) else stop
-    num = int(unwrap(num)) if isinstance(num, Tensor) else int(num)
+    num = (int(unwrap(num)) if isinstance(num, Tensor)  # noqa: PTL002 — num is the output length (static shape)
+           else int(num))
     return Tensor(jnp.linspace(start, stop, num,
                                dtype=_dt(dtype, dtypes.default_float())))
 

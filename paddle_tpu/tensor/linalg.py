@@ -189,8 +189,8 @@ def svdvals(x, name=None):
 
 def eig(x, name=None):
     x = ensure_tensor(x)
-    arr = np.asarray(x._data)
-    w, v = np.linalg.eig(arr)  # CPU-only in the reference too
+    arr = np.asarray(x._data)  # noqa: PTL004 — general eig has no XLA kernel; CPU-only in the reference too
+    w, v = np.linalg.eig(arr)
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
 
 
@@ -204,7 +204,7 @@ def eigh(x, UPLO="L", name=None):
 
 def eigvals(x, name=None):
     x = ensure_tensor(x)
-    arr = np.asarray(x._data)
+    arr = np.asarray(x._data)  # noqa: PTL004 — general eigvals has no XLA kernel; CPU-only in the reference too
     return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
 
 
@@ -265,10 +265,11 @@ def corrcoef(x, rowvar=True, name=None):
 
 def tensordot(x, y, axes=2, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
+    # contraction axes are program structure — concretize (break point)
     if isinstance(axes, Tensor):
-        axes = axes.tolist()
+        axes = axes.tolist()  # noqa: PTL001
     if isinstance(axes, (list, tuple)):
-        axes = tuple(tuple(a.tolist()) if isinstance(a, Tensor)
+        axes = tuple(tuple(a.tolist()) if isinstance(a, Tensor)  # noqa: PTL001
                      else (tuple(a) if isinstance(a, (list, tuple)) else a)
                      for a in axes)
     return call_op(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y), {},

@@ -74,7 +74,8 @@ def t(input, name=None):
 
 def concat(x, axis=0, name=None):
     tensors = [ensure_tensor(t) for t in x]
-    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    ax = (int(unwrap(axis)) if isinstance(axis, Tensor)  # noqa: PTL002 — axis is program structure (static)
+          else int(axis))
     return call_op(lambda *vs: jnp.concatenate(vs, axis=ax), tensors, {},
                    op_name="concat")
 
@@ -97,7 +98,8 @@ def unstack(x, axis=0, num=None, name=None):
 
 def split(x, num_or_sections, axis=0, name=None):
     x = ensure_tensor(x)
-    ax = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    ax = (int(unwrap(axis)) if isinstance(axis, Tensor)  # noqa: PTL002 — axis is program structure (static)
+          else int(axis))
     dim = x.shape[ax]
     if isinstance(num_or_sections, int):
         if dim % num_or_sections != 0:
@@ -106,7 +108,7 @@ def split(x, num_or_sections, axis=0, name=None):
                 f"num_or_sections={num_or_sections}")
         sections = [dim // num_or_sections] * num_or_sections
     else:
-        sections = [int(unwrap(s)) if isinstance(s, Tensor) else int(s)
+        sections = [int(unwrap(s)) if isinstance(s, Tensor) else int(s)  # noqa: PTL002 — section sizes are static shapes
                     for s in num_or_sections]
         n_neg = sum(1 for s in sections if s < 0)
         if n_neg:
@@ -157,7 +159,7 @@ def squeeze_(x, axis=None, name=None):
 def unsqueeze(x, axis, name=None):
     x = ensure_tensor(x)
     if isinstance(axis, Tensor):
-        axis = axis.numpy().reshape(-1).tolist()
+        axis = axis.numpy().reshape(-1).tolist()  # noqa: PTL001 — axes are program structure (static)
     ax = tuple(int(a) for a in (axis if isinstance(axis, (list, tuple)) else [axis]))
     return call_op(lambda v: jnp.expand_dims(v, ax), (x,), {},
                    op_name="unsqueeze")
@@ -183,7 +185,8 @@ def flatten_(x, start_axis=0, stop_axis=-1, name=None):
 
 def gather(x, index, axis=None, name=None):
     x, index = ensure_tensor(x), ensure_tensor(index)
-    ax = 0 if axis is None else (int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis))
+    ax = 0 if axis is None else (int(unwrap(axis))  # noqa: PTL002 — axis is program structure (static)
+                                 if isinstance(axis, Tensor) else int(axis))
     return call_op(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
                                          axis=ax), (x, index), {},
                    op_name="gather")
@@ -309,7 +312,7 @@ def masked_select(x, mask, name=None):
     x, mask = ensure_tensor(x), ensure_tensor(mask)
     # dynamic output shape — eager only (graph-break under jit, like ref's
     # dynamic-shape ops)
-    m = np.asarray(mask._data)
+    m = np.asarray(mask._data)  # noqa: PTL004 — dynamic output shape (see comment above)
     return call_op(lambda v: v[m.nonzero()] if m.shape == tuple(x.shape)
                    else v[np.broadcast_to(m, x.shape).nonzero()], (x,), {},
                    op_name="masked_select")
@@ -328,7 +331,8 @@ def masked_fill_(x, mask, value, name=None):
 
 def masked_scatter(x, mask, value, name=None):
     x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
-    m = np.asarray(mask._data)
+    # dynamic mask population — host-side by design (eager-only op)
+    m = np.asarray(mask._data)  # noqa: PTL004
     n = int(m.sum())
 
     def f(v, mk, u):
@@ -399,7 +403,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, dtype="int64", name=None):
     x = ensure_tensor(x)
     # dynamic shapes: compute on host (eager-only op, like ref's unique)
-    arr = np.asarray(x._data)
+    arr = np.asarray(x._data)  # noqa: PTL004
     res = np.unique(arr, return_index=return_index,
                     return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
@@ -415,7 +419,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
                        dtype="int64", name=None):
     x = ensure_tensor(x)
-    arr = np.asarray(x._data)
+    arr = np.asarray(x._data)  # noqa: PTL004 — dynamic shapes: host-side by design (eager-only op)
     if axis is None:
         arr = arr.reshape(-1)
         ax = 0

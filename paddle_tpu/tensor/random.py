@@ -29,8 +29,10 @@ def rand(shape, dtype=None, name=None):
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
     key = (jax.random.PRNGKey(seed) if seed else random_state.next_key())
-    lo = float(unwrap(min)) if isinstance(min, Tensor) else float(min)
-    hi = float(unwrap(max)) if isinstance(max, Tensor) else float(max)
+    # keep Tensor bounds on device: jax.random.uniform takes traced
+    # minval/maxval, so Tensor min/max no longer host-sync under capture
+    lo = unwrap(min) if isinstance(min, Tensor) else float(min)
+    hi = unwrap(max) if isinstance(max, Tensor) else float(max)
     return Tensor(jax.random.uniform(key, shape_list(shape),
                                      dtype=_dt(dtype, dtypes.default_float()),
                                      minval=lo, maxval=hi))

@@ -45,7 +45,8 @@ def sort(x, axis=-1, descending=False, stable=False, name=None):
 
 def topk(x, k, axis=None, largest=True, sorted=True, name=None):
     x = ensure_tensor(x)
-    kk = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+    kk = (int(unwrap(k)) if isinstance(k, Tensor)  # noqa: PTL002 — k is the output width (static shape)
+          else int(k))
 
     def f(v):
         ax = v.ndim - 1 if axis is None else axis % v.ndim
@@ -79,7 +80,7 @@ def where_(condition, x=None, y=None, name=None):
 
 def nonzero(x, as_tuple=False):
     x = ensure_tensor(x)
-    arr = np.asarray(x._data)  # dynamic shape → host (eager-only)
+    arr = np.asarray(x._data)  # noqa: PTL004 — dynamic shape → host (eager-only)
     nz = arr.nonzero()
     if as_tuple:
         return tuple(Tensor(jnp.asarray(i.astype(np.int64)).reshape(-1, 1))

@@ -39,6 +39,10 @@ import pytest
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: expensive test, skipped unless RUN_SLOW=1")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis self-checks (paddle_tpu."
+        "analysis self-lint + registry consistency); tier-1 runs these "
+        "as the CI gate — `pytest -m lint` runs just the gate")
 
 
 def pytest_collection_modifyitems(config, items):

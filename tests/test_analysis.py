@@ -1,0 +1,432 @@
+"""paddle_tpu.analysis: tracing-safety linter, registry checker, and
+captured-graph analyzer.
+
+Four layers of coverage:
+  * every PTL0xx lint rule fires on a crafted fixture snippet, and a
+    clean snippet produces zero findings;
+  * the JSON output schema round-trips;
+  * the package self-lint + registry check hold the zero-error contract
+    (the ``lint`` marker — tier-1 runs these as the CI gate);
+  * graphcheck's reported guard/graph-break counts are pinned against
+    what the SOT-lite scenarios in test_sot_lite.py actually produce
+    (regression guard: recorder and analyzer must not drift).
+"""
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import lint_source
+from paddle_tpu.analysis.cli import (findings_from_json, findings_to_json,
+                                     main as cli_main)
+from paddle_tpu.jit import to_static
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# PTL0xx rule fixtures — each must fire
+# ---------------------------------------------------------------------------
+
+def test_ptl001_host_sync_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    v = x.numpy()\n"
+        "    s = x.item()\n"
+        "    l = x.tolist()\n"
+        "    return v, s, l\n")
+    fs = lint_source(src, "snippet.py")
+    assert sum(1 for f in fs if f.code == "PTL001") == 3
+    assert all(f.severity == "error" for f in fs if f.code == "PTL001")
+
+
+def test_ptl002_host_cast_fires():
+    src = (
+        "@paddle.jit.to_static\n"
+        "def f(x):\n"
+        "    a = float(x.sum())\n"
+        "    b = int(x.max())\n"
+        "    c = bool(x.mean() > 0)\n"
+        "    return a + b + c\n")
+    fs = lint_source(src, "snippet.py")
+    assert sum(1 for f in fs if f.code == "PTL002") == 3
+
+
+def test_ptl003_traced_branch_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    if x.sum() > 0:\n"
+        "        return x + 1\n"
+        "    while x.mean() < 0:\n"
+        "        x = x + 1\n"
+        "    return x\n")
+    fs = lint_source(src, "snippet.py")
+    assert sum(1 for f in fs if f.code == "PTL003") == 2
+
+
+def test_ptl004_numpy_on_tensor_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    return np.abs(x)\n")
+    fs = lint_source(src, "snippet.py")
+    assert "PTL004" in _codes(fs)
+
+
+def test_ptl005_inplace_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    x.add_(1.0)\n"
+        "    return x\n")
+    fs = lint_source(src, "snippet.py")
+    assert "PTL005" in _codes(fs)
+
+
+def test_ptl006_mutable_default_fires():
+    src = (
+        "class M(nn.Layer):\n"
+        "    def __init__(self, sizes=[1, 2]):\n"
+        "        pass\n"
+        "    def forward(self, x, cache={}):\n"
+        "        return x\n")
+    fs = lint_source(src, "snippet.py")
+    hits = [f for f in fs if f.code == "PTL006"]
+    assert len(hits) == 2
+    assert all(f.severity == "error" for f in hits)
+    # fires outside Layer classes too (any def)
+    fs2 = lint_source("def g(a, xs=list()):\n    return xs\n", "s.py")
+    assert "PTL006" in _codes(fs2)
+
+
+def test_ptl007_impure_host_effect_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    t = time.time()\n"
+        "    r = random.random()\n"
+        "    n = np.random.randn(3)\n"
+        "    return x * t * r\n")
+    fs = lint_source(src, "snippet.py")
+    assert sum(1 for f in fs if f.code == "PTL007") == 3
+
+
+def test_ptl008_tensor_iteration_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    for row in x:\n"
+        "        pass\n"
+        "    return x\n")
+    fs = lint_source(src, "snippet.py")
+    assert "PTL008" in _codes(fs)
+
+
+def test_ptl009_print_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    print(x.mean())\n"
+        "    return x\n")
+    fs = lint_source(src, "snippet.py")
+    assert "PTL009" in _codes(fs)
+
+
+def test_ptl010_float64_fires():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    y = paddle.cast(x, 'float64')\n"
+        "    z = paddle.zeros([3], dtype='float64')\n"
+        "    return y + z\n")
+    fs = lint_source(src, "snippet.py")
+    assert sum(1 for f in fs if f.code == "PTL010") == 2
+
+
+def test_clean_snippet_is_clean():
+    src = (
+        "@to_static\n"
+        "def f(x, w):\n"
+        "    h = paddle.matmul(x, w)\n"
+        "    h = paddle.nn.functional.relu(h)\n"
+        "    if w is None:\n"                 # identity test: host-safe
+        "        return h\n"
+        "    return h.sum(axis=-1)\n"
+        "\n"
+        "def host_helper(arr):\n"             # undecorated: not traced
+        "    return float(arr.sum())\n")
+    fs = lint_source(src, "snippet.py")
+    assert fs == []
+
+
+def test_untraced_function_not_flagged():
+    # host syncs outside traced regions are fine (eager user code)
+    src = "def f(x):\n    return x.numpy()\n"
+    assert lint_source(src, "snippet.py") == []
+    # ...but the same file in surface mode treats every def as traced
+    assert "PTL001" in _codes(lint_source(src, "snippet.py", surface=True))
+
+
+def test_nested_function_inherits_traced():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    def inner(v):\n"
+        "        return v.numpy()\n"
+        "    return inner(x)\n")
+    assert "PTL001" in _codes(lint_source(src, "snippet.py"))
+
+
+def test_ptl_traced_comment_opt_in():
+    src = ("def step(x):  # ptl: traced\n"
+           "    return float(x.sum())\n")
+    assert "PTL002" in _codes(lint_source(src, "snippet.py"))
+
+
+def test_noqa_suppression():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    a = x.numpy()  # noqa: PTL001\n"
+        "    b = x.item()  # noqa\n"
+        "    c = x.tolist()  # noqa: PTL006\n"   # wrong code: kept
+        "    return a, b, c\n")
+    fs = lint_source(src, "snippet.py")
+    assert len(fs) == 1 and fs[0].line == 5
+
+
+def test_surface_metadata_not_tensorish():
+    # .shape / dtype predicates / `is None` must not trip the rules
+    src = (
+        "def op(x):\n"
+        "    x = ensure_tensor(x)\n"
+        "    n = int(x.shape[-1])\n"
+        "    if x is not None and jnp.issubdtype(x.dtype, jnp.floating):\n"
+        "        return n\n"
+        "    return 0\n")
+    assert lint_source(src, "snippet.py", surface=True) == []
+
+
+# ---------------------------------------------------------------------------
+# JSON schema round-trip + CLI
+# ---------------------------------------------------------------------------
+
+def test_json_roundtrip():
+    src = (
+        "@to_static\n"
+        "def f(x):\n"
+        "    return x.numpy()\n")
+    fs = lint_source(src, "roundtrip.py")
+    payload = json.loads(json.dumps(findings_to_json(fs)))
+    assert payload["version"] == 1
+    assert payload["summary"]["total"] == len(fs) == 1
+    assert payload["summary"]["error"] == 1
+    back = findings_from_json(payload)
+    assert [f.to_dict() for f in back] == [f.to_dict() for f in fs]
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("@to_static\ndef f(x):\n    return x.numpy()\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("@to_static\ndef f(x):\n    return x + 1\n")
+    assert cli_main([str(clean)]) == 0
+    capsys.readouterr()
+    assert cli_main([str(bad)]) == 1
+    capsys.readouterr()
+    rc = cli_main([str(bad), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["summary"]["error"] == 1
+    assert out["findings"][0]["code"] == "PTL001"
+    # --select filters down to nothing -> exit 0
+    assert cli_main([str(bad), "--select", "PTL006"]) == 0
+
+
+def test_rule_table_complete():
+    # every emitted code has a registered rule with rationale + fix
+    for code, rule in analysis.RULES.items():
+        assert rule.summary and rule.rationale and rule.fix, code
+        assert rule.severity in ("error", "warning", "info")
+
+
+# ---------------------------------------------------------------------------
+# the self-enforcing contracts (CI gate — `lint` marker)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_package_self_lint_zero_errors():
+    """`python -m paddle_tpu.analysis paddle_tpu/` must exit 0: every
+    error-severity hazard in the package is fixed or carries a reasoned
+    noqa."""
+    fs = analysis.lint_paths([os.path.join(_REPO, "paddle_tpu")])
+    errors = [f.render() for f in fs if f.severity == "error"]
+    assert not errors, "\n".join(errors)
+
+
+@pytest.mark.lint
+def test_examples_lint_zero_errors():
+    fs = analysis.lint_paths([os.path.join(_REPO, "examples")])
+    errors = [f.render() for f in fs if f.severity == "error"]
+    assert not errors, "\n".join(errors)
+
+
+@pytest.mark.lint
+def test_registry_check_clean():
+    """Zero uncovered public tensor ops (or explicit, reasoned
+    exclusions) and zero consistency violations."""
+    fs = analysis.check_registry(deep_sample=8)
+    assert not fs, "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.lint
+def test_registry_exclusions_carry_reasons():
+    from paddle_tpu.tensor.op_registry import _NOT_OPS, REGISTRY, \
+        build_full_registry
+    build_full_registry()
+    assert isinstance(_NOT_OPS, dict)
+    for name, reason in _NOT_OPS.items():
+        assert reason and isinstance(reason, str), name
+    for name, row in REGISTRY.items():
+        if row.gen_cases is None:
+            assert row.untested_reason, name
+
+
+# ---------------------------------------------------------------------------
+# graphcheck — SOT-lite regression guard (recorder vs analyzer)
+# ---------------------------------------------------------------------------
+
+def _branchy(x):
+    y = x * 2.0
+    if (y.mean() > 0.0):          # host read -> graph break
+        z = y + 10.0
+    else:
+        z = y - 10.0
+    return z * 3.0
+
+
+def test_graphcheck_matches_sot_recorder():
+    """The counts graphcheck reports must equal what the SOT recorder
+    (SotStats + the traces themselves) produced for the scenarios
+    test_sot_lite.py pins — catches drift between recorder and
+    analyzer."""
+    fn = to_static(_branchy)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fn(paddle.to_tensor(np.full((4,), 2.0, np.float32)))
+        fn(paddle.to_tensor(np.full((4,), -2.0, np.float32)))
+        fn(paddle.to_tensor(np.full((4,), 2.0, np.float32)))  # replay
+
+    rep = analysis.inspect_static_fn(fn)
+    st = fn._sot_stats
+
+    # analyzer vs recorder: every roll-up must agree
+    assert rep["trace_count"] == 2          # test_sot_lite: both branches
+    assert rep["graph_break_count"] == st.graph_breaks == 2
+    assert rep["segment_count"] == st.segments
+    assert rep["guard_count"] == 2          # one value guard per branch
+    assert rep["recompile_count"] == st.records - 1 == 1
+    assert rep["stats"]["replay_hits"] == st.replay_hits == 1
+    assert rep["sot_signatures"] == st.signatures == 1
+
+    # guard inventory details: scalar bool guards, value-checked
+    sot = next(iter(fn._sot_cache.values()))
+    inv = [g for tr in rep["specializations"][0]["traces"]
+           for g in tr["guards"]]
+    assert len(inv) == sum(len(tr.guards_at[b]) for tr in sot.traces
+                           for b in tr.guards_at)
+    assert all(g["check_value"] for g in inv)
+
+    # hazards: breaks + value guards present, no eager de-opt
+    hz = {h.code for h in rep["hazards"]}
+    assert hz == {"PTL201", "PTL202"}
+
+
+def test_graphcheck_reports_eager_deopt():
+    def leaky(x):
+        s = float(x.sum())
+        return x + s
+
+    fn = to_static(leaky)
+    from paddle_tpu.jit import sot_lite
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(sot_lite.MAX_TRACES_PER_SIG + 2):
+            fn(paddle.to_tensor(np.full((2,), float(i), np.float32)))
+    rep = analysis.inspect_static_fn(fn)
+    assert {h.code for h in rep["hazards"]} >= {"PTL201", "PTL203"}
+    assert rep["specializations"][0]["gave_up"]
+
+
+def test_graphcheck_clean_function_no_hazards():
+    @to_static
+    def clean(x):
+        return (x * 2.0 + 1.0).sum()
+
+    clean(paddle.to_tensor(np.ones((3,), np.float32)))
+    rep = analysis.inspect_static_fn(clean)
+    assert rep["graph_break_count"] == 0
+    assert rep["hazards"] == []
+    assert rep["whole_graph_signatures"] == 1
+
+
+def test_stream_report_host_transfers_and_ops():
+    def g(x):
+        h = x * 2.0
+        _ = float(h.sum())          # host transfer
+        return h + 1.0
+
+    sr = analysis.stream_report(
+        g, paddle.to_tensor(np.ones((3,), np.float32)))
+    assert sr["host_transfers"] == 1
+    assert sr["ops"] >= 3
+    assert any(h.code == "PTL205" for h in sr["hazards"])
+    np.testing.assert_allclose(sr["result"].numpy(), 3.0)
+
+
+def test_stream_report_f64_promotion():
+    def g(x):
+        return paddle.cast(x, "float64")  # noqa: PTL010 — the fixture IS the hazard
+
+    sr = analysis.stream_report(
+        g, paddle.to_tensor(np.ones((3,), np.float32)))
+    if any(dt == "float64" for p in sr["float64_promotions"]
+           for _, dt in p["out_avals"]):
+        assert any(h.code == "PTL204" for h in sr["hazards"])
+    # without x64, cast demotes silently — no promotion reported
+    else:
+        assert sr["float64_promotions"] == []
+
+
+def test_check_jaxpr_histogram():
+    import jax
+    import jax.numpy as jnp
+    jx = jax.make_jaxpr(lambda a: jnp.sin(a) + jnp.cos(a))(
+        np.ones((3,), np.float32))
+    rep = analysis.check_jaxpr(jx)
+    assert rep["histogram"]["sin"] == 1
+    assert rep["histogram"]["cos"] == 1
+    assert rep["eqns"] >= 3
+    assert rep["float64_vars"] == []
+
+
+def test_analyze_dispatches():
+    @to_static
+    def f(x):
+        return x + 1.0
+
+    f(paddle.to_tensor(np.ones((2,), np.float32)))
+    assert "specializations" in analysis.analyze(f)
+    sr = analysis.analyze(lambda: paddle.to_tensor(1.0))
+    assert "histogram" in sr
+    with pytest.raises(TypeError):
+        analysis.analyze(42)

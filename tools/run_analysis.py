@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""CI gate for paddle_tpu.analysis: exit non-zero on error findings.
+
+Runs the tracing-safety lint over the package + examples + tools and
+the op-registry consistency check, printing a summary.  This is the
+scriptable twin of `pytest -m lint` for environments without pytest:
+
+    python tools/run_analysis.py            # lint + registry
+    python tools/run_analysis.py --no-registry   # AST lint only (fast,
+                                                 # no jax import)
+    python tools/run_analysis.py --json     # machine-readable output
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# the registry check imports the framework — pin the platform before
+# jax initializes so the gate runs identically on CPU-only CI
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--no-registry", action="store_true",
+                    help="skip the op-registry consistency pass "
+                         "(no jax import; AST lint only)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("paths", nargs="*",
+                    help="override the default lint targets")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis.lint import lint_paths
+    from paddle_tpu.analysis.cli import findings_to_json
+
+    targets = args.paths or [os.path.join(_REPO, d)
+                             for d in ("paddle_tpu", "examples", "tools")]
+    findings = lint_paths(targets)
+    if not args.no_registry:
+        from paddle_tpu.analysis.registry_check import check_registry
+        findings.extend(check_registry(deep_sample=8))
+
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
+    errors = [f for f in findings if f.severity == "error"]
+    if args.json:
+        print(json.dumps(findings_to_json(findings), indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"analysis: {len(findings)} finding(s), "
+              f"{len(errors)} error(s) over {len(targets)} target(s)"
+              + ("" if args.no_registry else " + registry"))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
