@@ -106,7 +106,7 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
                 "utils", "profiler", "sparse", "text", "audio",
                 "quantization", "onnx", "version", "inference",
                 "hub", "sysconfig", "multiprocessing", "callbacks",
-                "geometric"]
+                "geometric", "tuning"]
 
 
 def __getattr__(name):

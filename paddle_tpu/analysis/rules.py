@@ -13,6 +13,8 @@ Code space:
   PTL0xx  tracing-safety lint rules (AST, see lint.py)
   PTL1xx  op-registry consistency rules (registry_check.py)
   PTL2xx  captured-graph hazard rules (graphcheck.py)
+  PTL3xx  tuning cost-model sanity rules (tuning/cost_model.py,
+          emitted by tools/run_analysis.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -255,6 +257,15 @@ _rule(
     "across them.",
     "Batch the reads, move them off the step path, or keep the value "
     "on device.")
+_rule(
+    "PTL301", "cost-model-sanity", ERROR,
+    "tuning cost model violates a physical invariant",
+    "The analytic model (paddle_tpu.tuning.cost_model) prunes which "
+    "autotune candidates ever get timed; a model that mis-orders an "
+    "obvious case (MXU misalignment, VMEM overflow, K/V re-streaming) "
+    "silently excludes the true winner from measurement everywhere.",
+    "Run paddle_tpu.tuning.cost_model.sanity_check() locally; fix the "
+    "violated term or the Coefficients default it exposes.")
 
 
 def get_rule(code: str) -> Rule:

@@ -190,6 +190,36 @@ define_flag("jit_cache_dir", "",
             "directory for the persistent XLA compilation cache "
             "(empty: disabled); survives process restarts",
             on_change=_apply_jit_cache_dir)
+
+
+def _apply_tuning_cache_dir(path: str):
+    """One flag, every persistent tuner (ref role: CINN auto-schedule
+    DB + cuDNN algo cache): the tuning subsystem's JSONL store lives in
+    ``path`` (paddle_tpu.tuning.cache), and JAX's persistent
+    compilation cache is pointed at ``path``/xla so cold starts skip
+    XLA recompiles too.  An explicit FLAGS_jit_cache_dir keeps
+    ownership of the compilation cache."""
+    import jax
+    if get_flag("jit_cache_dir"):
+        return
+    if path:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(path, "xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    else:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+define_flag("tuning_cache_dir", "",
+            "directory for the persistent autotune/plan caches "
+            "(flash_blocks + engine_plan JSONL stores, and the XLA "
+            "compilation cache under <dir>/xla); empty: disabled",
+            on_change=_apply_tuning_cache_dir)
+define_flag("pallas_autotune_topk", 4,
+            "measured autotune times only the cost model's top-K block "
+            "candidates (0: time every valid candidate)")
 define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")
 define_flag("embedding_deterministic", 0, "deterministic embedding lookup")
 define_flag("log_level", 0, "framework VLOG level")

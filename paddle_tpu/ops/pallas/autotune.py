@@ -8,25 +8,39 @@ TPU-native: one table, two modes —
   sequence when shorter; long sequences widen the key block so the
   fori_loop body amortises better against HBM streaming.
 - **measured** (``FLAGS_pallas_autotune=1``): on first use per
-  (sq, sk, head_dim, dtype, causal) each VALID candidate is compiled and
-  timed on the real array shapes (median of 3 after warmup) and the
-  winner is cached for the process lifetime.  Only reachable on TPU —
-  interpret mode always uses the heuristic (timing the interpreter is
+  (sq, sk, head_dim, dtype, causal, batch×heads bucket) candidates are
+  compiled and timed on the real array shapes (median of 3 after
+  warmup) — but only the cost model's top-K candidates
+  (``FLAGS_pallas_autotune_topk``, paddle_tpu.tuning.cost_model) are
+  ever timed, and the winner is remembered in the persistent tuning
+  cache (``FLAGS_tuning_cache_dir``, paddle_tpu.tuning.cache) so later
+  PROCESSES skip timing entirely.  The process-lifetime ``_cache`` dict
+  is a read-through layer over that disk store.  Only reachable on TPU
+  — interpret mode always uses the heuristic (timing the interpreter is
   meaningless).
 """
 from __future__ import annotations
 
+import logging
 import time
-from typing import Dict, Tuple
+import warnings
+from typing import Dict, Optional, Tuple
 
 from ...flags import get_flag
 from ..flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+
+logger = logging.getLogger(__name__)
 
 # (block_q, block_k) candidates, MXU-tile multiples
 _CANDIDATES = [(128, 128), (128, 256), (256, 128), (256, 256),
                (128, 512), (512, 128), (64, 128), (128, 64)]
 
 _cache: Dict[Tuple, Tuple[int, int]] = {}
+
+# observability counters (tests + bench assert warm starts via these):
+# _measure_calls counts candidate searches, _timing_runs timed trials
+_measure_calls = 0
+_timing_runs = 0
 
 
 def _valid(bq: int, bk: int, sq: int, sk: int) -> bool:
@@ -45,26 +59,83 @@ def _heuristic(sq: int, sk: int, d: int) -> Tuple[int, int]:
     return bq, bk
 
 
+def _bh_bucket(bh: int) -> int:
+    """Round batch×heads up to a power of two: close sizes share a
+    measurement (grid parallelism, not kernel shape), distant sizes
+    don't contaminate each other's timed winner."""
+    return 1 << max(0, int(bh) - 1).bit_length()
+
+
 def flash_blocks(sq: int, sk: int, d: int, dtype, causal: bool,
                  interpret: bool, bh_hint: int = 8) -> Tuple[int, int]:
     """Pick (block_q, block_k) for a flash call."""
     measured = not interpret and get_flag("pallas_autotune")
     # the mode is part of the key: a heuristic result cached while the
-    # flag was off must not suppress measurement after it's turned on
+    # flag was off must not suppress measurement after it's turned on.
+    # Measured keys also carry the bh bucket — the first caller's
+    # batch×heads must not bias the timed winner for every later shape
+    # (heuristic keys keep the historical 6-tuple shape)
     key = (sq, sk, d, str(dtype), bool(causal), measured)
+    if measured:
+        key = key + (_bh_bucket(bh_hint),)
     hit = _cache.get(key)
     if hit is not None:
         return hit
-    blocks = (_measure(sq, sk, d, dtype, causal, bh_hint) if measured
-              else _heuristic(sq, sk, d))
+    blocks = (_measured_blocks(sq, sk, d, dtype, causal, bh_hint)
+              if measured else _heuristic(sq, sk, d))
     _cache[key] = blocks
     return blocks
 
 
-def _measure(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
+def _disk_key(sq, sk, d, dtype, causal, bh_bucket) -> dict:
+    import jax
+    dev = jax.devices()[0]
+    return {"sq": int(sq), "sk": int(sk), "d": int(d),
+            "dtype": str(dtype), "causal": bool(causal),
+            "bh_bucket": int(bh_bucket), "backend": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "?")}
+
+
+def _measured_blocks(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
+    """Read-through to the persistent store; measure only on disk miss."""
+    from ...tuning.cache import get_cache
+    cache = get_cache()
+    key: Optional[dict] = None
+    if cache is not None:
+        key = _disk_key(sq, sk, d, dtype, causal, _bh_bucket(bh))
+        hit = cache.lookup("flash_blocks", key)
+        if hit is not None:
+            return (int(hit["block_q"]), int(hit["block_k"]))
+    blocks, timings = _measure(sq, sk, d, dtype, causal, bh)
+    # persist only a real measurement: an all-candidates-failed run
+    # (dead backend, Mosaic regression) must re-measure next process,
+    # not freeze its fallback on disk
+    measured_ok = any(isinstance(t, (int, float)) for t in timings.values())
+    if cache is not None and measured_ok:
+        cache.store("flash_blocks", key, {
+            "block_q": int(blocks[0]), "block_k": int(blocks[1]),
+            "source": "measured", "timings_ms": timings})
+    return blocks
+
+
+def _measure(sq, sk, d, dtype, causal, bh):
+    """Compile-and-time the cost model's top-K candidates.  Returns
+    (best blocks, {"BQxBK": median_ms | "error: ..."} timing table —
+    the table feeds ``python -m paddle_tpu.tuning fit``)."""
+    global _measure_calls, _timing_runs
     import jax
     import jax.numpy as jnp
     from ..flash_attention import _flash_fwd
+    from ...tuning.cache import get_cache
+    from ...tuning.cost_model import model_from_cache
+
+    _measure_calls += 1
+    valid = [c for c in _CANDIDATES if _valid(c[0], c[1], sq, sk)]
+    ranked = model_from_cache(get_cache()).rank_flash_candidates(
+        valid, sq, sk, d, dtype, causal, bh)
+    topk = int(get_flag("pallas_autotune_topk"))
+    if topk > 0:
+        ranked = ranked[:topk]
 
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (bh, sq, d), jnp.float32).astype(dtype)
@@ -72,10 +143,10 @@ def _measure(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
     v = jax.random.normal(ks[2], (bh, sk, d), jnp.float32).astype(dtype)
     scale = 1.0 / (d ** 0.5)
 
-    best, best_t = _heuristic(sq, sk, d), float("inf")
-    for bq, bk in _CANDIDATES:
-        if not _valid(bq, bk, sq, sk):
-            continue
+    fallback = _heuristic(sq, sk, d)
+    best, best_t = None, float("inf")
+    timings: Dict[str, object] = {}
+    for bq, bk in ranked:
         try:
             f = jax.jit(lambda q, k, v, _bq=bq, _bk=bk: _flash_fwd(
                 q, k, v, scale, causal, _bq, _bk, False)[0])
@@ -85,9 +156,31 @@ def _measure(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
                 t0 = time.perf_counter()
                 f(q, k, v)[0].block_until_ready()
                 ts.append(time.perf_counter() - t0)
+            _timing_runs += 1
             t = sorted(ts)[1]
-        except Exception:   # a candidate that fails to lower is skipped
+        except (ValueError, TypeError, NotImplementedError,
+                RuntimeError, AttributeError) as e:
+            # lowering/compile failures only: Mosaic and XLA surface
+            # these as ValueError/RuntimeError subclasses, and an
+            # AttributeError means the kernel hit a jax-API gap on this
+            # backend (e.g. the enable_x64 shim) — same verdict, the
+            # candidate can't lower here.  Anything else —
+            # KeyboardInterrupt, MemoryError — propagates
+            logger.debug("autotune: candidate (%d, %d) for "
+                         "(sq=%d, sk=%d, d=%d, %s, causal=%s) skipped: %s",
+                         bq, bk, sq, sk, d, dtype, causal, e)
+            timings[f"{bq}x{bk}"] = f"error: {str(e)[-160:]}"
             continue
+        timings[f"{bq}x{bk}"] = round(t * 1e3, 4)
         if t < best_t:
             best, best_t = (bq, bk), t
-    return best
+    if best is None:
+        warnings.warn(
+            f"pallas autotune: all {len(ranked)} block candidates for "
+            f"(sq={sq}, sk={sk}, d={d}, {dtype}, causal={causal}) failed "
+            f"to compile/run — falling back to the heuristic {fallback} "
+            "(enable debug logging on "
+            "paddle_tpu.ops.pallas.autotune for per-candidate errors)",
+            RuntimeWarning, stacklevel=2)
+        return fallback, timings
+    return best, timings

@@ -115,7 +115,8 @@ def pallas_flash_attention(query, key, value, causal: bool = False,
         n_rep = hq // hkv
         sc = scale if scale is not None else 1.0 / math.sqrt(d)
         q_off = (sk - sq) if causal else 0
-        bq, bk = flash_blocks(sq, sk, d, q.dtype, causal, interpret)
+        bq, bk = flash_blocks(sq, sk, d, q.dtype, causal, interpret,
+                              bh_hint=b * hq)
         qt = jnp.swapaxes(q, 1, 2).reshape(b * hq, sq, d)
         kt = jnp.swapaxes(k, 1, 2).reshape(b * hkv, sk, d)
         vt = jnp.swapaxes(v, 1, 2).reshape(b * hkv, sk, d)

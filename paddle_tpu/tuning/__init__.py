@@ -1,0 +1,30 @@
+"""paddle_tpu.tuning — persistent tuning subsystem.
+
+Three layers (ROADMAP: "Learned cost model powering the autotuner and
+mesh tuner"):
+
+* :mod:`cost_model` — analytic, fit-refinable scoring of Pallas flash
+  block pairs and Engine (dp, sharding, mp) plans; ranks candidates so
+  measured tuning times only the top-K.
+* :mod:`cache` — versioned JSONL store under ``FLAGS_tuning_cache_dir``
+  with atomic-rename writes, corruption fallback, and hit/miss
+  counters; the same flag wires JAX's persistent compilation cache.
+* CLI — ``python -m paddle_tpu.tuning {dump,stats,prune,warm,fit}``.
+
+Consumers: ``ops/pallas/autotune.flash_blocks`` and
+``distributed.auto_parallel.Engine.tune`` read through their in-memory
+caches to this store, so a warm process pays zero timing runs.
+"""
+from .cache import (SCHEMA_VERSION, TuningCache, cache_stats,  # noqa: F401
+                    canonical_key, get_cache)
+from .cost_model import (Coefficients, CostModel,  # noqa: F401
+                         default_model, features_from_jaxpr, flash_cost,
+                         flash_features, plan_cost, plan_layout,
+                         rank_flash_candidates, rank_plans, sanity_check)
+
+__all__ = [
+    "SCHEMA_VERSION", "TuningCache", "cache_stats", "canonical_key",
+    "get_cache", "Coefficients", "CostModel", "default_model",
+    "features_from_jaxpr", "flash_cost", "flash_features", "plan_cost",
+    "plan_layout", "rank_flash_candidates", "rank_plans", "sanity_check",
+]

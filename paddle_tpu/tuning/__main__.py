@@ -1,0 +1,195 @@
+"""CLI for the persistent tuning cache.
+
+    python -m paddle_tpu.tuning stats  [--dir DIR]
+    python -m paddle_tpu.tuning dump   [--dir DIR] [--kind K] [--json]
+    python -m paddle_tpu.tuning prune  [--dir DIR] [--kind K]
+                                       [--older-than-days D]
+    python -m paddle_tpu.tuning warm   [--dir DIR] [--backend B]
+                                       [--device-kind DK]
+                                       --flash SQ,SK,D[,DTYPE,CAUSAL,BH]
+                                       [--standard]
+    python -m paddle_tpu.tuning fit    [--dir DIR] [--json]
+
+``warm`` writes cost-model (analytic) block picks so a cold process
+resolves ``flash_blocks`` from disk without ever timing; ``fit``
+least-squares the model's alpha multipliers from the measured timing
+tables accumulated in ``flash_blocks`` entries and persists them under
+the ``coefficients`` kind.  ``--dir`` overrides FLAGS_tuning_cache_dir.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .cache import TuningCache, get_cache
+from . import cost_model
+
+# shapes every transformer workload in the repo hits: (sq, sk, d,
+# dtype, causal, bh) for prefill at common lengths + batched decode
+_STANDARD_FLASH = [
+    (128, 128, 64, "float32", True, 8),
+    (256, 256, 64, "float32", True, 8),
+    (512, 512, 64, "bfloat16", True, 16),
+    (1024, 1024, 64, "bfloat16", True, 16),
+    (2048, 2048, 64, "bfloat16", True, 8),
+    (2048, 2048, 128, "bfloat16", True, 8),
+    (1, 1024, 64, "bfloat16", False, 8),
+    (1, 2048, 128, "bfloat16", False, 8),
+]
+
+
+def _open_cache(args) -> TuningCache:
+    if args.dir:
+        return TuningCache(args.dir)
+    cache = get_cache()
+    if cache is None:
+        sys.stderr.write("no cache directory: pass --dir or set "
+                         "FLAGS_tuning_cache_dir\n")
+        raise SystemExit(2)
+    return cache
+
+
+def _parse_flash_spec(spec: str):
+    parts = spec.split(",")
+    if len(parts) < 3:
+        raise SystemExit(f"--flash needs SQ,SK,D[,DTYPE,CAUSAL,BH]: {spec!r}")
+    sq, sk, d = (int(p) for p in parts[:3])
+    dtype = parts[3] if len(parts) > 3 else "bfloat16"
+    causal = (parts[4].lower() in ("1", "true", "yes")) \
+        if len(parts) > 4 else True
+    bh = int(parts[5]) if len(parts) > 5 else 8
+    return sq, sk, d, dtype, causal, bh
+
+
+def _hardware_sig(args):
+    """(backend, device_kind) the runtime autotuner will key on."""
+    if args.backend and args.device_kind:
+        return args.backend, args.device_kind
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return (args.backend or dev.platform,
+                args.device_kind or getattr(dev, "device_kind", "?"))
+    except Exception:
+        return args.backend or "cpu", args.device_kind or "?"
+
+
+def cmd_stats(args) -> int:
+    cache = _open_cache(args)
+    rows = {k: sum(1 for _ in cache.entries(k)) for k in cache.kinds()}
+    out = {"dir": cache.directory, "entries": rows,
+           "counters": cache.stats()}
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_dump(args) -> int:
+    cache = _open_cache(args)
+    records = list(cache.entries(args.kind))
+    if args.json:
+        print(json.dumps(records, indent=2, sort_keys=True))
+        return 0
+    for rec in records:
+        print(f"[{rec.get('t', 0):.0f}] "
+              f"{json.dumps(rec['key'], sort_keys=True)} -> "
+              f"{json.dumps(rec['value'], sort_keys=True)}")
+    print(f"{len(records)} entr{'y' if len(records) == 1 else 'ies'}")
+    return 0
+
+
+def cmd_prune(args) -> int:
+    cache = _open_cache(args)
+    max_age = args.older_than_days * 86400.0 \
+        if args.older_than_days is not None else None
+    n = cache.prune(kind=args.kind, max_age_s=max_age)
+    print(f"pruned {n} entr{'y' if n == 1 else 'ies'}")
+    return 0
+
+
+def cmd_warm(args) -> int:
+    cache = _open_cache(args)
+    from ..ops.pallas.autotune import _CANDIDATES, _bh_bucket, _valid
+    backend, device_kind = _hardware_sig(args)
+    model = cost_model.model_from_cache(cache)
+    specs = [_parse_flash_spec(s) for s in (args.flash or [])]
+    if args.standard or not specs:
+        specs.extend(_STANDARD_FLASH)
+    n = 0
+    for sq, sk, d, dtype, causal, bh in specs:
+        valid = [c for c in _CANDIDATES if _valid(c[0], c[1], sq, sk)]
+        if not valid:
+            continue
+        bq, bk = model.rank_flash_candidates(
+            valid, sq, sk, d, dtype, causal, bh)[0]
+        cache.store("flash_blocks", {
+            "sq": sq, "sk": sk, "d": d, "dtype": dtype,
+            "causal": bool(causal), "bh_bucket": _bh_bucket(bh),
+            "backend": backend, "device_kind": device_kind,
+        }, {"block_q": bq, "block_k": bk, "source": "analytic"})
+        n += 1
+    print(f"warmed {n} flash_blocks entr{'y' if n == 1 else 'ies'} "
+          f"for backend={backend} device_kind={device_kind}")
+    return 0
+
+
+def cmd_fit(args) -> int:
+    cache = _open_cache(args)
+    samples = []
+    for rec in cache.entries("flash_blocks"):
+        key, timings = rec["key"], rec["value"].get("timings_ms")
+        if not timings:
+            continue
+        for blocks, ms in timings.items():
+            if not isinstance(ms, (int, float)):
+                continue                  # "error: ..." rows
+            bq, bk = (int(p) for p in blocks.split("x"))
+            samples.append((cost_model.flash_features(
+                key["sq"], key["sk"], key["d"], key["dtype"],
+                key["causal"], bq, bk, key.get("bh_bucket", 8)),
+                ms / 1e3))
+    if len(samples) < 3:
+        sys.stderr.write("fit: need >= 3 measured timings in the cache "
+                         "(run with FLAGS_pallas_autotune=1 first)\n")
+        return 1
+    model = cost_model.CostModel()
+    coeffs = model.fit(samples)
+    cache.store(cost_model.COEFFS_KIND, cost_model.COEFFS_KEY,
+                {"coeffs": coeffs.to_dict(), "n_samples": len(samples)})
+    out = {"n_samples": len(samples), "coeffs": coeffs.to_dict()}
+    print(json.dumps(out, indent=2 if args.json else None,
+                     sort_keys=True))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.tuning",
+                                 description=__doc__)
+    ap.add_argument("--dir", default="",
+                    help="cache directory (default: FLAGS_tuning_cache_dir)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("stats", help="entry counts + hit/miss counters")
+    p = sub.add_parser("dump", help="print cache entries")
+    p.add_argument("--kind", default=None)
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("prune", help="drop entries")
+    p.add_argument("--kind", default=None)
+    p.add_argument("--older-than-days", type=float, default=None)
+    p = sub.add_parser("warm", help="write analytic flash picks")
+    p.add_argument("--flash", action="append",
+                   help="SQ,SK,D[,DTYPE,CAUSAL,BH] (repeatable)")
+    p.add_argument("--standard", action="store_true",
+                   help="also warm the standard transformer shapes")
+    p.add_argument("--backend", default="")
+    p.add_argument("--device-kind", default="")
+    p = sub.add_parser("fit", help="refine cost-model coefficients from "
+                                   "measured timings in the cache")
+    p.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    return {"stats": cmd_stats, "dump": cmd_dump, "prune": cmd_prune,
+            "warm": cmd_warm, "fit": cmd_fit}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,430 @@
+"""Analytic-first, fit-refinable cost model for the tuning subsystem.
+
+ref role: the auto_parallel tuner's rule-based cost estimation +
+*A Learned Performance Model for TPUs* (PAPERS.md, arXiv 2008.01040):
+predict candidate cost from graph-derived features instead of timing
+every candidate.  Two candidate families share one model object:
+
+* **Pallas flash block pairs** ``(block_q, block_k)`` — features are
+  FLOPs, HBM traffic, MXU tile alignment, VMEM footprint, and kernel
+  launch/loop overheads derived from the launch shape
+  (``flash_features``).  ``rank_flash_candidates`` orders candidates so
+  measured mode (``ops/pallas/autotune.py``) times only the top-K.
+* **Engine parallelism plans** ``(dp, sharding, mp)`` — the roofline
+  previously inlined in ``Engine._rank_candidates``: per-device compute
+  plus mesh-axis communication volume (ring grad all-reduce on the
+  dp×sharding axis, activation collectives per live mp hop).
+
+Graph features come from the repo's existing analyzers:
+``features_from_jaxpr`` folds ``analysis.graphcheck.check_jaxpr``'s
+primitive histogram into per-op-class FLOP/byte scores, so any captured
+program can contribute features without new tracing machinery.
+
+The model is analytic FIRST: the default ``Coefficients`` are chip
+datasheet numbers (v5e-class), good enough for ORDERING.  It is
+refinable: ``CostModel.fit`` least-squares the three alpha multipliers
+against measured (features, seconds) samples — e.g. the timing tables
+the persistent cache accumulates (``python -m paddle_tpu.tuning fit``).
+
+Stdlib-only at module level on purpose (mirrors analysis/): the CI gate
+runs ``sanity_check`` without importing jax; numpy is imported lazily
+inside ``fit``.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import asdict, dataclass, replace
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+MXU_TILE = 128          # MXU systolic array is 128x128
+VPU_LANES = 128
+
+_DTYPE_BYTES = {
+    "float64": 8, "complex64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+
+def dtype_bytes(dtype) -> int:
+    name = str(dtype)
+    for key, nbytes in _DTYPE_BYTES.items():
+        if key in name:
+            return nbytes
+    return 4
+
+
+@dataclass
+class Coefficients:
+    """Hardware + overhead constants.  Defaults are v5e-class datasheet
+    numbers; ``alpha_*`` are the fit-refinable multipliers (identity
+    until ``CostModel.fit``)."""
+    peak_flops: float = 197e12        # bf16 MXU peak
+    hbm_bytes_per_s: float = 819e9
+    grid_overhead_s: float = 1.5e-6   # per grid-step dispatch
+    iter_overhead_s: float = 8e-8     # per inner fori_loop iteration
+    vmem_budget_bytes: float = 0.75 * 16 * 2 ** 20
+    vmem_penalty: float = 8.0         # over-budget blocks spill or fail
+    ici_flops_per_byte: float = 240.0  # chip compute intensity vs ICI
+    alpha_compute: float = 1.0
+    alpha_memory: float = 1.0
+    alpha_overhead: float = 1.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Coefficients":
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        return cls(**known)
+
+
+# ---------------------------------------------------------------------------
+# flash block candidates
+# ---------------------------------------------------------------------------
+
+def flash_features(sq: int, sk: int, d: int, dtype, causal: bool,
+                   bq: int, bk: int, bh: int = 8) -> Dict[str, float]:
+    """Feature vector for one flash launch with blocks (bq, bk).
+
+    The kernel grid is (bh, sq/bq); each grid step fori-loops over
+    sk/bk key blocks, streaming K/V from HBM, so a taller q block means
+    fewer K/V re-streams while a wider k block means fewer loop
+    iterations.  Causality drops ~half the key blocks (the lower block
+    triangle: (1+n_k)/2 of n_k survive on average)."""
+    nbytes = dtype_bytes(dtype)
+    bq = max(1, min(bq, sq))
+    bk = max(1, min(bk, sk))
+    n_q = math.ceil(sq / bq)
+    n_k = math.ceil(sk / bk)
+    causal_frac = (1.0 + n_k) / (2.0 * n_k) if causal else 1.0
+    flops = 4.0 * bh * sq * sk * d * causal_frac        # QK^T + PV
+    # Q and O move once; K/V stream once per q-block row
+    hbm_bytes = bh * nbytes * (2.0 * sq * d
+                               + n_q * 2.0 * sk * d * causal_frac)
+    # tile alignment: rows below the 128-lane MXU tile idle the array;
+    # the contraction/value sides average the QK^T (bk cols) and PV
+    # (d cols) matmuls
+    row_util = min(bq, MXU_TILE) / MXU_TILE
+    col_util = 0.5 * (min(bk, MXU_TILE) + min(d, MXU_TILE)) / MXU_TILE
+    mxu_util = row_util * min(col_util, 1.0)
+    grid_steps = float(bh * n_q)
+    inner_iters = grid_steps * n_k * causal_frac
+    # double-buffered streaming tiles + f32 scores/accumulator
+    vmem_bytes = (2.0 * nbytes * (bq * d + 2.0 * bk * d)
+                  + 4.0 * (bq * bk + 2.0 * bq * d))
+    return {"flops": flops, "hbm_bytes": hbm_bytes, "mxu_util": mxu_util,
+            "grid_steps": grid_steps, "inner_iters": inner_iters,
+            "vmem_bytes": vmem_bytes, "dtype_bytes": float(nbytes)}
+
+
+def _flash_cost(f: Dict[str, float], c: Coefficients) -> float:
+    # f32 runs the MXU at half rate; sub-16-bit types don't go faster
+    # than bf16 on the flash path (the accumulator is f32 anyway)
+    peak = c.peak_flops * (2.0 / f["dtype_bytes"] if f["dtype_bytes"] > 2
+                           else 1.0)
+    util = max(f["mxu_util"], 1e-3)
+    t_compute = f["flops"] / (peak * util)
+    t_memory = f["hbm_bytes"] / c.hbm_bytes_per_s
+    t_overhead = (f["grid_steps"] * c.grid_overhead_s
+                  + f["inner_iters"] * c.iter_overhead_s)
+    t = (max(c.alpha_compute * t_compute, c.alpha_memory * t_memory)
+         + c.alpha_overhead * t_overhead)
+    if f["vmem_bytes"] > c.vmem_budget_bytes:
+        t *= c.vmem_penalty * (f["vmem_bytes"] / c.vmem_budget_bytes)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# (dp, sharding, mp) plans
+# ---------------------------------------------------------------------------
+
+def _plan_cost(candidate: Sequence[int], batch_tokens: int,
+               param_bytes: int, c: Coefficients) -> float:
+    """Roofline for one mesh factorization, in byte-equivalent time
+    units (moved here from ``Engine._rank_candidates``): per-device
+    compute is (~2·N·T FLOPs)/(shards · CI) with CI the chip's compute
+    intensity per ICI byte; dp/sharding adds the ring grad all-reduce
+    (2(n-1)/n of the mp-shard's param bytes); mp adds activation
+    collectives (∝ this device's batch-token bytes per live mp hop).
+    Model- and batch-size aware, for ORDERING only."""
+    dp, sh, mp = candidate
+    shards = max(dp * sh * mp, 1)
+    t = (batch_tokens * param_bytes / 2.0) / (shards * c.ici_flops_per_byte)
+    n = dp * sh
+    if n > 1:
+        t += 2.0 * (n - 1) / n * (param_bytes / mp)
+    if mp > 1:
+        t += 2.0 * (mp - 1) / mp * (4.0 * batch_tokens / n) * 8
+    return t
+
+
+def plan_layout(dp: int, sharding: int, mp: int) -> dict:
+    """Canonical layout table for a tuned plan (the SNIPPETS.md [1]
+    SpecLayout shape): mesh axis sizes plus the PartitionSpec each
+    parameter/activation role gets under GSPMD, as axis-name lists
+    (None = replicated on that dim).  This is the durable, backend-
+    independent part of an ``engine_plan`` cache entry."""
+    return {
+        "mesh_axes": {"dp": dp, "sharding": sharding, "mp": mp},
+        "specs": {
+            "batch": ["dp", None],
+            "embeddings": [["sharding", "mp"], None],
+            "qkv_projection": ["sharding", "mp"],
+            "attn_output": ["mp", "sharding"],
+            "ffn_up": ["sharding", "mp"],
+            "ffn_down": ["mp", "sharding"],
+            "activations": ["dp", None, "mp"],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-derived features (analysis.graphcheck histograms)
+# ---------------------------------------------------------------------------
+
+# primitive name → op class; anything unlisted is "elementwise"
+_OP_CLASSES = {
+    "matmul": {"dot_general", "conv_general_dilated", "einsum"},
+    "reduce": {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+               "reduce_and", "reduce_or", "argmax", "argmin",
+               "reduce_precision", "cumsum", "cumprod", "sort"},
+    "gather_scatter": {"gather", "scatter", "scatter-add", "scatter_add",
+                       "dynamic_slice", "dynamic_update_slice", "take",
+                       "take_along_axis"},
+    "collective": {"psum", "all_gather", "reduce_scatter", "ppermute",
+                   "all_to_all", "pmax", "pmin", "axis_index"},
+    "control": {"while", "scan", "cond", "pjit", "custom_vjp_call",
+                "custom_jvp_call", "remat", "checkpoint"},
+}
+# relative FLOPs per op-class occurrence (shape-free proxy: a matmul
+# touches ~MXU_TILE times more arithmetic per output element)
+_CLASS_FLOPS_WEIGHT = {"matmul": 256.0, "reduce": 2.0,
+                       "gather_scatter": 2.0, "collective": 0.0,
+                       "control": 0.0, "elementwise": 1.0}
+_CLASS_BYTES_WEIGHT = {"matmul": 3.0, "reduce": 2.0, "gather_scatter": 4.0,
+                       "collective": 8.0, "control": 0.0,
+                       "elementwise": 2.0}
+
+
+def classify_primitive(name: str) -> str:
+    for cls, names in _OP_CLASSES.items():
+        if name in names:
+            return cls
+    return "elementwise"
+
+
+def features_from_jaxpr(jaxpr) -> dict:
+    """Per-op-class feature scores from a jaxpr's primitive histogram
+    (``analysis.graphcheck.check_jaxpr``).  Shape-free proxies — good
+    for comparing CANDIDATE lowerings of the same program, not for
+    absolute seconds."""
+    from ..analysis.graphcheck import check_jaxpr
+    report = check_jaxpr(jaxpr)
+    class_counts: Dict[str, int] = {}
+    for prim, n in report["histogram"].items():
+        cls = classify_primitive(prim)
+        class_counts[cls] = class_counts.get(cls, 0) + n
+    flops_score = sum(_CLASS_FLOPS_WEIGHT[c] * n
+                      for c, n in class_counts.items())
+    bytes_score = sum(_CLASS_BYTES_WEIGHT[c] * n
+                      for c, n in class_counts.items())
+    return {"eqns": report["eqns"], "histogram": report["histogram"],
+            "class_counts": class_counts, "flops_score": flops_score,
+            "bytes_score": bytes_score}
+
+
+# ---------------------------------------------------------------------------
+# the model object
+# ---------------------------------------------------------------------------
+
+class CostModel:
+    """Analytic scorer with fit-refinable alpha multipliers."""
+
+    def __init__(self, coeffs: Optional[Coefficients] = None):
+        self.coeffs = coeffs or Coefficients()
+
+    # -- flash blocks --
+    def flash_cost(self, sq: int, sk: int, d: int, dtype, causal: bool,
+                   bq: int, bk: int, bh: int = 8) -> float:
+        return _flash_cost(
+            flash_features(sq, sk, d, dtype, causal, bq, bk, bh),
+            self.coeffs)
+
+    def rank_flash_candidates(self, candidates: Iterable[Tuple[int, int]],
+                              sq: int, sk: int, d: int, dtype,
+                              causal: bool, bh: int = 8
+                              ) -> List[Tuple[int, int]]:
+        """Candidates ordered cheapest-first; stable on ties so the
+        caller's preference order breaks them."""
+        cands = list(candidates)
+        return sorted(cands, key=lambda c: self.flash_cost(
+            sq, sk, d, dtype, causal, c[0], c[1], bh))
+
+    # -- parallelism plans --
+    def plan_cost(self, candidate: Sequence[int], batch_tokens: int,
+                  param_bytes: int) -> float:
+        return _plan_cost(candidate, batch_tokens, param_bytes, self.coeffs)
+
+    def rank_plans(self, candidates: Iterable[Sequence[int]],
+                   batch_tokens: int, param_bytes: int) -> List:
+        return sorted(candidates, key=lambda c: self.plan_cost(
+            c, batch_tokens, param_bytes))
+
+    # -- refinement --
+    def fit(self, samples: Sequence[Tuple[Dict[str, float], float]]
+            ) -> Coefficients:
+        """Refine alpha multipliers from measured flash samples
+        ``[(features, seconds), ...]`` (features as produced by
+        ``flash_features``).  Least-squares on the decomposed terms;
+        alphas are clamped positive so a degenerate sample set can only
+        rescale, never invert, the analytic ordering."""
+        import numpy as np
+        if len(samples) < 3:
+            raise ValueError("fit needs >= 3 (features, seconds) samples")
+        c = self.coeffs
+        rows, ys = [], []
+        for f, secs in samples:
+            peak = c.peak_flops * (2.0 / f["dtype_bytes"]
+                                   if f["dtype_bytes"] > 2 else 1.0)
+            t_c = f["flops"] / (peak * max(f["mxu_util"], 1e-3))
+            t_m = f["hbm_bytes"] / c.hbm_bytes_per_s
+            t_o = (f["grid_steps"] * c.grid_overhead_s
+                   + f["inner_iters"] * c.iter_overhead_s)
+            rows.append([t_c, t_m, t_o])
+            ys.append(float(secs))
+        sol, *_ = np.linalg.lstsq(np.asarray(rows), np.asarray(ys),
+                                  rcond=None)
+        a_c, a_m, a_o = (float(max(v, 1e-3)) for v in sol)
+        self.coeffs = replace(c, alpha_compute=a_c, alpha_memory=a_m,
+                              alpha_overhead=a_o)
+        return self.coeffs
+
+    def to_dict(self) -> dict:
+        return self.coeffs.to_dict()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CostModel":
+        return cls(Coefficients.from_dict(d))
+
+
+_DEFAULT = CostModel()
+
+# cache kind + key under which `python -m paddle_tpu.tuning fit` stores
+# refined coefficients; consumers pick them up via model_from_cache
+COEFFS_KIND = "coefficients"
+COEFFS_KEY = {"model": "flash_v1"}
+
+
+def default_model() -> CostModel:
+    return _DEFAULT
+
+
+def model_from_cache(cache) -> CostModel:
+    """The fit-refined model persisted in ``cache`` (kind
+    ``coefficients``), falling back to the analytic default.  ``cache``
+    may be None (flag off)."""
+    if cache is not None:
+        try:
+            val = cache.lookup(COEFFS_KIND, COEFFS_KEY)
+        except Exception:
+            val = None
+        if val:
+            try:
+                return CostModel.from_dict(val.get("coeffs", val))
+            except Exception:
+                pass
+    return _DEFAULT
+
+
+def rank_flash_candidates(candidates, sq, sk, d, dtype, causal, bh=8):
+    return _DEFAULT.rank_flash_candidates(candidates, sq, sk, d, dtype,
+                                          causal, bh)
+
+
+def rank_plans(candidates, batch_tokens, param_bytes):
+    return _DEFAULT.rank_plans(candidates, batch_tokens, param_bytes)
+
+
+def plan_cost(candidate, batch_tokens, param_bytes):
+    return _DEFAULT.plan_cost(candidate, batch_tokens, param_bytes)
+
+
+def flash_cost(sq, sk, d, dtype, causal, bq, bk, bh=8):
+    return _DEFAULT.flash_cost(sq, sk, d, dtype, causal, bq, bk, bh)
+
+
+# ---------------------------------------------------------------------------
+# CI sanity checks (run by tools/run_analysis.py under PTL301)
+# ---------------------------------------------------------------------------
+
+def sanity_check(model: Optional[CostModel] = None) -> List[str]:
+    """Physical-invariant checks on the analytic model.  Returns a list
+    of violation strings (empty = healthy); the analysis gate turns each
+    into an error-severity PTL301 finding."""
+    m = model or _DEFAULT
+    bad: List[str] = []
+
+    def check(cond: bool, msg: str):
+        if not cond:
+            bad.append(msg)
+
+    # 1. costs are finite and positive over the candidate table (the
+    # autotuner's _CANDIDATES, inlined: importing it would pull jax in,
+    # and this check must run on the jax-free fast CI path)
+    candidate_table = [(128, 128), (128, 256), (256, 128), (256, 256),
+                       (128, 512), (512, 128), (64, 128), (128, 64)]
+    for bq, bk in candidate_table:
+        t = m.flash_cost(1024, 1024, 64, "float32", False, bq, bk)
+        check(math.isfinite(t) and t > 0,
+              f"non-finite/non-positive flash cost for blocks ({bq},{bk})")
+
+    # 2. MXU alignment: a 128-aligned block beats a 64-row block at the
+    # same footprint (half the systolic rows would idle)
+    check(m.flash_cost(256, 256, 64, "bfloat16", False, 128, 128)
+          < m.flash_cost(256, 256, 64, "bfloat16", False, 64, 128),
+          "misaligned 64-row block not penalized vs 128-aligned")
+
+    # 3. K/V re-streaming: at long sequence, taller q blocks stream K/V
+    # fewer times and must not cost more
+    check(m.flash_cost(2048, 2048, 64, "bfloat16", False, 256, 128)
+          <= m.flash_cost(2048, 2048, 64, "bfloat16", False, 64, 128),
+          "taller q block (fewer K/V streams) ranked worse at seq 2048")
+
+    # 4. VMEM wall: a block pair far over the VMEM budget must rank
+    # behind an in-budget aligned pair
+    f = flash_features(4096, 4096, 256, "float32", False, 2048, 2048)
+    check(f["vmem_bytes"] > m.coeffs.vmem_budget_bytes,
+          "vmem estimate misses an obviously over-budget block")
+    check(m.flash_cost(4096, 4096, 256, "float32", False, 2048, 2048)
+          > m.flash_cost(4096, 4096, 256, "float32", False, 256, 256),
+          "over-VMEM block pair not penalized")
+
+    # 5. causality discounts work: a causal launch is never costlier
+    # than the same non-causal launch
+    check(m.flash_cost(1024, 1024, 64, "bfloat16", True, 128, 128)
+          <= m.flash_cost(1024, 1024, 64, "bfloat16", False, 128, 128),
+          "causal masking increased modeled cost")
+
+    # 6. plans: on an activation-heavy, param-light fixture (32×2048
+    # tokens, 1 MiB of params) mp=8's per-hop activation collectives
+    # must outweigh dp=8's small grad all-reduce; with params dominating
+    # instead (100 MiB), the ordering must flip toward mp
+    costs = {c: m.plan_cost(c, 32 * 2048, 2 ** 20)
+             for c in [(1, 1, 1), (8, 1, 1), (2, 2, 2), (1, 1, 8)]}
+    check(all(math.isfinite(v) and v > 0 for v in costs.values()),
+          "non-finite/non-positive plan cost")
+    check(costs[(8, 1, 1)] < costs[(1, 1, 8)],
+          "mp-heavy plan not charged for activation collectives on an "
+          "activation-heavy workload")
+    check(m.plan_cost((1, 1, 8), 8 * 128, 100 * 2 ** 20)
+          < m.plan_cost((8, 1, 1), 8 * 128, 100 * 2 ** 20),
+          "param-heavy workload does not favor mp over dp's ring "
+          "all-reduce")
+
+    # 7. fitted alphas stay positive (ordering can rescale, not invert)
+    check(m.coeffs.alpha_compute > 0 and m.coeffs.alpha_memory > 0
+          and m.coeffs.alpha_overhead > 0, "non-positive alpha multiplier")
+    return bad

@@ -190,6 +190,87 @@ def test_engine_tuner_selects_a_mesh():
     assert np.isfinite(hist["loss"]).all()
 
 
+def test_engine_tune_warm_cache_zero_trial_steps(tmp_path):
+    """Persistent plan cache (FLAGS_tuning_cache_dir): a second Engine
+    over the same (model, batch, candidates, devices) resolves the
+    search entirely from disk — zero trial steps, proven by the cache's
+    hit/miss counters and a poisoned TrainStep."""
+    import sys
+    import jax
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.strategy import Strategy
+    from paddle_tpu.tuning import cache as tcache_mod
+
+    _fresh()
+    prev_xla_cache = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    paddle.set_flags({"FLAGS_tuning_cache_dir": str(tmp_path)})
+    try:
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 8))
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        loss = lambda out, y: ((out - y) ** 2).mean()   # noqa: E731
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 16).astype(np.float32)
+        y = rs.randn(8, 8).astype(np.float32)
+        cands = [(8, 1, 1), (2, 2, 2), (1, 1, 8)]
+
+        eng = Engine(model, loss=loss, optimizer=o, strategy=Strategy())
+        got = eng.tune(x, y, candidates=cands)
+        st = tcache_mod.get_cache().stats()["engine_plan"]
+        assert st["stores"] == 1 and st["misses"] == 1
+        assert "cached" not in got
+
+        # fresh-process stand-in: new cache instance, new Engine, and a
+        # TrainStep that detonates if any trial step gets built
+        _fresh()
+        tcache_mod._active = None
+        ts_mod = sys.modules["paddle_tpu.jit.train_step"]
+        orig_ts = ts_mod.TrainStep
+
+        def _poisoned(*a, **kw):
+            raise AssertionError("trial step built despite a warm "
+                                 "plan cache")
+
+        ts_mod.TrainStep = _poisoned
+        try:
+            eng2 = Engine(model, loss=loss, optimizer=o,
+                          strategy=Strategy())
+            got2 = eng2.tune(x, y, candidates=cands)
+        finally:
+            ts_mod.TrainStep = orig_ts
+        assert got2["cached"] is True
+        assert (got2["dp"], got2["sharding"], got2["mp"]) == \
+            (got["dp"], got["sharding"], got["mp"])
+        st2 = tcache_mod.get_cache().stats()["engine_plan"]
+        assert st2["hits"] == 1 and st2["misses"] == 0
+        # the replayed report carries the ORIGINAL measurements plus an
+        # explicit hit marker (no new step_s could exist — TrainStep is
+        # poisoned above)
+        assert eng2.tuning_report[-1]["cache"] == "hit"
+        # the cached entry carries the canonical layout table
+        rec = next(iter(tcache_mod.get_cache().entries("engine_plan")))
+        assert rec["value"]["layout"]["mesh_axes"] == {
+            "dp": got["dp"], "sharding": got["sharding"],
+            "mp": got["mp"]}
+        # and the engine still trains under the installed winner mesh
+        from paddle_tpu.io import TensorDataset
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        hist = eng2.fit(ds, batch_size=8, epochs=1)
+        assert np.isfinite(hist["loss"]).all()
+    finally:
+        paddle.set_flags({"FLAGS_tuning_cache_dir": ""})
+        tcache_mod._active = None
+        jax.config.update("jax_compilation_cache_dir", prev_xla_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          prev_size)
+
+
 def test_strategy_dict_config_merges_tuning():
     from paddle_tpu.distributed.auto_parallel.strategy import (
         Strategy, TuningConfig)

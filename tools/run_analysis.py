@@ -5,10 +5,16 @@ Runs the tracing-safety lint over the package + examples + tools and
 the op-registry consistency check, printing a summary.  This is the
 scriptable twin of `pytest -m lint` for environments without pytest:
 
-    python tools/run_analysis.py            # lint + registry
-    python tools/run_analysis.py --no-registry   # AST lint only (fast,
-                                                 # no jax import)
+    python tools/run_analysis.py            # lint + registry + cost model
+    python tools/run_analysis.py --no-registry   # skip the registry pass
+                                                 # (no jax import)
+    python tools/run_analysis.py --no-cost-model # skip the tuning
+                                                 # cost-model sanity pass
     python tools/run_analysis.py --json     # machine-readable output
+
+The cost-model pass (PTL301) runs paddle_tpu.tuning.cost_model
+.sanity_check() — stdlib-only math, no backend init, so it is cheap
+enough to keep on by default.
 """
 import argparse
 import json
@@ -28,6 +34,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-registry", action="store_true",
                     help="skip the op-registry consistency pass "
                          "(no jax import; AST lint only)")
+    ap.add_argument("--no-cost-model", action="store_true",
+                    help="skip the tuning cost-model sanity pass "
+                         "(PTL301)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("paths", nargs="*",
                     help="override the default lint targets")
@@ -42,6 +51,14 @@ def main(argv=None) -> int:
     if not args.no_registry:
         from paddle_tpu.analysis.registry_check import check_registry
         findings.extend(check_registry(deep_sample=8))
+    if not args.no_cost_model:
+        from paddle_tpu.analysis.rules import make_finding
+        from paddle_tpu.tuning.cost_model import sanity_check
+        findings.extend(
+            make_finding("PTL301", msg,
+                         file=os.path.join("paddle_tpu", "tuning",
+                                           "cost_model.py"))
+            for msg in sanity_check())
 
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
     errors = [f for f in findings if f.severity == "error"]
@@ -52,7 +69,8 @@ def main(argv=None) -> int:
             print(f.render())
         print(f"analysis: {len(findings)} finding(s), "
               f"{len(errors)} error(s) over {len(targets)} target(s)"
-              + ("" if args.no_registry else " + registry"))
+              + ("" if args.no_registry else " + registry")
+              + ("" if args.no_cost_model else " + cost-model"))
     return 1 if errors else 0
 
 
