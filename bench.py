@@ -342,6 +342,11 @@ def _run_child(extra_env, budget, mode=None):
     """Run one child stage; returns (json_line_or_None, err_string)."""
     import subprocess
     env = dict(os.environ, BENCH_CHILD="1", **extra_env)
+    # a chaos-test fault schedule leaking in from the environment must
+    # never fire inside a benchmark child (a scheduled crash/stall would
+    # read as a perf regression or a hung tunnel)
+    env.pop("FLAGS_fault_schedule", None)
+    env.pop("PADDLE_FAULT_STATE_FILE", None)
     if mode:
         env["BENCH_MODE"] = mode
     try:

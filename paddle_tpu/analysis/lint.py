@@ -54,6 +54,15 @@ SURFACE_GLOBS = (
 # point: test oracles, case generators, kernel benchmarking)
 SURFACE_EXEMPT = ("*/tensor/op_registry.py", "*/ops/pallas/autotune.py")
 
+# resilience-critical files (PTL401 exception-hygiene scope): a
+# swallow-and-continue handler here turns a torn checkpoint / dead
+# worker / failed predict into silent wrong behavior
+RESILIENCE_GLOBS = (
+    "*/resilience/*.py",
+    "*/distributed/checkpoint/*.py",
+    "*/inference/*.py",
+)
+
 _HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
 _HOST_CASTS = {"float", "int", "bool"}
 _TRACED_DECORATORS = {"to_static", "train_step", "TrainStep"}
@@ -417,6 +426,66 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+# calls that count as "the handler reported the failure"
+_LOGGING_LEAVES = {"warn", "warning", "error", "exception", "critical",
+                   "log", "debug", "info"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:`` or one whose type (or any tuple member) is
+    Exception/BaseException."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        dotted = _dotted(node) or ""
+        if dotted.split(".")[-1] in _BROAD_EXC_NAMES:
+            return True
+    return False
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, or call a warn/log function?"""
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted and dotted.split(".")[-1] in _LOGGING_LEAVES:
+                return True
+    return False
+
+
+class _ExceptionHygiene(ast.NodeVisitor):
+    """PTL401: broad exception handlers that neither re-raise nor log,
+    scoped to RESILIENCE_GLOBS files (resilience/, distributed/
+    checkpoint/, inference/)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+
+    def visit_Try(self, node):
+        for handler in node.handlers:
+            if _is_broad_handler(handler) and not _handler_reports(handler):
+                what = "bare 'except:'" if handler.type is None else \
+                    "broad 'except Exception'"
+                self.findings.append(make_finding(
+                    "PTL401",
+                    f"{what} swallows the failure (no re-raise, no "
+                    "warn/log) in resilience-critical code",
+                    file=self.filename, line=handler.lineno,
+                    col=handler.col_offset))
+        self.generic_visit(node)
+
+
+def is_resilience_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in RESILIENCE_GLOBS)
+
+
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> None (bare noqa: suppress all) | set of codes."""
     out: Dict[int, Optional[Set[str]]] = {}
@@ -455,9 +524,14 @@ def lint_source(source: str, filename: str = "<string>",
                              severity=WARNING)]
     linter = _Linter(filename, source.splitlines(), surface)
     linter.visit(tree)
+    findings = list(linter.findings)
+    if is_resilience_path(filename):
+        hygiene = _ExceptionHygiene(filename)
+        hygiene.visit(tree)
+        findings.extend(hygiene.findings)
     noqa = _collect_noqa(source)
     out = []
-    for f in linter.findings:
+    for f in findings:
         supp = noqa.get(f.line, "missing")
         if supp is None:               # bare noqa
             continue

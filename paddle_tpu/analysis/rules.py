@@ -15,6 +15,8 @@ Code space:
   PTL2xx  captured-graph hazard rules (graphcheck.py)
   PTL3xx  tuning cost-model sanity rules (tuning/cost_model.py,
           emitted by tools/run_analysis.py)
+  PTL4xx  resilience hygiene rules (exception handling in
+          resilience-critical subsystems, see lint.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -257,6 +259,19 @@ _rule(
     "across them.",
     "Batch the reads, move them off the step path, or keep the value "
     "on device.")
+_rule(
+    "PTL401", "swallowed-exception", ERROR,
+    "bare except / except Exception without re-raise or logging in "
+    "resilience-critical code",
+    "In resilience/, distributed/checkpoint/, and inference/ a "
+    "swallow-and-continue handler converts a real failure (torn "
+    "checkpoint, dead worker, failed predict) into silent wrong "
+    "behavior — the exact anti-pattern the resilience subsystem exists "
+    "to kill.  Typed, narrow handlers (OSError, ValueError, ...) are "
+    "fine; broad ones must re-raise, warn, or log.",
+    "Narrow the exception type, or add a re-raise / warnings.warn / "
+    "logging call; a deliberate broad catch takes '# noqa: PTL401' "
+    "with a reason comment.")
 _rule(
     "PTL301", "cost-model-sanity", ERROR,
     "tuning cost model violates a physical invariant",

@@ -11,31 +11,79 @@ Kwarg semantics (all honored, none silently ignored):
 - ``async_save``      — orbax AsyncCheckpointer: the save is committed on
                         a background thread; ``wait_async_save()`` (or the
                         next save/load touching the same path) joins it.
+                        A FAILED background save raises
+                        :class:`AsyncSaveError` at the join — a later
+                        load can never silently read the previous version.
 - ``unique_id``       — versioned save: writes into ``path/<unique_id>``;
-                        load with unique_id=None picks the newest version
-                        (the reference's dir-versioning contract).
+                        load with unique_id=None picks the newest VALID
+                        version (see below).
 - ``process_group``   — single-controller SPMD has exactly one (global)
                         group; passing a non-default group is rejected
                         rather than ignored.
 - ``coordinator_rank``— metadata writer; under the single-controller
                         runtime the controller IS rank 0, so only 0 is
                         accepted.
+
+Crash-safety contract (paddle_tpu.resilience):
+
+* every save finishes by atomically writing a ``_COMMIT`` manifest —
+  per-array sha256 digests + caller metadata (e.g. the training step) —
+  only AFTER the orbax save has fully landed.  A crash mid-save leaves a
+  version directory without ``_COMMIT``: torn by construction.
+* ``load_state_dict(unique_id=None)`` scans versions newest-first and
+  skips uncommitted, unrestorable, or digest-mismatched versions with a
+  warning, falling back to the next older valid one (legacy checkpoints
+  written before commit markers existed still load, with a warning).
+* ``keep_last_k=K`` on save (or :func:`gc_versions`) retains the newest
+  K committed versions and clears older ones plus stale torn versions.
+* disk I/O around the save (orbax write, commit write) retries transient
+  ``OSError`` via ``resilience.with_retries`` (deterministic backoff).
+* the ``ckpt_write`` fault point sits exactly in the torn window (after
+  the orbax save, before ``_COMMIT``) so chaos tests can crash, stall,
+  or damage the checkpoint there deterministically.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...resilience.faults import maybe_fault
+from ...resilience.retry import with_retries
 
-__all__ = ["save_state_dict", "load_state_dict", "wait_async_save"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "AsyncSaveError", "latest_committed", "gc_versions",
+           "last_load_info", "COMMIT_FILE"]
 
-# in-flight async saves: path -> AsyncCheckpointer (joined on demand)
-_ASYNC_SAVES: Dict[str, Any] = {}
+COMMIT_FILE = "_COMMIT"
+COMMIT_SCHEMA_VERSION = 1
+
+# in-flight async saves: path -> {"ckptr", "digests", "meta",
+# "keep_last_k", "base"} (joined + committed on demand)
+_ASYNC_SAVES: Dict[str, Dict[str, Any]] = {}
+
+# what the most recent load_state_dict actually restored (version picked,
+# manifest metadata, versions skipped) — the resilient driver reads the
+# resume step from here
+_LAST_LOAD: Optional[Dict[str, Any]] = None
+
+
+class AsyncSaveError(RuntimeError):
+    """A background (async_save=True) checkpoint save failed; raised at
+    the join so the failure cannot be silently absorbed."""
+
+    def __init__(self, path: str, cause: BaseException):
+        super().__init__(f"async checkpoint save to {path!r} failed: "
+                         f"{type(cause).__name__}: {cause}")
+        self.path = path
 
 
 def _to_arrays(state_dict: Dict[str, Any]):
@@ -71,51 +119,275 @@ def _versioned_path(path: str, unique_id) -> str:
     return os.path.join(path, str(unique_id))
 
 
-def _latest_version(path: str) -> str:
-    """For load with unique_id=None: if `path` holds only versioned
-    subdirs (no checkpoint metadata at top level), pick the newest."""
-    if os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")) or \
-            os.path.exists(os.path.join(path, "manifest.ocdbt")) or \
-            os.path.exists(os.path.join(path, "_METADATA")):
-        return path
-    subs = [d for d in (os.listdir(path) if os.path.isdir(path) else [])
-            if os.path.isdir(os.path.join(path, d))]
-    if not subs:
-        return path
-    def _key(d):
-        try:
-            return (1, int(d))
-        except ValueError:
-            return (0, os.path.getmtime(os.path.join(path, d)))
-    return os.path.join(path, max(subs, key=_key))
+def _is_checkpoint_dir(path: str) -> bool:
+    """Does ``path`` itself hold checkpoint data (unversioned layout)?"""
+    return any(os.path.exists(os.path.join(path, marker))
+               for marker in ("_CHECKPOINT_METADATA", "manifest.ocdbt",
+                              "_METADATA", COMMIT_FILE))
 
+
+def _version_subdirs_newest_first(base: str) -> List[str]:
+    """Version subdirectories of ``base``, newest first.  Numeric names
+    order by value; non-numeric by mtime with a NAME tie-break, so two
+    versions written within one mtime granule cannot flap between
+    runs (the deterministic ``_latest_version`` contract)."""
+    try:
+        subs = [d for d in os.listdir(base)
+                if os.path.isdir(os.path.join(base, d))]
+    except OSError:
+        return []
+
+    def _key(d: str):
+        try:
+            return (1, int(d), d)
+        except ValueError:
+            try:
+                mtime = os.path.getmtime(os.path.join(base, d))
+            except OSError:
+                mtime = 0.0
+            return (0, mtime, d)
+
+    return [os.path.join(base, d)
+            for d in sorted(subs, key=_key, reverse=True)]
+
+
+def _latest_version(path: str) -> str:
+    """Legacy newest-version pick (no commit-marker requirement) — the
+    fallback when no version carries a ``_COMMIT`` manifest."""
+    if _is_checkpoint_dir(path):
+        return path
+    subs = _version_subdirs_newest_first(path)
+    return subs[0] if subs else path
+
+
+# ---------------------------------------------------------------------------
+# commit manifest
+# ---------------------------------------------------------------------------
+
+def _flatten_arrays(tree: Dict[str, Any], prefix: str = "",
+                    out: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    if out is None:
+        out = {}
+    for k, v in tree.items():
+        name = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            _flatten_arrays(v, name, out)
+        else:
+            out[name] = v
+    return out
+
+
+def _digest(arr) -> Dict[str, Any]:
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(tuple(a.shape)).encode())
+    h.update(a.tobytes())
+    return {"sha256": h.hexdigest(), "dtype": str(a.dtype),
+            "shape": list(a.shape)}
+
+
+def _compute_digests(arrays: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    return {name: _digest(a)
+            for name, a in _flatten_arrays(arrays).items()}
+
+
+def _commit_path(version_dir: str) -> str:
+    return os.path.join(version_dir, COMMIT_FILE)
+
+
+def _write_commit(version_dir: str, digests: Dict[str, Any],
+                  metadata: Optional[Dict[str, Any]]) -> None:
+    """Atomic (tmp + rename) manifest write, retried on transient
+    OSError — the commit marker is the ONE file whose presence means
+    'this version is real'."""
+    payload = {"v": COMMIT_SCHEMA_VERSION, "t": time.time(),
+               "arrays": digests, "meta": dict(metadata or {})}
+    path = _commit_path(version_dir)
+
+    def _write():
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    with_retries(_write, attempts=3, retry_on=(OSError,),
+                 label="ckpt_commit")
+
+
+def read_commit(version_dir: str) -> Optional[Dict[str, Any]]:
+    """The parsed ``_COMMIT`` manifest, or None when the version is
+    uncommitted / the manifest is torn or schema-skewed (all of which
+    mean: do not trust this version)."""
+    path = _commit_path(version_dir)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or \
+            payload.get("v") != COMMIT_SCHEMA_VERSION or \
+            "arrays" not in payload:
+        return None
+    return payload
+
+
+def latest_committed(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """Newest version under ``path`` with a valid commit manifest, as
+    ``(version_dir, manifest)``; None when nothing is committed."""
+    base = os.path.abspath(path)
+    if _is_checkpoint_dir(base):
+        manifest = read_commit(base)
+        return (base, manifest) if manifest is not None else None
+    for vdir in _version_subdirs_newest_first(base):
+        manifest = read_commit(vdir)
+        if manifest is not None:
+            return vdir, manifest
+    return None
+
+
+def _digest_mismatches(restored: Dict[str, Any],
+                       manifest: Dict[str, Any]) -> List[str]:
+    """Names whose restored bytes do not match the committed digests
+    (missing arrays count as mismatches; extra restored arrays do not —
+    partial loads select subsets by key)."""
+    flat = _flatten_arrays(restored)
+    bad = []
+    for name, want in manifest.get("arrays", {}).items():
+        got = flat.get(name)
+        if got is None:
+            bad.append(name + " (missing)")
+            continue
+        if _digest(got)["sha256"] != want.get("sha256"):
+            bad.append(name)
+    return bad
+
+
+def last_load_info() -> Optional[Dict[str, Any]]:
+    """Details of the most recent ``load_state_dict`` in this process:
+    ``{"source", "version", "committed", "metadata", "skipped"}``."""
+    return dict(_LAST_LOAD) if _LAST_LOAD is not None else None
+
+
+# ---------------------------------------------------------------------------
+# retention GC
+# ---------------------------------------------------------------------------
+
+def gc_versions(path: str, keep_last_k: int) -> int:
+    """Keep the newest ``keep_last_k`` committed versions; remove older
+    committed versions AND torn (uncommitted) versions older than the
+    newest committed one.  Versions with an in-flight async save are
+    never touched.  Returns the number of version dirs removed."""
+    import warnings
+    if keep_last_k is None or int(keep_last_k) < 1:
+        return 0
+    base = os.path.abspath(path)
+    if _is_checkpoint_dir(base):
+        return 0                      # unversioned layout: nothing to GC
+    subs = _version_subdirs_newest_first(base)
+    committed = [p for p in subs if read_commit(p) is not None]
+    keep = set(committed[:int(keep_last_k)])
+    if not committed:
+        return 0
+    newest_committed_rank = subs.index(committed[0])
+    removed = 0
+    for rank, vdir in enumerate(subs):
+        if vdir in keep or vdir in _ASYNC_SAVES:
+            continue
+        is_committed = read_commit(vdir) is not None
+        # a torn version NEWER than the newest committed one may be a
+        # save that is still landing out-of-band — leave it alone
+        if not is_committed and rank <= newest_committed_rank:
+            continue
+        try:
+            shutil.rmtree(vdir)
+            removed += 1
+        except OSError as e:
+            warnings.warn(f"checkpoint GC could not remove {vdir!r}: {e}",
+                          stacklevel=2)
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
 
 def wait_async_save(path: Optional[str] = None):
     """Join outstanding async saves — all of them, or those under `path`
     (prefix match, so waiting on the base dir joins versioned saves made
-    with unique_id into ``path/<unique_id>``)."""
+    with unique_id into ``path/<unique_id>``).
+
+    On success each joined save gets its ``_COMMIT`` manifest (the save
+    is only now durable) and its retention GC.  A failed background save
+    raises :class:`AsyncSaveError` AFTER every other pending save has
+    been joined — a crash in one save never orphans the others."""
     if path is None:
         keys = list(_ASYNC_SAVES)
     else:
         p = os.path.abspath(path)
         keys = [k for k in _ASYNC_SAVES
                 if k == p or k.startswith(p + os.sep)]
+    first_error: Optional[Tuple[str, BaseException]] = None
     for k in keys:
-        ckptr = _ASYNC_SAVES.pop(k, None)
-        if ckptr is not None:
+        pending = _ASYNC_SAVES.pop(k, None)
+        if pending is None:
+            continue
+        ckptr = pending["ckptr"]
+        failed: Optional[BaseException] = None
+        try:
             ckptr.wait_until_finished()
+            check = getattr(ckptr, "check_for_errors", None)
+            if check is not None:
+                check()
+        except Exception as e:  # noqa: PTL401 — captured, then raised
+            # as AsyncSaveError after every other pending save joined
+            failed = e
+        finally:
             close = getattr(ckptr, "close", None)
             if close is not None:
-                close()
+                try:
+                    close()
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        f"closing async checkpointer for {k!r} failed: "
+                        f"{type(e).__name__}: {e}", stacklevel=2)
+        if failed is not None:
+            if first_error is None:
+                first_error = (k, failed)
+            continue                   # no commit marker: torn version
+        _write_commit(k, pending["digests"], pending["meta"])
+        if pending.get("keep_last_k") and pending.get("base"):
+            gc_versions(pending["base"], pending["keep_last_k"])
+    if first_error is not None:
+        k, e = first_error
+        raise AsyncSaveError(k, e) from e
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    unique_id=None, async_save: bool = False):
-    """ref: checkpoint/save_state_dict.py — sharded save."""
+                    unique_id=None, async_save: bool = False,
+                    metadata: Optional[Dict[str, Any]] = None,
+                    keep_last_k: Optional[int] = None):
+    """ref: checkpoint/save_state_dict.py — sharded save.
+
+    ``metadata`` (e.g. ``{"step": 1234}``) is recorded in the ``_COMMIT``
+    manifest; ``keep_last_k`` runs retention GC after the commit (only
+    meaningful with ``unique_id`` versioning)."""
     import orbax.checkpoint as ocp
     _check_group_rank(process_group, coordinator_rank)
     arrays = _to_arrays(state_dict)
+    # digests are taken from the immutable source arrays BEFORE anything
+    # touches disk: what the manifest promises is what was asked to be
+    # saved, so any torn/bit-rotten write is detectable on restore
+    digests = _compute_digests(arrays)
+    base = os.path.abspath(path)
     dest = _versioned_path(path, unique_id)
     wait_async_save(dest)  # one in-flight save per path
     if async_save:
@@ -125,28 +397,152 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             wait_async_save(next(iter(_ASYNC_SAVES)))
         ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         ckptr.save(dest, arrays, force=True)
-        _ASYNC_SAVES[dest] = ckptr
+        _ASYNC_SAVES[dest] = {
+            "ckptr": ckptr, "digests": digests, "meta": metadata,
+            "keep_last_k": keep_last_k,
+            "base": base if unique_id is not None else None,
+        }
+        # the torn window: the background save may still be in flight
+        # and _COMMIT only lands at the join
+        maybe_fault("ckpt_write", path=dest)
     else:
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(dest, arrays, force=True)
+        def _save():
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(dest, arrays, force=True)
+
+        with_retries(_save, attempts=2, retry_on=(OSError, TimeoutError),
+                     label="ckpt_save")
+        # the torn window: data is on disk, _COMMIT is not — a crash or
+        # injected damage here is exactly what load must survive
+        maybe_fault("ckpt_write", path=dest)
+        _write_commit(dest, digests, metadata)
+        if keep_last_k and unique_id is not None:
+            gc_versions(base, keep_last_k)
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+def _orbax_restore(src: str):
+    import warnings
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # sharding-from-file notice
+        try:
+            # restore as HOST numpy arrays: device placement belongs to
+            # the assign step (each destination tensor's own sharding),
+            # not to orbax's recorded save-time placement — and digest
+            # verification reads host bytes anyway
+            meta = ckptr.metadata(src)
+            args = jax.tree.map(
+                lambda m: ocp.RestoreArgs(restore_type=np.ndarray), meta)
+            return ckptr.restore(src, restore_args=args)
+        except Exception:  # noqa: PTL401 — falls back to the plain
+            # restore path below; a failure THERE propagates to the
+            # caller's skip-with-warning / raise handling
+            return ckptr.restore(src)
+
+
+def _select_and_restore(base: str, verify: bool):
+    """Newest-first scan over committed versions; returns
+    ``(src, manifest_or_None, restored, skipped)`` — skipping torn,
+    unrestorable, and digest-mismatched versions with a warning each."""
+    import warnings
+    skipped: List[str] = []
+    if _is_checkpoint_dir(base):
+        candidates = [base]
+    else:
+        candidates = _version_subdirs_newest_first(base) or [base]
+    any_committed = False
+    for vdir in candidates:
+        manifest = read_commit(vdir)
+        if manifest is None:
+            if vdir != base:
+                warnings.warn(
+                    f"checkpoint version {vdir!r} has no {COMMIT_FILE} "
+                    "manifest (torn or in-flight save) — skipping",
+                    stacklevel=3)
+                skipped.append(vdir)
+            continue
+        any_committed = True
+        try:
+            restored = _orbax_restore(vdir)
+        except Exception as e:
+            warnings.warn(
+                f"checkpoint version {vdir!r} is committed but failed to "
+                f"restore ({type(e).__name__}: {e}) — skipping",
+                stacklevel=3)
+            skipped.append(vdir)
+            continue
+        if verify:
+            bad = _digest_mismatches(restored, manifest)
+            if bad:
+                warnings.warn(
+                    f"checkpoint version {vdir!r} failed digest "
+                    f"verification for {', '.join(sorted(bad)[:4])}"
+                    f"{'…' if len(bad) > 4 else ''} — skipping",
+                    stacklevel=3)
+                skipped.append(vdir)
+                continue
+        return vdir, manifest, restored, skipped
+    # nothing committed+valid: legacy fallback (checkpoints written
+    # before commit markers existed), loud but functional
+    legacy = _latest_version(base)
+    if any_committed or skipped:
+        warnings.warn(
+            f"no committed checkpoint version under {base!r} survived "
+            f"validation; falling back to newest-by-name {legacy!r}",
+            stacklevel=3)
+    return legacy, None, _orbax_restore(legacy), skipped
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    unique_id=None, offload: bool = False):
+                    unique_id=None, offload: bool = False,
+                    verify: bool = True):
     """ref: checkpoint/load_state_dict.py — loads INTO the given
     state_dict (shapes/keys from it), resharding each array to the
-    destination tensor's current sharding."""
+    destination tensor's current sharding.
+
+    ``unique_id=None`` picks the newest VALID version (committed +
+    digest-clean), skipping torn ones with a warning.  An explicit
+    ``unique_id`` is honored verbatim, but a digest mismatch on a
+    committed version raises (the caller asked for THIS version; giving
+    them silently corrupt bytes is worse than failing)."""
     import warnings
-    import orbax.checkpoint as ocp
+    global _LAST_LOAD
     _check_group_rank(process_group, coordinator_rank)
-    wait_async_save()  # a pending async save must land before any load
-    src = (_versioned_path(path, unique_id) if unique_id is not None
-           else _latest_version(os.path.abspath(path)))
-    ckptr = ocp.PyTreeCheckpointer()
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")  # sharding-from-file notice
-        restored = ckptr.restore(src)
+    wait_async_save()  # a pending async save must land before any load;
+    #                    a FAILED one raises here instead of letting this
+    #                    load silently read the previous version
+    base = os.path.abspath(path)
+    skipped: List[str] = []
+    if unique_id is not None:
+        src = _versioned_path(path, unique_id)
+        manifest = read_commit(src)
+        restored = _orbax_restore(src)
+        if manifest is not None and verify:
+            bad = _digest_mismatches(restored, manifest)
+            if bad:
+                raise ValueError(
+                    f"checkpoint version {src!r} failed digest "
+                    f"verification for: {', '.join(sorted(bad))}")
+        elif manifest is None:
+            warnings.warn(
+                f"loading explicitly-requested checkpoint {src!r} with "
+                f"no {COMMIT_FILE} manifest (pre-commit-marker save, or "
+                "torn)", stacklevel=2)
+    else:
+        src, manifest, restored, skipped = _select_and_restore(base, verify)
+    _LAST_LOAD = {
+        "source": src,
+        "version": os.path.basename(src) if src != base else None,
+        "committed": manifest is not None,
+        "metadata": dict(manifest.get("meta", {})) if manifest else {},
+        "skipped": list(skipped),
+    }
 
     def assign(dst, src_tree, prefix=""):
         for k, v in dst.items():

@@ -70,6 +70,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op: bool = True,
     """In-place across-rank reduction (ref: distributed/communication/
     all_reduce.py).  Eager single-controller: the array is already a global
     value so the reduction is an identity."""
+    # resilience fault point: host-side entry of the collective layer
+    # (a scheduled crash/stall here models a rank dying inside NCCL/ICI)
+    from ...resilience.faults import maybe_fault
+    maybe_fault("collective", op="all_reduce")
     g = _resolve_group(group)
     t = _as_tensor(tensor)
     if g.in_spmd_scope():

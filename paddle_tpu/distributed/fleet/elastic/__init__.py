@@ -116,9 +116,15 @@ class ElasticManager:
                  heartbeat_timeout: float = 10.0,
                  stale_polls_to_restart: int = 2):
         self.args = args
-        self.elastic_level = int(os.environ.get(
-            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL",
-            os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANCE_LEVEL", "1")))
+        # both spellings are honored — the reference's env var is the
+        # typo'd PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL; precedence: the
+        # CORRECT spelling (…TOLERANCE_LEVEL) wins when both are set,
+        # the reference spelling is the fallback, default level 1
+        _lvl = os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANCE_LEVEL")
+        if _lvl is None:
+            _lvl = os.environ.get(
+                "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1")
+        self.elastic_level = int(_lvl)
         self.np = int(np if np is not None else
                       os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         self.ranks = list(ranks) if ranks is not None \
@@ -152,10 +158,16 @@ class ElasticManager:
 
     def reset(self):
         """Clear THIS manager's ranks' state before a (re)launch (peers'
-        files in a shared registry are never touched)."""
+        files in a shared registry are never touched).  Also sweeps
+        orphaned ``worker_<r>.hb.tmp<pid>`` files — a worker SIGKILLed
+        between the tmp write and the atomic rename leaves one behind
+        per crash, and a long-lived registry would accumulate them."""
+        import glob
         self._stale_streak = 0
         for r in self.ranks:
-            for path in (self._hb_path(r), self._done_path(r)):
+            paths = [self._hb_path(r), self._done_path(r)]
+            paths += glob.glob(self._hb_path(r) + ".tmp*")
+            for path in paths:
                 try:
                     os.remove(path)
                 except OSError:

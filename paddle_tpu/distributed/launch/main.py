@@ -81,6 +81,15 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             not os.environ.get("PADDLE_ELASTIC_JOB_ID"):
         job_id = f"{os.getpid()}_{int(time.time() * 1000)}"
         env["PADDLE_ELASTIC_JOB_ID"] = job_id
+    # chaos mode (paddle_tpu.resilience.faults): a FLAGS_fault_schedule
+    # riding into a SUPERVISED worker gets a job-scoped fired-state file,
+    # so each scheduled fault fires once per job instead of once per
+    # relaunch — without it a crash fault would burn every restart
+    if env.get("FLAGS_fault_schedule"):
+        from ...resilience.faults import STATE_FILE_ENV
+        env.setdefault(STATE_FILE_ENV,
+                       os.path.join(os.path.abspath(log_dir),
+                                    "fault_state.txt"))
     # this launcher supervises its OWN rank; peers run their own loop
     manager = ElasticManager(ranks=[local_rank], job_id=job_id)
     if elastic_timeout is not None:

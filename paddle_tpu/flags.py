@@ -217,6 +217,22 @@ define_flag("tuning_cache_dir", "",
             "(flash_blocks + engine_plan JSONL stores, and the XLA "
             "compilation cache under <dir>/xla); empty: disabled",
             on_change=_apply_tuning_cache_dir)
+def _apply_fault_schedule(text: str):
+    """Deterministic chaos layer (paddle_tpu.resilience.faults): parse
+    and install the fault-injection schedule.  A malformed schedule
+    raises here, so set_flags rejects it and an env typo warns at
+    import instead of silently not injecting."""
+    from .resilience.faults import install_schedule
+    install_schedule(text)
+
+
+define_flag("fault_schedule", "",
+            "deterministic fault-injection schedule "
+            "'point@N=kind[:arg];...' over the named fault points "
+            "(step, ckpt_write, collective, compile); kinds: crash, "
+            "exit, stall, exc, truncate, corrupt.  Empty: disabled. "
+            "See paddle_tpu.resilience.faults",
+            on_change=_apply_fault_schedule)
 define_flag("pallas_autotune_topk", 4,
             "measured autotune times only the cost model's top-K block "
             "candidates (0: time every valid candidate)")

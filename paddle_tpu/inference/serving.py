@@ -9,8 +9,25 @@ The wire format is npz both ways (dense arrays, zero deps):
 
 - ``POST /predict`` — body: ``np.savez`` of named inputs (or positional
   ``input_0..``); response: npz of ``output_i`` arrays.
-- ``GET /health`` — JSON with the model's input names and a serving
-  counter.
+- ``GET /health`` — JSON with the model's input names and serving
+  counters (served / in_flight / rejected / errors).
+
+Failure taxonomy (the resilience contract):
+
+* a malformed request (bad npz, missing inputs) answers **400** — the
+  client's fault, the server carries no blame and keeps serving;
+* a predictor failure answers **500** — the server's fault, reported
+  honestly instead of dressed up as a client error;
+* more than ``max_in_flight`` concurrent predicts answers **503** with
+  ``Retry-After`` — bounded load shedding instead of unbounded queueing
+  on the predictor lock (TPU steps don't time-slice; queue time is
+  latency);
+* ``stop()`` drains in-flight requests before closing the socket, so a
+  rolling restart never truncates a response mid-body.
+
+``predict_http`` retries 503 and connection resets with the shared
+``resilience.with_retries`` backoff (deterministic jitter), making the
+client side of a resilient deployment a one-liner too.
 
 The predictor executes under a lock (jit executables are thread-safe
 but the handle-feed API is stateful); batching across requests is the
@@ -23,35 +40,47 @@ from __future__ import annotations
 import io
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
 
 import numpy as np
 
 from . import Config, Predictor, create_predictor
+from ..resilience.retry import with_retries
 
 __all__ = ["InferenceServer", "serve", "predict_http"]
 
 
 class InferenceServer:
-    """Serve one Predictor over HTTP."""
+    """Serve one Predictor over HTTP (bounded load, draining stop)."""
 
-    def __init__(self, predictor, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, predictor, host: str = "127.0.0.1", port: int = 0,
+                 max_in_flight: int = 8):
         if isinstance(predictor, Config):
             predictor = create_predictor(predictor)
         self.predictor = predictor
-        self._lock = threading.Lock()
+        self.max_in_flight = int(max_in_flight)
+        self._lock = threading.Lock()          # predictor execution
+        self._state = threading.Condition()    # in-flight accounting
+        self._in_flight = 0
+        self._closing = False
         self._served = 0
+        self._rejected = 0
+        self._errors = 0
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):        # quiet
                 pass
 
-            def _reply(self, code, body, ctype="application/json"):
+            def _reply(self, code, body, ctype="application/json",
+                       extra_headers=()):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -61,36 +90,77 @@ class InferenceServer:
                     return
                 info = {"status": "ok",
                         "inputs": outer.predictor.get_input_names(),
-                        "served": outer._served}
+                        "served": outer._served,
+                        "in_flight": outer._in_flight,
+                        "rejected": outer._rejected,
+                        "errors": outer._errors}
                 self._reply(200, json.dumps(info).encode())
 
             def do_POST(self):
                 if self.path != "/predict":
                     self._reply(404, b'{"error": "unknown path"}')
                     return
+                if not outer._admit():
+                    # overloaded (or draining): shed load NOW rather
+                    # than queueing unbounded on the predictor lock
+                    self._reply(503, json.dumps(
+                        {"error": "overloaded: "
+                         f"{outer.max_in_flight} requests in flight"}
+                    ).encode(), extra_headers=(("Retry-After", "1"),))
+                    return
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    payload = np.load(io.BytesIO(self.rfile.read(n)),
-                                      allow_pickle=False)
-                    names = outer.predictor.get_input_names()
-                    inputs = [payload[k] if k in payload.files
-                              else payload[payload.files[i]]
-                              for i, k in enumerate(names)]
-                    with outer._lock:
-                        outs = outer.predictor.run(inputs)
-                        outer._served += 1
+                    # ---- parse phase: failures are the CLIENT's -> 400
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                        payload = np.load(io.BytesIO(self.rfile.read(n)),
+                                          allow_pickle=False)
+                        names = outer.predictor.get_input_names()
+                        inputs = [payload[k] if k in payload.files
+                                  else payload[payload.files[i]]
+                                  for i, k in enumerate(names)]
+                    except Exception as e:  # noqa: PTL401, BLE001 —
+                        # answered to the client as HTTP 400; a bad
+                        # request must not kill the server thread
+                        self._reply(400, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode())
+                        return
+                    # ---- predict phase: failures are OURS -> 500
+                    try:
+                        with outer._lock:
+                            outs = outer.predictor.run(inputs)
+                            outer._served += 1
+                    except Exception as e:  # noqa: PTL401, BLE001 —
+                        # reported to the client as HTTP 500 (and
+                        # counted); the serving loop must survive one
+                        # bad batch
+                        outer._errors += 1
+                        self._reply(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode())
+                        return
                     buf = io.BytesIO()
                     np.savez(buf, **{f"output_{i}": o
                                      for i, o in enumerate(outs)})
                     self._reply(200, buf.getvalue(),
                                 "application/octet-stream")
-                except Exception as e:  # noqa: BLE001 — a bad request
-                    # must answer the client, not kill the server thread
-                    self._reply(400, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                finally:
+                    outer._release()
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._thread: Optional[threading.Thread] = None
+
+    # -- in-flight accounting -------------------------------------------
+    def _admit(self) -> bool:
+        with self._state:
+            if self._closing or self._in_flight >= self.max_in_flight:
+                self._rejected += 1
+                return False
+            self._in_flight += 1
+            return True
+
+    def _release(self):
+        with self._state:
+            self._in_flight -= 1
+            self._state.notify_all()
 
     @property
     def url(self) -> str:
@@ -110,8 +180,25 @@ class InferenceServer:
         self._thread.start()
         return self
 
-    def stop(self):
-        self._httpd.shutdown()
+    def stop(self, drain_timeout: float = 10.0):
+        """Stop accepting work, DRAIN in-flight requests (bounded by
+        ``drain_timeout``), then close the socket and join the loop."""
+        with self._state:
+            self._closing = True          # new requests answer 503
+        self._httpd.shutdown()            # stop the accept loop
+        deadline = time.monotonic() + float(drain_timeout)
+        with self._state:
+            while self._in_flight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    import warnings
+                    warnings.warn(
+                        f"InferenceServer.stop: {self._in_flight} "
+                        "request(s) still in flight after "
+                        f"{drain_timeout}s drain; closing anyway",
+                        stacklevel=2)
+                    break
+                self._state.wait(remaining)
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -124,25 +211,53 @@ class InferenceServer:
 
 
 def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 0,
-          **config_kw) -> InferenceServer:
+          max_in_flight: int = 8, **config_kw) -> InferenceServer:
     """One-call server over a ``paddle.jit.save`` artifact."""
     cfg = Config(model_prefix + ".pdmodel", model_prefix + ".pdiparams")
     for k, v in config_kw.items():
         setattr(cfg, k, v)
-    return InferenceServer(cfg, host=host, port=port).start()
+    return InferenceServer(cfg, host=host, port=port,
+                           max_in_flight=max_in_flight).start()
 
 
-def predict_http(url: str, *inputs: np.ndarray,
-                 timeout: float = 30.0):
-    """Minimal client for :class:`InferenceServer` (npz wire format)."""
+def _retriable_http(exc: BaseException) -> bool:
+    """Retry overload shedding (503) and connection resets — the two
+    failure modes a resilient deployment produces on purpose (load
+    limits, rolling restarts).  4xx/5xx semantics are preserved: a 400
+    stays the client's bug and a 500 the server's, neither is retried."""
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code == 503
+    if isinstance(exc, (ConnectionResetError, ConnectionRefusedError,
+                        ConnectionAbortedError, BrokenPipeError)):
+        return True
+    if isinstance(exc, urllib.error.URLError):
+        return isinstance(getattr(exc, "reason", None),
+                          (ConnectionResetError, ConnectionRefusedError,
+                           ConnectionAbortedError, BrokenPipeError))
+    return False
+
+
+def predict_http(url: str, *inputs: np.ndarray, timeout: float = 30.0,
+                 retries: int = 4, retry_backoff: float = 0.1):
+    """Minimal client for :class:`InferenceServer` (npz wire format)
+    with retry-with-backoff on 503/connection-reset."""
     import urllib.request
     buf = io.BytesIO()
     np.savez(buf, **{f"input_{i}": np.asarray(a)
                      for i, a in enumerate(inputs)})
-    req = urllib.request.Request(url.rstrip("/") + "/predict",
-                                 data=buf.getvalue(), method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        if resp.status != 200:
-            raise RuntimeError(f"server error {resp.status}")
-        payload = np.load(io.BytesIO(resp.read()), allow_pickle=False)
-        return [payload[k] for k in sorted(payload.files)]
+    data = buf.getvalue()
+
+    def _once():
+        req = urllib.request.Request(url.rstrip("/") + "/predict",
+                                     data=data, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"server error {resp.status}")
+            payload = np.load(io.BytesIO(resp.read()), allow_pickle=False)
+            return [payload[k] for k in sorted(payload.files)]
+
+    return with_retries(_once, attempts=max(1, int(retries)),
+                        retry_on=_retriable_http,
+                        base_delay=retry_backoff, max_delay=2.0,
+                        label="predict_http")

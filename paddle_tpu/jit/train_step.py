@@ -236,6 +236,11 @@ class TrainStep:
                tuple(tuple(a.shape) for a in batch_arrays))
         fn = self._jit_cache.get(key)
         if fn is None:
+            # resilience fault point: a jit-cache miss is where a
+            # scheduled compile-time crash/stall/exception fires (the
+            # wedged-Mosaic-compile case the stall heartbeat must catch)
+            from ..resilience.faults import maybe_fault
+            maybe_fault("compile")
             step = self._make_step()
             kw = {}
             if self.mesh is not None:

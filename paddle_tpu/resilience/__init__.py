@@ -1,0 +1,47 @@
+"""``paddle_tpu.resilience`` — deterministic fault injection, shared
+retry/backoff, and the resilient training driver.
+
+Three layers (see each module's docstring):
+
+* :mod:`.faults` — named fault points (``step``, ``ckpt_write``,
+  ``collective``, ``compile``) driven by a declarative
+  ``FLAGS_fault_schedule``; crash / stall / transient-exception /
+  checkpoint-damage kinds, each firing at a scheduled occurrence count
+  so chaos runs are exactly reproducible.
+* :mod:`.retry` — ``with_retries``: typed exception filter, bounded
+  exponential backoff with *deterministic* jitter; used by checkpoint
+  I/O, the tuning disk cache, and the HTTP inference client.
+* :mod:`.driver` — ``run_resilient`` (supervisor: relaunch on crash or
+  stall, SIGTERM preemption with a final-checkpoint grace window) and
+  ``ResilientTrainLoop`` (worker: resume from the newest *valid*
+  checkpoint version, heartbeat per step, keep-last-K retention).
+
+``faults`` and ``retry`` are stdlib-only and import-safe from
+``flags.py`` at package-import time; ``driver`` (which pulls in the
+distributed stack) loads lazily.
+"""
+from __future__ import annotations
+
+from .faults import (FaultInjector, FaultSpec, InjectedFault,  # noqa: F401
+                     get_injector, install_schedule, maybe_fault,
+                     parse_schedule)
+from .retry import with_retries  # noqa: F401
+
+__all__ = ["FaultInjector", "FaultSpec", "InjectedFault", "get_injector",
+           "install_schedule", "maybe_fault", "parse_schedule",
+           "with_retries", "ResilientTrainLoop", "RunReport",
+           "run_resilient"]
+
+_DRIVER_NAMES = ("ResilientTrainLoop", "RunReport", "run_resilient",
+                 "CKPT_DIR_ENV", "driver")
+
+
+def __getattr__(name):
+    # driver imports fleet.elastic/checkpoint — lazy so installing a
+    # fault schedule from flags.py at import time stays cycle-free
+    if name in _DRIVER_NAMES:
+        from . import driver as _driver
+        if name == "driver":
+            return _driver
+        return getattr(_driver, name)
+    raise AttributeError(name)

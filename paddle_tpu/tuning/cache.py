@@ -116,21 +116,40 @@ class TuningCache:
         return mem
 
     def _flush(self, kind: str) -> None:
-        """Atomic whole-file rewrite of the merged index."""
+        """Atomic whole-file rewrite of the merged index; transient
+        OSErrors (NFS/GCS-fuse hiccups — the shared-storage deployments
+        the cache targets) are retried with deterministic backoff via
+        the shared resilience helper."""
+        try:
+            from ..resilience.retry import with_retries
+        except ImportError:
+            # this module is loadable standalone (file-path import in
+            # tests/tools); degrade to one attempt rather than dragging
+            # the package in
+            try:
+                from paddle_tpu.resilience.retry import with_retries
+            except ImportError:
+                def with_retries(fn, **kw):
+                    return fn()
         mem = self._load(kind)       # merge latest disk state first
         os.makedirs(self.directory, exist_ok=True)
         path = self._path(kind)
-        tmp = f"{path}.{os.getpid()}.{os.urandom(4).hex()}.tmp"
-        try:
-            with open(tmp, "w", encoding="utf-8") as fh:
-                for rec in mem.values():
-                    fh.write(json.dumps(rec, sort_keys=True) + "\n")
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+
+        def _write():
+            tmp = f"{path}.{os.getpid()}.{os.urandom(4).hex()}.tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    for rec in mem.values():
+                        fh.write(json.dumps(rec, sort_keys=True) + "\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+
+        with_retries(_write, attempts=3, retry_on=(OSError,),
+                     label=f"tuning_cache:{kind}")
         try:
             self._mtime[kind] = os.stat(path).st_mtime
         except OSError:

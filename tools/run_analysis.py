@@ -2,8 +2,11 @@
 """CI gate for paddle_tpu.analysis: exit non-zero on error findings.
 
 Runs the tracing-safety lint over the package + examples + tools and
-the op-registry consistency check, printing a summary.  This is the
-scriptable twin of `pytest -m lint` for environments without pytest:
+the op-registry consistency check, printing a summary.  The lint pass
+includes the resilience exception-hygiene rule (PTL401: bare except /
+except Exception without re-raise or logging in resilience/,
+distributed/checkpoint/, and inference/).  This is the scriptable twin
+of `pytest -m lint` for environments without pytest:
 
     python tools/run_analysis.py            # lint + registry + cost model
     python tools/run_analysis.py --no-registry   # skip the registry pass
