@@ -144,8 +144,17 @@ def _measure(preset, seq, batch, steps, warmup, on_tpu, devices):
 
     step = train_step(model, None, optimizer, step_fn=_step_fn)
 
+    from paddle_tpu.core.dispatch import observe_op_stream
+    from paddle_tpu.observability.metrics import (HistogramValue,
+                                                  TIME_BUCKETS)
+
     rs = np.random.RandomState(0)
     cold_compile_s = None
+    dispatch_ops = {}
+
+    def _count_op(ev):
+        dispatch_ops[ev.op_name] = dispatch_ops.get(ev.op_name, 0) + 1
+
     while True:
         ids = rs.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
         labels = rs.randint(0, cfg.vocab_size,
@@ -153,9 +162,15 @@ def _measure(preset, seq, batch, steps, warmup, on_tpu, devices):
         try:
             # first warmup step = trace + XLA compile + one step: the
             # cold-start number FLAGS_tuning_cache_dir (persistent
-            # compile + autotune caches) exists to shrink
+            # compile + autotune caches) exists to shrink.  The op
+            # stream of this trace is ALSO where every op the compiled
+            # step contains gets dispatched once — count it for the
+            # observability snapshot (steady-state steps dispatch
+            # nothing; that's the point of the jit)
+            dispatch_ops.clear()
             t_cold = time.perf_counter()
-            step(ids, labels).block_until_ready()
+            with observe_op_stream(_count_op):
+                step(ids, labels).block_until_ready()
             cold_compile_s = time.perf_counter() - t_cold
             for _ in range(max(warmup - 1, 0)):
                 step(ids, labels).block_until_ready()
@@ -165,10 +180,13 @@ def _measure(preset, seq, batch, steps, warmup, on_tpu, devices):
                 batch //= 2        # HBM-adaptive batch (VERDICT r3 w1)
                 continue
             raise
+    step_hist = HistogramValue(TIME_BUCKETS)
     t0 = time.perf_counter()
     loss = None
     for _ in range(steps):
+        t1 = time.perf_counter()
         loss = step(ids, labels)
+        step_hist.observe(time.perf_counter() - t1)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
 
@@ -187,6 +205,15 @@ def _measure(preset, seq, batch, steps, warmup, on_tpu, devices):
         # tuning/compile caches reclaim on re-runs
         "cold_compile_s": round(cold_compile_s, 3),
         "warm_step_s": round(dt / steps, 4),
+        # observability snapshot: per-step DISPATCH time distribution
+        # (async — the sync cost sits on the final block), and the op
+        # stream the compiled step was traced from
+        "observability": {
+            "step_dispatch": step_hist.summary(),
+            "dispatch_ops_total": sum(dispatch_ops.values()),
+            "dispatch_top_ops": sorted(dispatch_ops.items(),
+                                       key=lambda kv: -kv[1])[:8],
+        },
     }
     if on_tpu:
         res["mfu"] = round(value * 6.0 * n_params
@@ -263,6 +290,21 @@ def run_bench():
         # always records a parsable line even when the TPU tunnel is down
         preset, seq, batch, steps, warmup = "tiny", 128, 4, 3, 1
 
+    # count backend compile events (jax.monitoring) across the run —
+    # the cold/warm split cache PRs optimize shows up here as a count
+    compile_events = {"n": 0, "secs": 0.0}
+    try:
+        import jax.monitoring as _mon
+
+        def _on_dur(event, duration, **kw):
+            if "backend_compile" in event or "compilation_cache" in event:
+                compile_events["n"] += 1
+                compile_events["secs"] += float(duration)
+
+        _mon.register_event_duration_secs_listener(_on_dur)
+    except Exception:  # noqa: BLE001
+        pass
+
     primary = _measure(preset, seq, batch, steps, warmup, on_tpu, devices)
     if on_tpu:
         metric = f"{preset}_pretrain_tokens_per_sec_per_chip"
@@ -291,6 +333,10 @@ def run_bench():
         out["mfu"] = primary["mfu"]
     out["cold_compile_s"] = primary.get("cold_compile_s")
     out["warm_step_s"] = primary.get("warm_step_s")
+    out["observability"] = dict(
+        primary.get("observability") or {},
+        compile_events=compile_events["n"],
+        compile_total_s=round(compile_events["secs"], 3))
     # tuning-cache effectiveness: hit/miss counters (zeros when
     # FLAGS_tuning_cache_dir is unset) so BENCH_*.json trajectories
     # show the caching win; never let reporting break the bench
@@ -347,6 +393,10 @@ def _run_child(extra_env, budget, mode=None):
     # read as a perf regression or a hung tunnel)
     env.pop("FLAGS_fault_schedule", None)
     env.pop("PADDLE_FAULT_STATE_FILE", None)
+    # likewise a leaked observability dir: the event-log dispatch hook
+    # adds per-op overhead and JSONL writes that would skew the numbers
+    # (the bench emits its own in-process snapshot instead)
+    env.pop("FLAGS_observability_dir", None)
     if mode:
         env["BENCH_MODE"] = mode
     try:

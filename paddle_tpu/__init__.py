@@ -106,7 +106,13 @@ _SUBPACKAGES = ["nn", "optimizer", "autograd", "amp", "io", "metric",
                 "utils", "profiler", "sparse", "text", "audio",
                 "quantization", "onnx", "version", "inference",
                 "hub", "sysconfig", "multiprocessing", "callbacks",
-                "geometric", "tuning"]
+                "geometric", "tuning", "observability"]
+
+# an env-ingested FLAGS_observability_dir configured the event log
+# while the core modules were still importing; now that they exist,
+# install the dispatch/host-read hooks (no-op when the flag is unset)
+from .observability import events as _obs_events
+_obs_events._ensure_hooks()
 
 
 def __getattr__(name):

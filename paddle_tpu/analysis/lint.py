@@ -63,6 +63,15 @@ RESILIENCE_GLOBS = (
     "*/inference/*.py",
 )
 
+# instrumented subsystems (PTL501 raw-timing scope): timings reported
+# from here must flow through observability.metrics, not ad-hoc
+# time.time()/perf_counter() deltas (time.monotonic deadlines are fine)
+TIMING_GLOBS = (
+    "*/tuning/*.py",
+    "*/resilience/*.py",
+    "*/inference/*.py",
+)
+
 _HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
 _HOST_CASTS = {"float", "int", "bool"}
 _TRACED_DECORATORS = {"to_static", "train_step", "TrainStep"}
@@ -486,6 +495,36 @@ def is_resilience_path(path: str) -> bool:
     return any(fnmatch.fnmatch(p, g) for g in RESILIENCE_GLOBS)
 
 
+_RAW_TIMING_CALLS = {"time.time", "time.perf_counter",
+                     "_time.time", "_time.perf_counter"}
+
+
+class _TimingHygiene(ast.NodeVisitor):
+    """PTL501: raw wall-clock reads in instrumented subsystems, scoped
+    to TIMING_GLOBS files (tuning/, resilience/, inference/)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+        if dotted in _RAW_TIMING_CALLS:
+            self.findings.append(make_finding(
+                "PTL501",
+                f"{dotted}() in an instrumented subsystem bypasses "
+                "observability.metrics (use a registry histogram's "
+                ".time()/.observe() or events.span())",
+                file=self.filename, line=node.lineno,
+                col=node.col_offset))
+        self.generic_visit(node)
+
+
+def is_timing_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in TIMING_GLOBS)
+
+
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> None (bare noqa: suppress all) | set of codes."""
     out: Dict[int, Optional[Set[str]]] = {}
@@ -529,6 +568,10 @@ def lint_source(source: str, filename: str = "<string>",
         hygiene = _ExceptionHygiene(filename)
         hygiene.visit(tree)
         findings.extend(hygiene.findings)
+    if is_timing_path(filename):
+        timing = _TimingHygiene(filename)
+        timing.visit(tree)
+        findings.extend(timing.findings)
     noqa = _collect_noqa(source)
     out = []
     for f in findings:

@@ -1,0 +1,135 @@
+"""PTL502 — event-schema drift checker for paddle_tpu.observability.
+
+Downstream tools parse the JSONL event log by the documented schema
+(``observability.events.EVENT_SCHEMA`` + docs/observability_events.md).
+This pass holds the three surfaces together:
+
+1. every ``events.emit("<kind>", field=...)`` / ``events.span("<kind>",
+   ...)`` call site in the package uses a documented kind and only
+   documented fields for it;
+2. every documented kind is actually emitted somewhere (a schema row
+   nothing produces is dead documentation);
+3. the schema doc file names every kind (so a new emitter cannot ship
+   without its parse contract).
+
+AST-based and stdlib-only — importable without jax, wired into
+``tools/run_analysis.py --metrics-schema`` and ``pytest -m lint``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .rules import Finding, make_finding
+
+# call shapes that count as event emission: events.emit(...),
+# obs_events.emit(...), _events.emit(...), _obs_events.emit(...), and
+# events.span(...).  Bare emit(...)/span(...) only count inside the
+# observability package itself — other modules legitimately define
+# unrelated local helpers with those names (analysis.registry_check's
+# finding emitter, for one)
+_EMIT_LEAVES = {"emit", "span"}
+_EMIT_BASES = {"events", "obs_events", "_events", "_obs_events"}
+
+SCHEMA_DOC = os.path.join("docs", "observability_events.md")
+
+
+def _emit_sites(tree: ast.AST, allow_bare: bool
+                ) -> List[Tuple[str, List[Optional[str]], int, int]]:
+    """(kind, keyword_names, line, col) for every literal-kind emit/span
+    call.  Non-literal kinds are skipped (none exist in-tree; the gate
+    test keeps it that way implicitly via coverage of the schema)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            leaf = f.attr
+            base = f.value.id if isinstance(f.value, ast.Name) else ""
+            if leaf not in _EMIT_LEAVES or base not in _EMIT_BASES:
+                continue
+        elif isinstance(f, ast.Name) and allow_bare:
+            if f.id not in _EMIT_LEAVES:
+                continue
+        else:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        kws = [kw.arg for kw in node.keywords]
+        out.append((node.args[0].value, kws, node.lineno,
+                    node.col_offset))
+    return out
+
+
+def check_event_schema(repo_root: Optional[str] = None
+                       ) -> List[Finding]:
+    """Run the three-way schema consistency check; returns findings."""
+    from ..observability.events import ENVELOPE_FIELDS, EVENT_SCHEMA
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    pkg = os.path.join(repo_root, "paddle_tpu")
+    findings: List[Finding] = []
+    emitted_kinds: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue
+            rel = os.path.relpath(path, repo_root)
+            in_obs = os.sep + "observability" + os.sep in path
+            for kind, kws, line, col in _emit_sites(tree, in_obs):
+                emitted_kinds.add(kind)
+                fields = EVENT_SCHEMA.get(kind)
+                if fields is None:
+                    findings.append(make_finding(
+                        "PTL502",
+                        f"emit of undocumented event kind {kind!r} "
+                        "(add it to observability.events.EVENT_SCHEMA "
+                        f"and {SCHEMA_DOC})",
+                        file=rel, line=line, col=col))
+                    continue
+                for kw in kws:
+                    if kw is None:       # **kwargs forwarding site
+                        continue
+                    if kw not in fields and kw not in ENVELOPE_FIELDS:
+                        findings.append(make_finding(
+                            "PTL502",
+                            f"event kind {kind!r} emitted with "
+                            f"undocumented field {kw!r}",
+                            file=rel, line=line, col=col))
+    for kind in sorted(set(EVENT_SCHEMA) - emitted_kinds):
+        findings.append(make_finding(
+            "PTL502",
+            f"documented event kind {kind!r} has no emit site in the "
+            "package (dead schema row, or an emitter renamed away "
+            "from it)",
+            file=os.path.join("paddle_tpu", "observability",
+                              "events.py")))
+    doc_path = os.path.join(repo_root, SCHEMA_DOC)
+    try:
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError:
+        findings.append(make_finding(
+            "PTL502", f"schema doc {SCHEMA_DOC} is missing",
+            file=SCHEMA_DOC))
+        return findings
+    for kind in sorted(EVENT_SCHEMA):
+        if f"`{kind}`" not in doc:
+            findings.append(make_finding(
+                "PTL502",
+                f"event kind {kind!r} is not documented in "
+                f"{SCHEMA_DOC}",
+                file=SCHEMA_DOC))
+    return findings

@@ -17,6 +17,9 @@ Code space:
           emitted by tools/run_analysis.py)
   PTL4xx  resilience hygiene rules (exception handling in
           resilience-critical subsystems, see lint.py)
+  PTL5xx  observability hygiene rules (raw-timing bypasses in
+          instrumented subsystems, event-schema drift; see lint.py and
+          obs_check.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -272,6 +275,29 @@ _rule(
     "Narrow the exception type, or add a re-raise / warnings.warn / "
     "logging call; a deliberate broad catch takes '# noqa: PTL401' "
     "with a reason comment.")
+_rule(
+    "PTL501", "raw-timing-bypass", ERROR,
+    "direct time.time()/time.perf_counter() in an instrumented "
+    "subsystem (tuning/, resilience/, inference/)",
+    "These subsystems report timings operators act on; a raw wall-clock "
+    "delta bypasses paddle_tpu.observability.metrics, so the number "
+    "never reaches the registry, the /metrics surface, or the event "
+    "log — ad-hoc counters are exactly what the observability layer "
+    "replaced.  Deadlines and backoffs belong on time.monotonic (not "
+    "flagged).",
+    "Route the measurement through observability.metrics (histogram "
+    ".time() / .observe()) or events.span(); a deliberate raw read "
+    "takes '# noqa: PTL501' with a reason comment.")
+_rule(
+    "PTL502", "event-schema-drift", ERROR,
+    "events.emit()/span() call site disagrees with the documented "
+    "EVENT_SCHEMA",
+    "Downstream tools parse the JSONL event log by the documented "
+    "schema (docs/observability_events.md); an emitter inventing kinds "
+    "or fields ships records nothing can consume, and drift is "
+    "invisible until a dashboard breaks.",
+    "Add the kind/field to observability.events.EVENT_SCHEMA and the "
+    "schema doc, or fix the call site.")
 _rule(
     "PTL301", "cost-model-sanity", ERROR,
     "tuning cost model violates a physical invariant",

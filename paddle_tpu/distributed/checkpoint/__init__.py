@@ -56,6 +56,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...observability import events as obs_events
+from ...observability import metrics as obs_metrics
 from ...resilience.faults import maybe_fault
 from ...resilience.retry import with_retries
 
@@ -74,6 +76,16 @@ _ASYNC_SAVES: Dict[str, Dict[str, Any]] = {}
 # manifest metadata, versions skipped) — the resilient driver reads the
 # resume step from here
 _LAST_LOAD: Optional[Dict[str, Any]] = None
+
+
+def _ckpt_hist(op: str):
+    """Shared latency histogram for checkpoint I/O (save/commit/restore),
+    one family across every checkpoint dir in the process."""
+    from ...observability.metrics import TIME_BUCKETS
+    return obs_metrics.histogram(
+        "paddle_checkpoint_seconds",
+        "checkpoint I/O wall time by operation",
+        labels=("op",), buckets=TIME_BUCKETS).labels(op=op)
 
 
 class AsyncSaveError(RuntimeError):
@@ -217,8 +229,11 @@ def _write_commit(version_dir: str, digests: Dict[str, Any],
             if os.path.exists(tmp):
                 os.unlink(tmp)
 
-    with_retries(_write, attempts=3, retry_on=(OSError,),
-                 label="ckpt_commit")
+    with _ckpt_hist("commit").time() as t:
+        with_retries(_write, attempts=3, retry_on=(OSError,),
+                     label="ckpt_commit")
+    obs_events.emit("ckpt_commit", dur_s=round(t.seconds, 6),
+                    path=version_dir)
 
 
 def read_commit(version_dir: str) -> Optional[Dict[str, Any]]:
@@ -395,13 +410,21 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         # a save-every-epoch loop can't accumulate checkpointer threads
         while len(_ASYNC_SAVES) >= 4:
             wait_async_save(next(iter(_ASYNC_SAVES)))
-        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        ckptr.save(dest, arrays, force=True)
+        with _ckpt_hist("save").time() as t:
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            ckptr.save(dest, arrays, force=True)
         _ASYNC_SAVES[dest] = {
             "ckptr": ckptr, "digests": digests, "meta": metadata,
             "keep_last_k": keep_last_k,
             "base": base if unique_id is not None else None,
         }
+        # dur_s here is the enqueue cost; the durable commit is the
+        # ckpt_commit event at the join
+        obs_events.emit("ckpt_save", dur_s=round(t.seconds, 6),
+                        path=dest,
+                        version=str(unique_id)
+                        if unique_id is not None else None,
+                        async_save=True, arrays=len(digests))
         # the torn window: the background save may still be in flight
         # and _COMMIT only lands at the join
         maybe_fault("ckpt_write", path=dest)
@@ -410,8 +433,15 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             ckptr = ocp.PyTreeCheckpointer()
             ckptr.save(dest, arrays, force=True)
 
-        with_retries(_save, attempts=2, retry_on=(OSError, TimeoutError),
-                     label="ckpt_save")
+        with _ckpt_hist("save").time() as t:
+            with_retries(_save, attempts=2,
+                         retry_on=(OSError, TimeoutError),
+                         label="ckpt_save")
+        obs_events.emit("ckpt_save", dur_s=round(t.seconds, 6),
+                        path=dest,
+                        version=str(unique_id)
+                        if unique_id is not None else None,
+                        async_save=False, arrays=len(digests))
         # the torn window: data is on disk, _COMMIT is not — a crash or
         # injected damage here is exactly what load must survive
         maybe_fault("ckpt_write", path=dest)
@@ -519,23 +549,31 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     #                    load silently read the previous version
     base = os.path.abspath(path)
     skipped: List[str] = []
-    if unique_id is not None:
-        src = _versioned_path(path, unique_id)
-        manifest = read_commit(src)
-        restored = _orbax_restore(src)
-        if manifest is not None and verify:
-            bad = _digest_mismatches(restored, manifest)
-            if bad:
-                raise ValueError(
-                    f"checkpoint version {src!r} failed digest "
-                    f"verification for: {', '.join(sorted(bad))}")
-        elif manifest is None:
-            warnings.warn(
-                f"loading explicitly-requested checkpoint {src!r} with "
-                f"no {COMMIT_FILE} manifest (pre-commit-marker save, or "
-                "torn)", stacklevel=2)
-    else:
-        src, manifest, restored, skipped = _select_and_restore(base, verify)
+    with _ckpt_hist("restore").time() as _t:
+        if unique_id is not None:
+            src = _versioned_path(path, unique_id)
+            manifest = read_commit(src)
+            restored = _orbax_restore(src)
+            if manifest is not None and verify:
+                bad = _digest_mismatches(restored, manifest)
+                if bad:
+                    raise ValueError(
+                        f"checkpoint version {src!r} failed digest "
+                        f"verification for: {', '.join(sorted(bad))}")
+            elif manifest is None:
+                warnings.warn(
+                    f"loading explicitly-requested checkpoint {src!r} "
+                    f"with no {COMMIT_FILE} manifest (pre-commit-marker "
+                    "save, or torn)", stacklevel=2)
+        else:
+            src, manifest, restored, skipped = \
+                _select_and_restore(base, verify)
+    obs_events.emit("ckpt_restore", dur_s=round(_t.seconds, 6),
+                    path=base,
+                    version=os.path.basename(src) if src != base
+                    else None,
+                    committed=manifest is not None,
+                    skipped=len(skipped))
     _LAST_LOAD = {
         "source": src,
         "version": os.path.basename(src) if src != base else None,

@@ -233,6 +233,22 @@ define_flag("fault_schedule", "",
             "exit, stall, exc, truncate, corrupt.  Empty: disabled. "
             "See paddle_tpu.resilience.faults",
             on_change=_apply_fault_schedule)
+def _apply_observability_dir(path: str):
+    """One flag, every telemetry stream (paddle_tpu.observability):
+    the JSONL event log (step/compile/checkpoint/fault/restart/tuning/
+    dispatch records) lands under ``path``; empty disables it and every
+    emit site degrades to a single is-None check.  The metrics registry
+    is always live — this flag only gates the on-disk event stream."""
+    from .observability import events
+    events.configure(path or None)
+
+
+define_flag("observability_dir", "",
+            "directory for the structured run-telemetry event log "
+            "(events.jsonl; see paddle_tpu.observability and "
+            "`python -m paddle_tpu.observability report`); "
+            "empty: disabled",
+            on_change=_apply_observability_dir)
 define_flag("pallas_autotune_topk", 4,
             "measured autotune times only the cost model's top-K block "
             "candidates (0: time every valid candidate)")

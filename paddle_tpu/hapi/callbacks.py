@@ -12,7 +12,7 @@ import numpy as np
 from .progressbar import ProgressBar
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "VisualDL", "WandbCallback",
+           "VisualDL", "WandbCallback", "ObservabilityCallback",
            "LRScheduler"]
 
 
@@ -77,12 +77,16 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
                      epochs=None, steps=None, log_freq=2, verbose=2,
                      save_freq=1, save_dir=None, metrics=None,
                      mode="train"):
-    """ref: callbacks.config_callbacks — default ProgBar + ModelCheckpoint."""
+    """ref: callbacks.config_callbacks — default ProgBar + ModelCheckpoint
+    (+ the observability step-telemetry hook, a no-op unless
+    ``FLAGS_observability_dir`` is set)."""
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks):
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks = cbks + [LRScheduler()]
+    if not any(isinstance(c, ObservabilityCallback) for c in cbks):
+        cbks = cbks + [ObservabilityCallback(batch_size=batch_size)]
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
     cbk_list = CallbackList(cbks)
@@ -148,6 +152,58 @@ class ProgBarLogger(Callback):
         if self.verbose:
             self._updates(logs, self.eval_progbar, self.eval_step)
             print("Eval samples: ", (logs or {}).get("samples", ""))
+
+
+class ObservabilityCallback(Callback):
+    """Step-telemetry hook (paddle_tpu.observability): every train loop
+    built on hapi callbacks emits ``step`` event records — step id,
+    loss, step time, examples/sec — with NO model-code changes.
+    ``config_callbacks`` installs it by default; when
+    ``FLAGS_observability_dir`` is unset each hook is a single
+    enabled-check.
+    """
+
+    def __init__(self, batch_size=None):
+        super().__init__()
+        self.batch_size = batch_size
+        self.global_step = 0
+        self._epoch = 0
+        self._t_last = None
+
+    def on_train_begin(self, logs=None):
+        self._t_last = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..observability import events, metrics
+        if not events.enabled():
+            return
+        import time
+        now = time.perf_counter()
+        dt = (now - self._t_last) if self._t_last is not None else None
+        self._t_last = now
+        loss = (logs or {}).get("loss")
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        if loss is not None and not isinstance(loss, numbers.Number):
+            try:
+                loss = float(np.asarray(loss).reshape(-1)[0])
+            except Exception:
+                loss = None
+        if dt is not None:
+            metrics.histogram(
+                "paddle_train_step_seconds",
+                "wall time between consecutive end_step calls",
+                buckets=metrics.TIME_BUCKETS).observe(dt)
+        events.emit(
+            "step", step=self.global_step, epoch=self._epoch,
+            loss=float(loss) if loss is not None else None,
+            step_time_s=round(dt, 6) if dt is not None else None,
+            examples_per_sec=round(self.batch_size / dt, 3)
+            if (self.batch_size and dt) else None)
+        self.global_step += 1
 
 
 class ModelCheckpoint(Callback):

@@ -10,7 +10,17 @@ The wire format is npz both ways (dense arrays, zero deps):
 - ``POST /predict`` — body: ``np.savez`` of named inputs (or positional
   ``input_0..``); response: npz of ``output_i`` arrays.
 - ``GET /health`` — JSON with the model's input names and serving
-  counters (served / in_flight / rejected / errors).
+  counters (served / in_flight / rejected / errors / bad_requests).
+- ``GET /metrics`` — Prometheus text exposition of the process metrics
+  registry (request counts by outcome, request-latency histogram,
+  in-flight and queue-depth gauges — plus whatever every other
+  subsystem registered).
+
+The serving counters live in ``paddle_tpu.observability.metrics`` (one
+labelled child set per server instance): handler threads increment
+atomic registry counters instead of the plain ints they used to race
+on, so ``served + rejected + errors + bad_requests`` always equals the
+number of requests received.
 
 Failure taxonomy (the resilience contract):
 
@@ -38,6 +48,7 @@ contract).
 from __future__ import annotations
 
 import io
+import itertools
 import json
 import threading
 import time
@@ -47,9 +58,32 @@ from typing import Optional, Sequence
 import numpy as np
 
 from . import Config, Predictor, create_predictor
+from ..observability import metrics as _metrics
+from ..observability import events as _events
 from ..resilience.retry import with_retries
 
 __all__ = ["InferenceServer", "serve", "predict_http"]
+
+# one family set for every server in the process; children are labelled
+# per server instance so /health stays instance-scoped while GET
+# /metrics exposes the whole process
+_REQUESTS = _metrics.counter(
+    "paddle_serving_requests_total",
+    "requests by outcome (served/rejected/error/bad_request)",
+    labels=("server", "outcome"))
+_LATENCY = _metrics.histogram(
+    "paddle_serving_request_latency_seconds",
+    "wall time of completed /predict requests (parse+queue+predict)",
+    labels=("server",), buckets=_metrics.TIME_BUCKETS)
+_IN_FLIGHT = _metrics.gauge(
+    "paddle_serving_in_flight", "admitted requests currently executing",
+    labels=("server",))
+_QUEUE_DEPTH = _metrics.gauge(
+    "paddle_serving_queue_depth",
+    "admitted requests waiting on the predictor lock",
+    labels=("server",))
+
+_SERVER_SEQ = itertools.count(1)
 
 
 class InferenceServer:
@@ -65,9 +99,18 @@ class InferenceServer:
         self._state = threading.Condition()    # in-flight accounting
         self._in_flight = 0
         self._closing = False
-        self._served = 0
-        self._rejected = 0
-        self._errors = 0
+        # registry-backed serving counters (atomic under concurrent
+        # handler threads — the old plain-int "_errors += 1" raced)
+        sid = str(next(_SERVER_SEQ))
+        self.server_id = sid
+        self._c_served = _REQUESTS.labels(server=sid, outcome="served")
+        self._c_rejected = _REQUESTS.labels(server=sid,
+                                            outcome="rejected")
+        self._c_errors = _REQUESTS.labels(server=sid, outcome="error")
+        self._c_bad = _REQUESTS.labels(server=sid, outcome="bad_request")
+        self._h_latency = _LATENCY.labels(server=sid)
+        self._g_in_flight = _IN_FLIGHT.labels(server=sid)
+        self._g_queue = _QUEUE_DEPTH.labels(server=sid)
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -85,15 +128,22 @@ class InferenceServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    body = _metrics.default_registry() \
+                        .prometheus_text().encode()
+                    self._reply(200, body,
+                                "text/plain; version=0.0.4")
+                    return
                 if self.path != "/health":
                     self._reply(404, b'{"error": "unknown path"}')
                     return
                 info = {"status": "ok",
                         "inputs": outer.predictor.get_input_names(),
-                        "served": outer._served,
+                        "served": outer.served,
                         "in_flight": outer._in_flight,
-                        "rejected": outer._rejected,
-                        "errors": outer._errors}
+                        "rejected": outer.rejected,
+                        "errors": outer.errors,
+                        "bad_requests": outer.bad_requests}
                 self._reply(200, json.dumps(info).encode())
 
             def do_POST(self):
@@ -109,57 +159,91 @@ class InferenceServer:
                     ).encode(), extra_headers=(("Retry-After", "1"),))
                     return
                 try:
-                    # ---- parse phase: failures are the CLIENT's -> 400
-                    try:
-                        n = int(self.headers.get("Content-Length", "0"))
-                        payload = np.load(io.BytesIO(self.rfile.read(n)),
-                                          allow_pickle=False)
-                        names = outer.predictor.get_input_names()
-                        inputs = [payload[k] if k in payload.files
-                                  else payload[payload.files[i]]
-                                  for i, k in enumerate(names)]
-                    except Exception as e:  # noqa: PTL401, BLE001 —
-                        # answered to the client as HTTP 400; a bad
-                        # request must not kill the server thread
-                        self._reply(400, json.dumps(
-                            {"error": f"{type(e).__name__}: {e}"}).encode())
-                        return
-                    # ---- predict phase: failures are OURS -> 500
-                    try:
-                        with outer._lock:
-                            outs = outer.predictor.run(inputs)
-                            outer._served += 1
-                    except Exception as e:  # noqa: PTL401, BLE001 —
-                        # reported to the client as HTTP 500 (and
-                        # counted); the serving loop must survive one
-                        # bad batch
-                        outer._errors += 1
-                        self._reply(500, json.dumps(
-                            {"error": f"{type(e).__name__}: {e}"}).encode())
-                        return
-                    buf = io.BytesIO()
-                    np.savez(buf, **{f"output_{i}": o
-                                     for i, o in enumerate(outs)})
-                    self._reply(200, buf.getvalue(),
-                                "application/octet-stream")
+                    # one latency observation per ADMITTED request,
+                    # whatever its outcome (400/500/200 all cost the
+                    # client this wall time)
+                    with outer._h_latency.time():
+                        self._do_predict()
                 finally:
                     outer._release()
 
+            def _do_predict(self):
+                # ---- parse phase: failures are the CLIENT's -> 400
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = np.load(io.BytesIO(self.rfile.read(n)),
+                                      allow_pickle=False)
+                    names = outer.predictor.get_input_names()
+                    inputs = [payload[k] if k in payload.files
+                              else payload[payload.files[i]]
+                              for i, k in enumerate(names)]
+                except Exception as e:  # noqa: PTL401, BLE001 —
+                    # answered to the client as HTTP 400; a bad
+                    # request must not kill the server thread
+                    outer._c_bad.inc()
+                    self._reply(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
+                # ---- predict phase: failures are OURS -> 500
+                try:
+                    outer._g_queue.inc()   # waiting on the predictor
+                    try:
+                        outer._lock.acquire()
+                    finally:
+                        outer._g_queue.dec()
+                    try:
+                        outs = outer.predictor.run(inputs)
+                        outer._c_served.inc()
+                    finally:
+                        outer._lock.release()
+                except Exception as e:  # noqa: PTL401, BLE001 —
+                    # reported to the client as HTTP 500 (and
+                    # counted); the serving loop must survive one
+                    # bad batch
+                    outer._c_errors.inc()
+                    self._reply(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
+                buf = io.BytesIO()
+                np.savez(buf, **{f"output_{i}": o
+                                 for i, o in enumerate(outs)})
+                self._reply(200, buf.getvalue(),
+                            "application/octet-stream")
+
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._thread: Optional[threading.Thread] = None
+
+    # -- registry-backed counter views ----------------------------------
+    @property
+    def served(self) -> int:
+        return int(self._c_served.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._c_rejected.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._c_errors.value)
+
+    @property
+    def bad_requests(self) -> int:
+        return int(self._c_bad.value)
 
     # -- in-flight accounting -------------------------------------------
     def _admit(self) -> bool:
         with self._state:
             if self._closing or self._in_flight >= self.max_in_flight:
-                self._rejected += 1
+                self._c_rejected.inc()
                 return False
             self._in_flight += 1
+            self._g_in_flight.set(self._in_flight)
             return True
 
     def _release(self):
         with self._state:
             self._in_flight -= 1
+            self._g_in_flight.set(self._in_flight)
             self._state.notify_all()
 
     @property
@@ -178,6 +262,7 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        _events.emit("serving", action="start", url=self.url)
         return self
 
     def stop(self, drain_timeout: float = 10.0):
@@ -202,6 +287,7 @@ class InferenceServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        _events.emit("serving", action="stop", url=self.url)
 
     def __enter__(self):
         return self.start()

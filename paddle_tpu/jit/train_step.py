@@ -235,6 +235,7 @@ class TrainStep:
                len(state["o"]["master"]),
                tuple(tuple(a.shape) for a in batch_arrays))
         fn = self._jit_cache.get(key)
+        jit_miss = fn is None
         if fn is None:
             # resilience fault point: a jit-cache miss is where a
             # scheduled compile-time crash/stall/exception fires (the
@@ -269,7 +270,26 @@ class TrainStep:
             _sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
             self._cost_args = (jax.tree.map(_sds, state), _sds(lr),
                                jax.tree.map(_sds, batch_arrays))
-        new_state, loss = fn(state, lr, batch_arrays)
+        if jit_miss:
+            # observability: a jit miss pays trace+XLA-compile inside
+            # this first call — record it as a `compile` event so the
+            # log explains the step-time spike (jax.monitoring adds the
+            # backend_compile breakdown when available).  Steady-state
+            # calls skip this block entirely.
+            from ..observability import events as _obs_events
+            if _obs_events.enabled():
+                import time as _time
+                _t0 = _time.perf_counter()
+                new_state, loss = fn(state, lr, batch_arrays)
+                _obs_events.emit(
+                    "compile", source="train_step",
+                    dur_s=round(_time.perf_counter() - _t0, 6),
+                    key=f"acc={sorted(state['o']['acc'])} "
+                        f"batch={[tuple(a.shape) for a in batch_arrays]}")
+            else:
+                new_state, loss = fn(state, lr, batch_arrays)
+        else:
+            new_state, loss = fn(state, lr, batch_arrays)
         # swap updated arrays back into the live objects
         for p, v in zip(self.params, new_state["p"]):
             p._data = v

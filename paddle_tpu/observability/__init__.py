@@ -1,0 +1,29 @@
+"""paddle_tpu.observability — unified metrics + structured run telemetry.
+
+Two surfaces, one flag:
+
+* :mod:`~paddle_tpu.observability.metrics` — the process-wide metrics
+  registry (counters / gauges / fixed-bucket histograms; thread-safe,
+  labelled) with Prometheus-text and JSON snapshot exporters.  Always
+  live (a locked add is cheap); ``metrics.set_enabled(False)`` is the
+  kill switch.
+* :mod:`~paddle_tpu.observability.events` — the append-only JSONL event
+  log (step / compile / checkpoint / fault / restart / tuning /
+  dispatch-summary records), enabled by ``FLAGS_observability_dir``.
+
+CLI: ``python -m paddle_tpu.observability {snapshot,tail,report}``.
+
+Import-time is stdlib-only: ``flags.py`` reaches this package during
+env ingestion at bootstrap.
+"""
+from . import metrics  # noqa: F401
+from . import events   # noqa: F401
+from .metrics import (counter, gauge, histogram, default_registry,  # noqa: F401
+                      HistogramValue, MetricsRegistry)
+from .events import (emit, span, read_events, emit_dispatch_summary,  # noqa: F401
+                     EVENT_SCHEMA)
+
+__all__ = ["metrics", "events", "counter", "gauge", "histogram",
+           "default_registry", "HistogramValue", "MetricsRegistry",
+           "emit", "span", "read_events", "emit_dispatch_summary",
+           "EVENT_SCHEMA"]

@@ -1,0 +1,436 @@
+"""Structured run telemetry — append-only JSONL event log.
+
+One flag (``FLAGS_observability_dir``) turns every subsystem's telemetry
+on: training step records, XLA compile events (``jax.monitoring`` +
+``TrainStep`` jit-miss hooks), op-dispatch summaries (via
+``core.dispatch.observe_op_stream``), checkpoint save/restore/commit
+latencies, fault-injection firings, elastic restarts, and tuning-cache
+hit/miss/fit events all land in ``<dir>/events.jsonl`` as independent
+JSON lines:
+
+    {"v": 1, "ts": <unix>, "pid": <pid>, "run": "<run-id>",
+     "kind": "<kind>", ...kind fields...}
+
+Failure model mirrors ``tuning/cache.py``: writes are line-atomic
+appends under a process lock; readers (:func:`read_events`) tolerate a
+corrupt tail — a crash mid-line costs that line, never the log.  Files
+rotate at ``rotate_bytes`` into ``events-<k>.jsonl`` (bounded count),
+so a long chaos run cannot fill the disk.
+
+Correlation with the profiler: :func:`span` wraps the block in a
+``profiler.RecordEvent`` named ``obs:<kind>#<span_id>`` and stamps the
+same ``span_id`` into the JSONL record, so an event row can be matched
+to its exact span inside the chrome-trace timeline.
+
+When the flag is unset every entry point is one ``is None`` check —
+the <2% bench-overhead contract.  Import-time is stdlib-only: this
+module is reachable from ``flags.py`` env ingestion during package
+bootstrap, so the jax.monitoring listener and the dispatch hook are
+installed lazily on the first emit after the package is importable.
+
+The documented schema (``EVENT_SCHEMA``) is load-bearing: downstream
+tools parse the JSONL by it, and ``tools/run_analysis.py
+--metrics-schema`` validates every ``emit()`` call site in the package
+against it (PTL502).  See docs/observability_events.md.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+__all__ = ["configure", "enabled", "emit", "span", "EventLog",
+           "read_events", "emit_dispatch_summary", "dispatch_counts",
+           "EVENT_SCHEMA", "ENVELOPE_FIELDS", "log_dir"]
+
+SCHEMA_VERSION = 1
+
+# Envelope stamped on every record by the writer (span_id/dur_s are
+# added by :class:`span` regardless of kind).
+ENVELOPE_FIELDS: Dict[str, str] = {
+    "v": "int", "ts": "float", "pid": "int", "run": "str", "kind": "str",
+    "span_id": "int", "dur_s": "float",
+}
+
+# kind -> {field: type}.  Every field an emitter may pass; emitters may
+# omit fields (None values are dropped) but may not invent new ones —
+# the PTL502 schema gate holds call sites to this table.
+EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
+    # one training step completed (TrainerCallback / ResilientTrainLoop)
+    "step": {"step": "int", "epoch": "int", "loss": "float",
+             "step_time_s": "float", "examples_per_sec": "float",
+             "grad_norm": "float", "lr": "float"},
+    # a jit-cache miss paid trace+compile (TrainStep) or a backend
+    # compile measured by jax.monitoring
+    "compile": {"source": "str", "event": "str", "dur_s": "float",
+                "key": "str"},
+    # checkpoint lifecycle (distributed.checkpoint)
+    "ckpt_save": {"dur_s": "float", "path": "str", "version": "str",
+                  "async_save": "bool", "arrays": "int"},
+    "ckpt_commit": {"dur_s": "float", "path": "str"},
+    "ckpt_restore": {"dur_s": "float", "path": "str", "version": "str",
+                     "committed": "bool", "skipped": "int"},
+    # a scheduled fault fired (resilience.faults)
+    "fault": {"point": "str", "occurrence": "int", "fault_kind": "str",
+              "arg": "str"},
+    # the supervisor relaunched (or gave up on) a worker
+    "elastic_restart": {"reason": "str", "restarts": "int", "code": "int"},
+    "preempt": {"grace_s": "float"},
+    # tuning-cache traffic + cost-model refits (paddle_tpu.tuning)
+    "tuning_cache": {"cache_kind": "str", "event": "str"},
+    "tuning_fit": {"samples": "int", "alphas": "object"},
+    # aggregated op-dispatch + host-transfer counts since the last
+    # summary
+    "dispatch_summary": {"ops": "object", "total": "int",
+                         "host_transfers": "int", "window_s": "float"},
+    # inference server lifecycle (per-request traffic lives in metrics)
+    "serving": {"action": "str", "url": "str"},
+}
+
+_lock = threading.Lock()
+_LOG: Optional["EventLog"] = None
+_PENDING_DIR: Optional[str] = None
+_HOOKS_READY = False
+_DISPATCH_COUNTS: Dict[str, int] = {}
+_HOST_TRANSFERS = {"n": 0}
+_DISPATCH_T0: Optional[float] = None
+_DISPATCH_CM = None
+_PREV_HOST_HOOK = None
+_HOST_HOOK = None
+_MONITORING_ON = False
+_SPAN_IDS = itertools.count(1)
+
+
+class EventLog:
+    """Append-only JSONL writer with size-based rotation."""
+
+    def __init__(self, directory: str, rotate_bytes: int = 32 << 20,
+                 keep_rotated: int = 4):
+        self.directory = os.path.abspath(directory)
+        self.rotate_bytes = int(rotate_bytes)
+        self.keep_rotated = int(keep_rotated)
+        self.path = os.path.join(self.directory, "events.jsonl")
+        self._lock = threading.Lock()
+        self.run_id = os.environ.get("PADDLE_OBS_RUN_ID") or \
+            f"{os.getpid()}-{int(time.time() * 1000)}"
+        self.dropped_writes = 0
+
+    # -- rotation ---------------------------------------------------------
+    def _rotated_name(self, k: int) -> str:
+        return os.path.join(self.directory, f"events-{k}.jsonl")
+
+    def _maybe_rotate_locked(self) -> None:
+        try:
+            if os.path.getsize(self.path) < self.rotate_bytes:
+                return
+        except OSError:
+            return
+        # shift events-(k) -> events-(k+1), dropping the oldest
+        for k in range(self.keep_rotated - 1, 0, -1):
+            src, dst = self._rotated_name(k), self._rotated_name(k + 1)
+            if os.path.exists(src):
+                if k + 1 > self.keep_rotated - 1:
+                    try:
+                        os.unlink(src)
+                    except OSError:
+                        pass
+                else:
+                    os.replace(src, dst)
+        try:
+            os.replace(self.path, self._rotated_name(1))
+        except OSError:
+            pass
+
+    # -- writing ----------------------------------------------------------
+    def write(self, kind: str, fields: Dict[str, Any]) -> None:
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "pid": os.getpid(),
+               "run": self.run_id, "kind": kind}
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        line = json.dumps(rec, sort_keys=True, default=str) + "\n"
+        with self._lock:
+            try:
+                os.makedirs(self.directory, exist_ok=True)
+                self._maybe_rotate_locked()
+                with open(self.path, "a", encoding="utf-8") as fh:
+                    fh.write(line)
+            except OSError:
+                # telemetry must never take the training run down; the
+                # drop is visible in the counter below
+                self.dropped_writes += 1
+
+    def files_oldest_first(self) -> List[str]:
+        out = [self._rotated_name(k)
+               for k in range(self.keep_rotated, 0, -1)
+               if os.path.exists(self._rotated_name(k))]
+        if os.path.exists(self.path):
+            out.append(self.path)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module-level surface (what the flag + every subsystem use)
+# ---------------------------------------------------------------------------
+
+def configure(directory: Optional[str],
+              rotate_bytes: Optional[int] = None) -> None:
+    """(Re)target the process event log; None/'' disables it.  Called by
+    the ``FLAGS_observability_dir`` on_change hook, so env ingestion at
+    import wires worker processes automatically."""
+    global _LOG, _PENDING_DIR
+    with _lock:
+        if not directory:
+            _uninstall_hooks_locked()
+            _LOG = None
+            _PENDING_DIR = None
+            return
+        kw = {} if rotate_bytes is None else \
+            {"rotate_bytes": int(rotate_bytes)}
+        _LOG = EventLog(directory, **kw)
+        _PENDING_DIR = directory
+    # hook install imports the framework — during package bootstrap
+    # (env-ingested flag) that import cycle isn't ready yet, so defer
+    # to the first emit
+    _ensure_hooks()
+
+
+def enabled() -> bool:
+    return _LOG is not None
+
+
+def log_dir() -> Optional[str]:
+    return _LOG.directory if _LOG is not None else None
+
+
+def emit(kind: str, **fields: Any) -> None:
+    """Append one event record; a no-op (one check) when disabled."""
+    log = _LOG
+    if log is None:
+        return
+    _ensure_hooks()
+    log.write(kind, fields)
+
+
+class span:
+    """Context manager: time a block, stamp the duration AND a profiler
+    ``RecordEvent`` correlation id into the emitted record.
+
+    ::
+
+        with events.span("ckpt_save", path=dest) as sp:
+            ...                       # shows as obs:ckpt_save#<id> in
+                                      # the chrome trace
+    """
+
+    def __init__(self, kind: str, **fields: Any):
+        self.kind = kind
+        self.fields = fields
+        self.span_id: Optional[int] = None
+        self._t0 = 0.0
+        self._rec = None
+
+    def __enter__(self) -> "span":
+        if _LOG is None:
+            return self
+        self.span_id = next(_SPAN_IDS)
+        try:
+            from ..profiler.profiler import RecordEvent
+            self._rec = RecordEvent(f"obs:{self.kind}#{self.span_id}")
+            self._rec.begin()
+        except Exception:
+            self._rec = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self.span_id is not None:
+            dur = time.perf_counter() - self._t0
+            if self._rec is not None:
+                try:
+                    self._rec.end()
+                except Exception:
+                    pass
+            emit(self.kind, span_id=self.span_id,
+                 dur_s=round(dur, 6), **self.fields)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# reading (corrupt-tail tolerant)
+# ---------------------------------------------------------------------------
+
+def read_events(path: str, kinds: Optional[List[str]] = None
+                ) -> List[Dict[str, Any]]:
+    """Parse a JSONL event file or an observability dir (rotated files
+    merged oldest-first).  Unparsable lines — the torn tail of a
+    crashed writer, bit rot — are skipped, never raised."""
+    files: List[str]
+    if os.path.isdir(path):
+        names = sorted(f for f in os.listdir(path)
+                       if f.startswith("events") and f.endswith(".jsonl"))
+        # events-<k>.jsonl rotate upward: higher k is OLDER
+        rotated = sorted((f for f in names if f != "events.jsonl"),
+                         key=lambda f: -_rot_index(f))
+        files = [os.path.join(path, f) for f in rotated]
+        if "events.jsonl" in names:
+            files.append(os.path.join(path, "events.jsonl"))
+    else:
+        files = [path]
+    out: List[Dict[str, Any]] = []
+    for fp in files:
+        try:
+            with open(fp, "r", encoding="utf-8", errors="replace") as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "kind" not in rec:
+                continue
+            if kinds is None or rec["kind"] in kinds:
+                out.append(rec)
+    return out
+
+
+def _rot_index(name: str) -> int:
+    try:
+        return int(name[len("events-"):-len(".jsonl")])
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# framework hooks: op-dispatch counting + jax.monitoring compile events
+# ---------------------------------------------------------------------------
+
+def _ensure_hooks() -> None:
+    """Install the dispatch-count hook and the jax.monitoring compile
+    listener once the package is importable (never during bootstrap)."""
+    global _HOOKS_READY, _DISPATCH_CM, _DISPATCH_T0, _MONITORING_ON, \
+        _PREV_HOST_HOOK, _HOST_HOOK
+    if _HOOKS_READY or _LOG is None:
+        return
+    with _lock:
+        if _HOOKS_READY or _LOG is None:
+            return
+        try:
+            from ..core import tensor as tensor_mod
+            from ..core.dispatch import observe_op_stream
+        except Exception:  # ImportError/KeyError — the env-ingested
+            # flag fires this during package bootstrap, before the
+            # core modules (and the flags they read at import) exist;
+            # retry on the next emit, by which time the package is up
+            return
+        cm = observe_op_stream(_count_op)
+        cm.__enter__()
+        _DISPATCH_CM = cm
+        _DISPATCH_T0 = time.perf_counter()
+        # chain onto the host-read hook (graphcheck's stream_report
+        # chains the same way, so the two compose in either order)
+        prev = tensor_mod._host_read_hook
+
+        def _count_host_read(t, _prev=prev):
+            _HOST_TRANSFERS["n"] += 1
+            if _prev is not None:
+                _prev(t)
+
+        _PREV_HOST_HOOK = prev
+        _HOST_HOOK = _count_host_read
+        tensor_mod._host_read_hook = _count_host_read
+        if not _MONITORING_ON:
+            try:
+                import jax.monitoring as _mon
+                _mon.register_event_duration_secs_listener(
+                    _on_jax_duration)
+                # listeners are global and cannot be removed singly —
+                # the callback itself checks enabled()
+                _MONITORING_ON = True
+            except Exception:
+                pass
+        _HOOKS_READY = True
+    import atexit
+    atexit.register(emit_dispatch_summary)
+
+
+def _uninstall_hooks_locked() -> None:
+    global _HOOKS_READY, _DISPATCH_CM, _PREV_HOST_HOOK, _HOST_HOOK
+    if _DISPATCH_CM is not None:
+        try:
+            _DISPATCH_CM.__exit__(None, None, None)
+        except Exception:
+            pass
+        _DISPATCH_CM = None
+    if _HOST_HOOK is not None:
+        try:
+            from ..core import tensor as tensor_mod
+            # only restore if nobody chained on top of us meanwhile
+            if tensor_mod._host_read_hook is _HOST_HOOK:
+                tensor_mod._host_read_hook = _PREV_HOST_HOOK
+        except ImportError:
+            pass
+        _HOST_HOOK = None
+        _PREV_HOST_HOOK = None
+    _HOOKS_READY = False
+    _DISPATCH_COUNTS.clear()
+    _HOST_TRANSFERS["n"] = 0
+
+
+def _count_op(ev) -> None:
+    # GIL-atomic enough for counts; the summary emit takes the lock
+    _DISPATCH_COUNTS[ev.op_name] = _DISPATCH_COUNTS.get(ev.op_name, 0) + 1
+
+
+def dispatch_counts() -> Dict[str, int]:
+    """Live per-op dispatch counts since the last summary."""
+    return dict(_DISPATCH_COUNTS)
+
+
+def emit_dispatch_summary() -> Optional[Dict[str, int]]:
+    """Emit one ``dispatch_summary`` record aggregating op counts since
+    the last summary (or hook install), then reset the window.  No-op
+    when disabled or when nothing was dispatched."""
+    global _DISPATCH_T0
+    if _LOG is None or not (_DISPATCH_COUNTS or _HOST_TRANSFERS["n"]):
+        return None
+    with _lock:
+        counts = dict(_DISPATCH_COUNTS)
+        _DISPATCH_COUNTS.clear()
+        transfers, _HOST_TRANSFERS["n"] = _HOST_TRANSFERS["n"], 0
+        t0, _DISPATCH_T0 = _DISPATCH_T0, time.perf_counter()
+    window = round(time.perf_counter() - t0, 3) if t0 else None
+    emit("dispatch_summary", ops=counts,
+         total=sum(counts.values()), host_transfers=transfers,
+         window_s=window)
+    return counts
+
+
+# substrings of jax.monitoring event names worth recording.  ONLY the
+# backend compile + persistent-cache events: the jaxpr trace/lowering
+# durations fire per *eager op dispatch* (every op traces its vjp), so
+# recording them would write one line per op and bury the log
+_COMPILE_EVENT_MARKERS = ("backend_compile", "compilation_cache",
+                          "persistent_cache", "pjit")
+
+
+def _on_jax_duration(event: str, duration: float, **kw: Any) -> None:
+    log = _LOG
+    if log is None:
+        return
+    name = event.lower()
+    if not any(m in name for m in _COMPILE_EVENT_MARKERS):
+        return
+    try:
+        emit("compile", source="jax.monitoring", event=event,
+             dur_s=round(float(duration), 6))
+    except Exception:
+        pass                          # telemetry must never raise into jax

@@ -13,24 +13,30 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from ..observability.metrics import HistogramValue, TIME_BUCKETS
+
 
 class _Stat:
-    """Streaming mean over a window plus a global total."""
+    """Streaming mean over a window plus a global distribution.
+
+    The global accumulator is the shared observability
+    :class:`HistogramValue` (not a private sum/count pair), so every
+    timer gets bucketed percentiles for free and reports the same
+    numbers the metrics registry would.
+    """
 
     def __init__(self):
         self.reset()
 
     def reset(self):
-        self.total = 0.0
-        self.count = 0
+        self.hist = HistogramValue(TIME_BUCKETS)
         self.window_total = 0.0
         self.window_count = 0
         self.last = 0.0
 
     def update(self, value: float):
         self.last = value
-        self.total += value
-        self.count += 1
+        self.hist.observe(value)
         self.window_total += value
         self.window_count += 1
 
@@ -39,8 +45,16 @@ class _Stat:
         self.window_count = 0
 
     @property
+    def total(self) -> float:
+        return self.hist.sum
+
+    @property
+    def count(self) -> int:
+        return self.hist.count
+
+    @property
     def avg(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        return self.hist.avg
 
     @property
     def window_avg(self) -> float:

@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
+from ..observability import events as obs_events
 from .faults import STATE_FILE_ENV, maybe_fault
 
 __all__ = ["ResilientTrainLoop", "RunReport", "run_resilient",
@@ -139,11 +140,18 @@ class ResilientTrainLoop:
         self.last_saved_step = int(step)
 
     # -- the per-step hook ----------------------------------------------
-    def end_step(self, step: int) -> None:
+    def end_step(self, step: int, *, loss: Optional[float] = None,
+                 examples: Optional[float] = None) -> None:
         """Call once per completed training step: fires the ``step``
-        fault point, advances the progress heartbeat, checkpoints every
-        ``save_every`` steps, and honors a pending preemption."""
+        fault point, emits the step telemetry record, advances the
+        progress heartbeat, checkpoints every ``save_every`` steps, and
+        honors a pending preemption.  ``loss``/``examples`` (this
+        step's sample count) enrich the telemetry when given."""
         maybe_fault("step", step=step)
+        # telemetry AFTER the fault point: a step whose fault crashed
+        # the process never logs, so the event stream's step ids stay
+        # strictly increasing across a relaunch-and-resume
+        self._emit_step(step, loss, examples)
         if self._hb is not None:
             self._hb.ping()
         if self.preempted:
@@ -156,6 +164,30 @@ class ResilientTrainLoop:
             raise SystemExit(0)
         if self.save_every > 0 and (step + 1) % self.save_every == 0:
             self.save(step)
+
+    def _emit_step(self, step: int, loss, examples) -> None:
+        from ..observability import events, metrics
+        if not events.enabled():
+            return
+        # interval since the previous end_step (None on the first step
+        # of this process) — an anchor difference, routed straight into
+        # the shared registry histogram + the event record
+        now = time.perf_counter()  # noqa: PTL501 — the delta is
+        # observed into observability.metrics two lines down
+        anchor = getattr(self, "_t_last_step", None)
+        self._t_last_step = now
+        dt = (now - anchor) if anchor is not None else None
+        if dt is not None:
+            metrics.histogram(
+                "paddle_train_step_seconds",
+                "wall time between consecutive end_step calls",
+                buckets=metrics.TIME_BUCKETS).observe(dt)
+        events.emit(
+            "step", step=int(step),
+            loss=float(loss) if loss is not None else None,
+            step_time_s=round(dt, 6) if dt is not None else None,
+            examples_per_sec=round(float(examples) / dt, 3)
+            if (examples and dt) else None)
 
     def finish(self, rank: Optional[int] = None) -> None:
         """Mark this worker completed (the elastic done-file) and stop
@@ -234,7 +266,9 @@ def run_resilient(script: str, script_args: Optional[Sequence[str]] = None,
     job_id = None
     if not child_env.get("PADDLE_ELASTIC_REGISTRY") and \
             not child_env.get("PADDLE_ELASTIC_JOB_ID"):
-        job_id = f"resilient_{os.getpid()}_{int(time.time() * 1000)}"
+        job_id = f"resilient_{os.getpid()}_" \
+            f"{int(time.time() * 1000)}"  # noqa: PTL501 — unique job
+        # id, not a reported timing
         child_env["PADDLE_ELASTIC_JOB_ID"] = job_id
     if fault_schedule is not None:
         child_env["FLAGS_fault_schedule"] = fault_schedule
@@ -274,12 +308,15 @@ def run_resilient(script: str, script_args: Optional[Sequence[str]] = None,
                     # window to write the final checkpoint and exit 0
                     report.preempted = True
                     report.events.append("preempt:forward-sigterm")
+                    obs_events.emit("preempt",
+                                    grace_s=float(preempt_grace_s))
                     try:
                         proc.send_signal(signal.SIGTERM)
                     except OSError:
                         pass
-                    deadline = time.time() + float(preempt_grace_s)
-                    while proc.poll() is None and time.time() < deadline:
+                    deadline = time.monotonic() + float(preempt_grace_s)
+                    while proc.poll() is None and \
+                            time.monotonic() < deadline:
                         time.sleep(poll_interval)
                     launcher.stop()
                     report.code = proc.poll() if proc.poll() is not None \
@@ -311,7 +348,14 @@ def run_resilient(script: str, script_args: Optional[Sequence[str]] = None,
             if report.restarts > max_restarts:
                 report.code = code if code else 1
                 report.events.append("gave-up")
+                obs_events.emit("elastic_restart", reason="gave-up",
+                                restarts=report.restarts,
+                                code=int(code or 1))
                 return report
+            obs_events.emit("elastic_restart",
+                            reason="stall" if stalled else "crash",
+                            restarts=report.restarts,
+                            code=int(code or 1))
             # deterministic exponential backoff — reproducible chaos runs
             time.sleep(min(max_backoff_s,
                            restart_backoff_s

@@ -240,6 +240,15 @@ class FaultInjector:
             # the record must survive the fault itself (crash/exit never
             # return) so a relaunched process sees it as already-fired
             self._persist(spec)
+            # telemetry likewise BEFORE execution — a crash fault would
+            # never come back to log itself (lazy import: this module
+            # loads from flags.py during package bootstrap)
+            try:
+                from ..observability import events
+                events.emit("fault", point=point, occurrence=n,
+                            fault_kind=spec.kind, arg=spec.arg)
+            except ImportError:
+                pass
             self._execute(spec, path)
 
     def _execute(self, spec: FaultSpec, path: Optional[str]) -> None:

@@ -24,7 +24,13 @@ signature, backend — whatever the caller folds in).  Failure model:
   the file clean.
 * **observability** — per-kind hit/miss/store/drop counters
   (``stats()``), surfaced by bench.py and asserted by the warm-start
-  tier-1 tests.
+  tier-1 tests.  Every counter bump is mirrored into the process
+  metrics registry (``paddle_tuning_cache_events_total{kind,event}``,
+  readable from any ``GET /metrics`` endpoint or the observability
+  CLI) and, when ``FLAGS_observability_dir`` is set, emitted as a
+  ``tuning_cache`` event-log record — the instance dict stays the
+  source of truth for ``stats()`` so a flag-driven instance swap still
+  means fresh counters.
 
 The module also registers no flags itself — ``FLAGS_tuning_cache_dir``
 lives in ``paddle_tpu.flags`` so it ingests ``FLAGS_*`` env vars at
@@ -41,6 +47,25 @@ from typing import Any, Dict, Iterator, List, Optional
 SCHEMA_VERSION = 1
 
 _KIND_OK = set("abcdefghijklmnopqrstuvwxyz0123456789_")
+
+
+def _obs():
+    """(counter_family, events_module) or (None, None) — this module is
+    loadable standalone (file-path import in tests/tools), so the
+    observability mirror degrades to the plain dict counters."""
+    try:
+        from ..observability import events, metrics
+    except ImportError:
+        try:
+            from paddle_tpu.observability import events, metrics
+        except ImportError:
+            return None, None
+    fam = metrics.counter(
+        "paddle_tuning_cache_events_total",
+        "tuning-cache traffic by kind (hits/misses/stores/"
+        "corrupt_lines/version_skew)",
+        labels=("kind", "event"))
+    return fam, events
 
 
 def canonical_key(key: Dict[str, Any]) -> str:
@@ -75,6 +100,15 @@ class TuningCache:
             "hits": 0, "misses": 0, "stores": 0,
             "corrupt_lines": 0, "version_skew": 0})
 
+    def _bump(self, kind: str, event: str) -> None:
+        """Count into the instance dict AND the shared observability
+        surfaces (metrics registry + event log)."""
+        self._kind_stats(kind)[event] += 1
+        fam, events = _obs()
+        if fam is not None:
+            fam.labels(kind=kind, event=event).inc()
+            events.emit("tuning_cache", cache_kind=kind, event=event)
+
     def _load(self, kind: str) -> Dict[str, dict]:
         """Merge the on-disk file into the in-memory index (newest ``t``
         wins) when its mtime moved; tolerate any corruption."""
@@ -86,7 +120,6 @@ class TuningCache:
             return mem
         if self._mtime.get(kind) == mtime:
             return mem
-        stats = self._kind_stats(kind)
         try:
             # errors="replace": binary corruption becomes unparsable
             # text and is counted line-by-line below, never raised
@@ -102,12 +135,12 @@ class TuningCache:
             try:
                 rec = json.loads(line)
                 if rec.get("v") != SCHEMA_VERSION:
-                    stats["version_skew"] += 1
+                    self._bump(kind, "version_skew")
                     continue
                 k = canonical_key(rec["key"])
                 rec["value"]  # noqa: B018 — KeyError => corrupt record
             except Exception:
-                stats["corrupt_lines"] += 1
+                self._bump(kind, "corrupt_lines")
                 continue
             have = mem.get(k)
             if have is None or rec.get("t", 0) >= have.get("t", 0):
@@ -159,19 +192,20 @@ class TuningCache:
     def lookup(self, kind: str, key: Dict[str, Any]) -> Optional[dict]:
         """The stored value dict, or None (counted as hit/miss)."""
         rec = self._load(kind).get(canonical_key(key))
-        stats = self._kind_stats(kind)
         if rec is None:
-            stats["misses"] += 1
+            self._bump(kind, "misses")
             return None
-        stats["hits"] += 1
+        self._bump(kind, "hits")
         return rec["value"]
 
     def store(self, kind: str, key: Dict[str, Any],
               value: Dict[str, Any]) -> None:
-        rec = {"v": SCHEMA_VERSION, "t": time.time(),
+        rec = {"v": SCHEMA_VERSION,
+               "t": time.time(),  # noqa: PTL501 — record timestamp
+               # (newest-wins merge key), not a reported timing
                "key": dict(key), "value": dict(value)}
         self._mem.setdefault(kind, {})[canonical_key(key)] = rec
-        self._kind_stats(kind)["stores"] += 1
+        self._bump(kind, "stores")
         self._flush(kind)
 
     def entries(self, kind: Optional[str] = None) -> Iterator[dict]:
@@ -194,7 +228,8 @@ class TuningCache:
         """Drop entries (all of them, or those older than ``max_age_s``).
         Returns the number removed."""
         removed = 0
-        now = time.time()
+        now = time.time()  # noqa: PTL501 — age cutoff vs stored record
+        # timestamps, not a reported timing
         for k in ([kind] if kind else self.kinds()):
             mem = self._load(k)
             if max_age_s is None:

@@ -300,6 +300,13 @@ class CostModel:
         a_c, a_m, a_o = (float(max(v, 1e-3)) for v in sol)
         self.coeffs = replace(c, alpha_compute=a_c, alpha_memory=a_m,
                               alpha_overhead=a_o)
+        try:
+            from ..observability import events
+            events.emit("tuning_fit", samples=len(samples),
+                        alphas={"compute": a_c, "memory": a_m,
+                                "overhead": a_o})
+        except ImportError:
+            pass                # standalone file-path import (tests)
         return self.coeffs
 
     def to_dict(self) -> dict:

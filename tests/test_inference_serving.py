@@ -69,3 +69,65 @@ def test_bad_request_answers_400(artifact):
             assert json.loads(r.read())["status"] == "ok"
     finally:
         srv.stop()
+
+
+def _prom_value(text, name, **labels):
+    """Value of one series from Prometheus text exposition."""
+    want = {f'{k}="{v}"' for k, v in labels.items()}
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest[:1] not in ("{", " "):
+            continue                      # name-prefix collision
+        if "{" in rest:
+            inner = rest[1:rest.index("}")]
+            have = set(inner.split(","))
+            if not want <= have:
+                continue
+        elif want:
+            continue
+        return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"series {name}{labels} not found in:\n{text}")
+
+
+def test_metrics_endpoint_matches_scripted_load(artifact):
+    """GET /metrics is live Prometheus text whose request-count /
+    latency / in-flight values match a scripted load (the acceptance
+    criterion for the serving surface)."""
+    prefix, x, _ = artifact
+    srv = serve(prefix)
+    try:
+        n_ok, n_bad = 5, 2
+        for _ in range(n_ok):
+            predict_http(srv.url, x)
+        for _ in range(n_bad):
+            req = urllib.request.Request(srv.url + "/predict",
+                                         data=b"junk", method="POST")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req, timeout=10)
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        sid = srv.server_id
+        assert _prom_value(text, "paddle_serving_requests_total",
+                           server=sid, outcome="served") == n_ok
+        assert _prom_value(text, "paddle_serving_requests_total",
+                           server=sid, outcome="bad_request") == n_bad
+        assert _prom_value(text, "paddle_serving_in_flight",
+                           server=sid) == 0
+        # every admitted request (200 AND 400) left one latency sample
+        assert _prom_value(
+            text, "paddle_serving_request_latency_seconds_count",
+            server=sid) == n_ok + n_bad
+        assert _prom_value(
+            text, "paddle_serving_request_latency_seconds_sum",
+            server=sid) > 0
+        # /health reads the same children
+        with urllib.request.urlopen(srv.url + "/health", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["served"] == n_ok and h["bad_requests"] == n_bad
+        assert h["rejected"] == 0 and h["errors"] == 0
+    finally:
+        srv.stop()

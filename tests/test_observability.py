@@ -1,0 +1,443 @@
+"""paddle_tpu.observability: metrics registry (concurrency, golden
+exporter output), JSONL event log (rotation, corrupt-tail tolerance,
+profiler correlation), train-loop telemetry (TrainStep compile events,
+monotonic step ids across a supervised restart), the CLI, and the
+PTL501/PTL502 observability-hygiene gates."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import events, metrics
+from paddle_tpu.observability.metrics import (HistogramValue,
+                                              MetricsRegistry)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_dir(tmp_path):
+    """Point FLAGS_observability_dir at a temp dir for the test body."""
+    d = str(tmp_path / "obs")
+    paddle.set_flags({"FLAGS_observability_dir": d})
+    try:
+        yield d
+    finally:
+        paddle.set_flags({"FLAGS_observability_dir": ""})
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_concurrency_exact_total():
+    reg = MetricsRegistry()
+    c = reg.counter("hammered_total", labels=("who",))
+    h = reg.histogram("hammered_seconds", buckets=(0.5, 1.0))
+    n_threads, per_thread = 8, 5000
+
+    def work(i):
+        child = c.labels(who=str(i % 2))
+        for _ in range(per_thread):
+            child.inc()
+            h.observe(0.25)
+
+    ts = [threading.Thread(target=work, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = sum(child.value for _, child in c.series())
+    assert total == n_threads * per_thread
+    hv = h.child().hist
+    assert hv.count == n_threads * per_thread
+    assert hv.bucket_counts[0] == n_threads * per_thread  # all <= 0.5
+    assert abs(hv.sum - 0.25 * hv.count) < 1e-6
+
+
+def test_registry_type_and_conflict_rules():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "help")
+    assert reg.counter("x_total") is c          # re-register: same family
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")                    # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("a",))   # label conflict
+    with pytest.raises(ValueError):
+        c.labels(bogus="1")                     # undeclared label
+    with pytest.raises(ValueError):
+        c.child().inc(-1)                       # counters only go up
+    g = reg.gauge("g")
+    g.set(5)
+    g.dec(2)
+    assert g.value == 3
+    with pytest.raises(ValueError):
+        reg.counter("0bad name")
+
+
+def test_prometheus_text_golden():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests", labels=("code",)) \
+        .labels(code="200").inc(3)
+    reg.gauge("inflight", "live").set(2)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(9.0)
+    want = """\
+# HELP inflight live
+# TYPE inflight gauge
+inflight 2
+# HELP lat_seconds latency
+# TYPE lat_seconds histogram
+lat_seconds_bucket{le="0.1"} 1
+lat_seconds_bucket{le="1"} 2
+lat_seconds_bucket{le="+Inf"} 3
+lat_seconds_sum 9.55
+lat_seconds_count 3
+# HELP req_total requests
+# TYPE req_total counter
+req_total{code="200"} 3
+"""
+    assert reg.prometheus_text() == want
+
+
+def test_snapshot_json_round_trips():
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc(7)
+    reg.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["a_total"]["type"] == "counter"
+    assert snap["a_total"]["series"][0]["value"] == 7
+    assert snap["h_seconds"]["series"][0]["count"] == 1
+
+
+def test_disabled_metrics_are_noop():
+    reg = MetricsRegistry()
+    c = reg.counter("kill_total")
+    h = reg.histogram("kill_seconds", buckets=(1.0,))
+    metrics.set_enabled(False)
+    try:
+        c.inc()
+        h.observe(0.5)
+    finally:
+        metrics.set_enabled(True)
+    assert c.value == 0 and h.child().hist.count == 0
+    c.inc()
+    assert c.value == 1
+
+
+def test_histogram_value_quantiles():
+    h = HistogramValue(buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    assert h.count == 4 and h.avg == pytest.approx(1.625)
+    assert 0.0 < h.quantile(0.5) <= 2.0
+    s = h.summary()
+    assert s["count"] == 4 and s["p99"] <= 4.0
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def test_event_log_write_read_and_envelope(obs_dir):
+    assert events.enabled()
+    events.emit("step", step=3, loss=0.5, skipme=None)
+    recs = events.read_events(obs_dir)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["kind"] == "step" and r["step"] == 3 and r["loss"] == 0.5
+    assert "skipme" not in r                    # None fields dropped
+    for k in ("v", "ts", "pid", "run"):
+        assert k in r
+    # disabled -> emit is a no-op
+    paddle.set_flags({"FLAGS_observability_dir": ""})
+    events.emit("step", step=4)
+    assert len(events.read_events(obs_dir)) == 1
+
+
+def test_event_log_rotation_and_merge(tmp_path):
+    log = events.EventLog(str(tmp_path), rotate_bytes=400,
+                          keep_rotated=3)
+    for i in range(40):
+        log.write("step", {"step": i})
+    files = log.files_oldest_first()
+    assert len(files) > 1                       # rotation happened
+    assert os.path.basename(files[-1]) == "events.jsonl"
+    recs = events.read_events(str(tmp_path))
+    steps = [r["step"] for r in recs]
+    # oldest rotations may be dropped (bounded count) but order holds
+    # and the tail is intact
+    assert steps == sorted(steps)
+    assert steps[-1] == 39
+
+
+def test_event_log_corrupt_tail_tolerated(tmp_path):
+    log = events.EventLog(str(tmp_path))
+    log.write("step", {"step": 0})
+    log.write("step", {"step": 1})
+    with open(log.path, "a", encoding="utf-8") as fh:
+        fh.write('{"v": 1, "kind": "step", "step": 2')   # torn tail
+    with open(log.path, "ab") as fh:
+        fh.write(b"\n\x00\xff garbage\n")
+    recs = events.read_events(str(tmp_path))
+    assert [r["step"] for r in recs] == [0, 1]
+
+
+def test_span_emits_duration_and_correlation_id(obs_dir):
+    with events.span("ckpt_save", path="/x") as sp:
+        pass
+    (rec,) = events.read_events(obs_dir, kinds=["ckpt_save"])
+    assert rec["span_id"] == sp.span_id
+    assert rec["dur_s"] >= 0.0 and rec["path"] == "/x"
+
+
+def test_dispatch_summary_counts_ops_and_transfers(obs_dir):
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    y = (x * 2 + 1).sum()
+    y.numpy()                                   # one host transfer
+    counts = events.emit_dispatch_summary()
+    assert counts and sum(counts.values()) >= 3
+    (rec,) = events.read_events(obs_dir, kinds=["dispatch_summary"])
+    assert rec["total"] == sum(counts.values())
+    assert isinstance(rec["ops"], dict)
+    assert rec["host_transfers"] >= 1
+    # window reset: nothing pending now
+    assert events.emit_dispatch_summary() is None
+
+
+# ---------------------------------------------------------------------------
+# train-loop integration
+# ---------------------------------------------------------------------------
+
+def _tiny_step():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import train_step
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    return m, train_step(m, nn.MSELoss(), o)
+
+
+def test_train_step_jit_miss_emits_compile_event(obs_dir):
+    _, step = _tiny_step()
+    x = np.ones((2, 4), np.float32)
+    y = np.zeros((2, 4), np.float32)
+    step(x, y)
+    comp = [r for r in events.read_events(obs_dir, kinds=["compile"])
+            if r.get("source") == "train_step"]
+    assert len(comp) == 1
+    assert comp[0]["dur_s"] > 0 and "batch=" in comp[0]["key"]
+    # warm call: no new train_step compile event
+    step(x, y)
+    comp2 = [r for r in events.read_events(obs_dir, kinds=["compile"])
+             if r.get("source") == "train_step"]
+    assert len(comp2) == 1
+
+
+def test_end_to_end_training_run_report(obs_dir, tmp_path):
+    """The acceptance loop: one training run with the flag set produces
+    step + compile + checkpoint + dispatch-summary records and the CLI
+    report aggregates them."""
+    from paddle_tpu.observability.__main__ import aggregate
+    from paddle_tpu.resilience.driver import ResilientTrainLoop
+    m, step = _tiny_step()
+    sd = {p.name or f"p{i}": p for i, p in enumerate(m.parameters())}
+    loop = ResilientTrainLoop(str(tmp_path / "ck"), sd, save_every=2,
+                              heartbeat=False)
+    x = np.ones((2, 4), np.float32)
+    y = np.zeros((2, 4), np.float32)
+    for s in range(loop.restore(), 4):
+        loss = step(x, y)
+        loop.end_step(s, loss=float(loss.numpy()), examples=2)
+    events.emit_dispatch_summary()
+    recs = events.read_events(obs_dir)
+    kinds = {r["kind"] for r in recs}
+    assert {"step", "compile", "ckpt_save", "ckpt_commit",
+            "dispatch_summary"} <= kinds
+    agg = aggregate(recs)
+    assert agg["steps"]["count"] == 4
+    assert agg["steps"]["first"] == 0 and agg["steps"]["last"] == 3
+    assert agg["steps"]["last_loss"] is not None
+    assert agg["checkpoint"]["saves"] == 2
+    assert agg["compile"]["count"] >= 1
+    assert agg["dispatch"]["total"] >= 1
+    # registry side: the shared step-time histogram saw 3 intervals
+    fam = metrics.default_registry().get("paddle_train_step_seconds")
+    assert fam is not None and fam.child().hist.count >= 3
+    # CLI renders it (in-process: the CLI is plain argparse + stdlib)
+    from paddle_tpu.observability.__main__ import main as cli_main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["report", "--dir", obs_dir])
+    assert rc == 0
+    assert "steps" in buf.getvalue() and "ids 0..3" in buf.getvalue()
+
+
+def test_cli_snapshot_and_tail(obs_dir):
+    import io
+    from contextlib import redirect_stdout
+    from paddle_tpu.observability.__main__ import main as cli_main
+    events.emit("step", step=0)
+    events.emit("step", step=1)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["tail", "--dir", obs_dir, "-n", "1"])
+    assert rc == 0
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert len(lines) == 1 and lines[0]["step"] == 1
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["snapshot", "--prometheus"])
+    assert rc == 0 and "# TYPE" in buf.getvalue()
+
+
+_RESTART_WORKER = r"""
+import os
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.resilience.driver import ResilientTrainLoop
+
+TOTAL = int(os.environ["OBS_TOTAL"])
+sd = {"w": paddle.to_tensor(np.zeros(4, dtype=np.float32))}
+loop = ResilientTrainLoop(None, sd, save_every=1, keep_last_k=50,
+                          heartbeat_interval=0.1)
+for step in range(loop.restore(), TOTAL):
+    sd["w"] = sd["w"] + 1.0
+    loop.end_step(step)
+loop.finish()
+"""
+
+
+@pytest.mark.slow
+def test_step_ids_monotonic_across_restart(obs_dir, tmp_path):
+    """Step telemetry is emitted AFTER the step fault point: a crashed
+    step never logs, so the merged event stream carries strictly
+    increasing step ids across the supervised relaunch (the worker
+    resumes from the last committed checkpoint).  slow: two full worker
+    processes under the run_resilient supervisor, like the resilience
+    chaos tests."""
+    from paddle_tpu.resilience.driver import run_resilient
+    script = tmp_path / "worker.py"
+    script.write_text(_RESTART_WORKER)
+    total = 6
+    report = run_resilient(
+        str(script), ckpt_dir=str(tmp_path / "ck"),
+        fault_schedule="step@3=crash",
+        max_restarts=2, restart_backoff_s=0.2,
+        heartbeat_timeout=5.0, poll_interval=0.05,
+        log_dir=str(tmp_path / "logs"),
+        env={"OBS_TOTAL": str(total), "JAX_PLATFORMS": "cpu",
+             "FLAGS_observability_dir": obs_dir})
+    assert report.code == 0, (report, open(os.path.join(
+        str(tmp_path / "logs"), "workerlog.0")).read()[-2000:])
+    assert report.crashes == 1
+    recs = events.read_events(obs_dir)
+    steps = [r["step"] for r in recs if r["kind"] == "step"]
+    assert steps == sorted(steps)               # monotonic...
+    assert len(steps) == len(set(steps))        # ...and strictly so
+    assert steps[-1] == total - 1
+    runs = {r["run"] for r in recs if r["kind"] == "step"}
+    assert len(runs) == 2                       # two worker processes
+    # the crash itself and the supervisor's relaunch are both on record
+    faults = [r for r in recs if r["kind"] == "fault"]
+    assert [(f["point"], f["fault_kind"]) for f in faults] == \
+        [("step", "crash")]
+    restarts = [r for r in recs if r["kind"] == "elastic_restart"]
+    assert len(restarts) == 1 and restarts[0]["reason"] == "crash"
+    restores = [r for r in recs if r["kind"] == "ckpt_restore"]
+    assert len(restores) == 1 and restores[0]["committed"] is True
+
+
+# ---------------------------------------------------------------------------
+# hapi callback
+# ---------------------------------------------------------------------------
+
+def test_hapi_callback_emits_steps_and_autoinstalls(obs_dir):
+    from paddle_tpu.hapi.callbacks import (ObservabilityCallback,
+                                           config_callbacks)
+    cbks = config_callbacks(verbose=0, batch_size=8)
+    assert any(isinstance(c, ObservabilityCallback)
+               for c in cbks.callbacks)
+    cb = ObservabilityCallback(batch_size=8)
+    cb.on_train_begin()
+    cb.on_epoch_begin(0)
+    for s in range(3):
+        cb.on_train_batch_end(s, {"loss": [0.5 - 0.1 * s]})
+    steps = events.read_events(obs_dir, kinds=["step"])
+    assert [r["step"] for r in steps] == [0, 1, 2]
+    assert steps[0]["epoch"] == 0
+    assert steps[0]["loss"] == pytest.approx(0.5)
+    assert "step_time_s" not in steps[0]        # no prior anchor
+    assert steps[1]["step_time_s"] > 0
+    assert steps[1]["examples_per_sec"] > 0
+
+
+# ---------------------------------------------------------------------------
+# observability-hygiene gates (PTL501 / PTL502)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_ptl501_fires_in_scope_and_respects_noqa():
+    from paddle_tpu.analysis.lint import lint_source
+    src = (
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    ok = time.monotonic()\n"
+        "    t1 = time.time()  # noqa: PTL501 — intentional\n"
+        "    return t0, ok, t1\n")
+    fs = lint_source(src, filename="paddle_tpu/tuning/whatever.py",
+                     select={"PTL501"})
+    assert [f.line for f in fs] == [3]          # monotonic + noqa'd ok
+    # out of scope: same source elsewhere is clean
+    assert lint_source(src, filename="paddle_tpu/ops/whatever.py",
+                       select={"PTL501"}) == []
+
+
+@pytest.mark.lint
+def test_ptl501_package_reports_clean():
+    from paddle_tpu.analysis.lint import lint_paths
+    fs = lint_paths([os.path.join(_REPO, "paddle_tpu")],
+                    select={"PTL501"})
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.lint
+def test_ptl502_event_schema_consistent():
+    from paddle_tpu.analysis.obs_check import check_event_schema
+    fs = check_event_schema(_REPO)
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.lint
+def test_ptl502_detects_drift(tmp_path):
+    """An emitter inventing a kind or a field is caught."""
+    from paddle_tpu.analysis.obs_check import check_event_schema
+    root = tmp_path / "repo"
+    pkg = root / "paddle_tpu"
+    pkg.mkdir(parents=True)
+    (root / "docs").mkdir()
+    (root / "docs" / "observability_events.md").write_text(
+        "\n".join(f"`{k}`" for k in events.EVENT_SCHEMA))
+    (pkg / "bad.py").write_text(
+        "from ..observability import events\n"
+        "events.emit('made_up_kind', x=1)\n"
+        "events.emit('step', bogus_field=2)\n")
+    # make every documented kind "emitted" so only the drift findings
+    # remain
+    (pkg / "ok.py").write_text("\n".join(
+        f"events.emit({k!r})" for k in events.EVENT_SCHEMA))
+    fs = check_event_schema(str(root))
+    msgs = "\n".join(f.message for f in fs)
+    assert "made_up_kind" in msgs
+    assert "bogus_field" in msgs
+    assert len(fs) == 2, msgs

@@ -498,6 +498,22 @@ def test_serving_overload_returns_503_and_drain_on_stop():
     stopper.join(timeout=30)
     assert not stopper.is_alive()
     assert results["blocked"][0] == 200             # full response landed
+    # counter consistency under concurrency: the registry-backed
+    # serving counters account for EVERY request this test issued (the
+    # old plain-int increments could drop one under handler races)
+    issued = 2                                      # blocked + shed
+    assert srv.served + srv.rejected + srv.errors \
+        + srv.bad_requests == issued
+    assert (srv.served, srv.rejected) == (1, 1)
+    assert srv._in_flight == 0
+    # the registry children ARE the /health numbers (same storage)
+    from paddle_tpu.observability import metrics as obs_metrics
+    fam = obs_metrics.default_registry().get(
+        "paddle_serving_requests_total")
+    assert fam.labels(server=srv.server_id,
+                      outcome="served").value == 1
+    assert fam.labels(server=srv.server_id,
+                      outcome="rejected").value == 1
 
 
 def test_predict_http_retries_through_503():

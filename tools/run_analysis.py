@@ -9,15 +9,22 @@ distributed/checkpoint/, and inference/).  This is the scriptable twin
 of `pytest -m lint` for environments without pytest:
 
     python tools/run_analysis.py            # lint + registry + cost model
+                                            # + event schema
     python tools/run_analysis.py --no-registry   # skip the registry pass
                                                  # (no jax import)
     python tools/run_analysis.py --no-cost-model # skip the tuning
                                                  # cost-model sanity pass
+    python tools/run_analysis.py --no-metrics-schema  # skip the
+                                                 # observability event-
+                                                 # schema pass (PTL502)
     python tools/run_analysis.py --json     # machine-readable output
 
 The cost-model pass (PTL301) runs paddle_tpu.tuning.cost_model
-.sanity_check() — stdlib-only math, no backend init, so it is cheap
-enough to keep on by default.
+.sanity_check(); the metrics-schema pass (PTL502) validates every
+events.emit()/span() call site against observability.events
+.EVENT_SCHEMA and docs/observability_events.md.  Both are stdlib-only
+(no backend init), so they stay on by default; ``--metrics-schema``
+remains accepted as an explicit opt-in spelling.
 """
 import argparse
 import json
@@ -40,6 +47,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cost-model", action="store_true",
                     help="skip the tuning cost-model sanity pass "
                          "(PTL301)")
+    ap.add_argument("--metrics-schema", action="store_true",
+                    help="run the observability event-schema pass "
+                         "(PTL502); on by default — this flag is the "
+                         "explicit opt-in spelling")
+    ap.add_argument("--no-metrics-schema", action="store_true",
+                    help="skip the observability event-schema pass")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("paths", nargs="*",
                     help="override the default lint targets")
@@ -62,6 +75,9 @@ def main(argv=None) -> int:
                          file=os.path.join("paddle_tpu", "tuning",
                                            "cost_model.py"))
             for msg in sanity_check())
+    if not args.no_metrics_schema:
+        from paddle_tpu.analysis.obs_check import check_event_schema
+        findings.extend(check_event_schema(_REPO))
 
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
     errors = [f for f in findings if f.severity == "error"]
@@ -73,7 +89,8 @@ def main(argv=None) -> int:
         print(f"analysis: {len(findings)} finding(s), "
               f"{len(errors)} error(s) over {len(targets)} target(s)"
               + ("" if args.no_registry else " + registry")
-              + ("" if args.no_cost_model else " + cost-model"))
+              + ("" if args.no_cost_model else " + cost-model")
+              + ("" if args.no_metrics_schema else " + event-schema"))
     return 1 if errors else 0
 
 
