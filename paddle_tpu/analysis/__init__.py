@@ -17,6 +17,11 @@ severity levels, per-line ``# noqa: PTLxxx`` suppression, JSON output):
   ``StaticFunction``, op-stream host-transfer + float64-promotion
   reports via the ``core.dispatch`` introspection hook, raw jaxpr
   histograms.
+* **pass_check** (PTL601) — replay-equivalence verification of the
+  program-optimization passes (static/passes) over a randomized
+  program corpus, plus a jaxpr hazard re-scan of every optimized
+  replay; the companion AST rule PTL602 (lint.py) bans in-place
+  ``_OpRecord`` mutation inside pass code.
 
 Import cost mirrors the passes: ``rules``/``lint`` import no jax; the
 other passes import the framework lazily inside their entry points.
@@ -30,7 +35,7 @@ __all__ = [
     "make_finding", "max_severity", "has_errors",
     "lint_source", "lint_file", "lint_paths", "is_surface_path",
     "check_registry", "analyze", "inspect_static_fn", "stream_report",
-    "check_jaxpr", "main",
+    "check_jaxpr", "verify_registered_passes", "main",
 ]
 
 
@@ -57,6 +62,11 @@ def stream_report(fn, *args, **kwargs):
 def check_jaxpr(jaxpr):
     from .graphcheck import check_jaxpr as _impl
     return _impl(jaxpr)
+
+
+def verify_registered_passes(corpus=None, check_hazards: bool = True):
+    from .pass_check import verify_registered_passes as _impl
+    return _impl(corpus, check_hazards=check_hazards)
 
 
 def main(argv=None):

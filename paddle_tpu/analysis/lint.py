@@ -72,6 +72,12 @@ TIMING_GLOBS = (
     "*/inference/*.py",
 )
 
+# program-pass files (PTL602 scope): graph passes must build new
+# _OpRecords, never mutate the shared ones in place
+PASS_GLOBS = (
+    "*/static/passes/*.py",
+)
+
 _HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
 _HOST_CASTS = {"float", "int", "bool"}
 _TRACED_DECORATORS = {"to_static", "train_step", "TrainStep"}
@@ -525,6 +531,77 @@ def is_timing_path(path: str) -> bool:
     return any(fnmatch.fnmatch(p, g) for g in TIMING_GLOBS)
 
 
+# _OpRecord slots (static/capture.py) — assigning to these on anything
+# but ``self``, or calling a mutator on the list/dict-valued ones,
+# rewrites a shared record in place
+_OPRECORD_ATTRS = {"fn", "kwargs", "inputs", "outputs", "multi_out",
+                   "name"}
+_OPRECORD_CONTAINER_ATTRS = {"kwargs", "inputs", "outputs"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "pop", "remove",
+                    "clear", "sort", "reverse", "update", "setdefault",
+                    "popitem"}
+
+
+class _PassHygiene(ast.NodeVisitor):
+    """PTL602: in-place _OpRecord mutation inside program-pass files
+    (scoped to PASS_GLOBS).  Flags ``op.fn = ...`` / ``op.inputs[0] =
+    ...`` / ``op.inputs.append(...)`` shapes on any receiver except
+    ``self`` — passes rebind Program.ops with NEW records instead."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+
+    def _flag(self, node: ast.AST, what: str):
+        self.findings.append(make_finding(
+            "PTL602",
+            f"{what} mutates a shared _OpRecord in place — build a new "
+            "record and rebind Program.ops instead",
+            file=self.filename, line=node.lineno, col=node.col_offset))
+
+    def _check_target(self, tgt: ast.AST):
+        if isinstance(tgt, ast.Attribute) and \
+                tgt.attr in _OPRECORD_ATTRS and \
+                not (isinstance(tgt.value, ast.Name)
+                     and tgt.value.id in ("self", "cls")):
+            self._flag(tgt, f"assignment to .{tgt.attr}")
+        elif isinstance(tgt, ast.Subscript) and \
+                isinstance(tgt.value, ast.Attribute) and \
+                tgt.value.attr in _OPRECORD_CONTAINER_ATTRS:
+            self._flag(tgt, f"item assignment into .{tgt.value.attr}")
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._check_target(e)
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._check_target(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._check_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATOR_METHODS \
+                and isinstance(f.value, ast.Attribute) \
+                and f.value.attr in _OPRECORD_CONTAINER_ATTRS \
+                and not (isinstance(f.value.value, ast.Name)
+                         and f.value.value.id in ("self", "cls")):
+            self._flag(node, f".{f.value.attr}.{f.attr}()")
+        self.generic_visit(node)
+
+
+def is_pass_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in PASS_GLOBS)
+
+
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> None (bare noqa: suppress all) | set of codes."""
     out: Dict[int, Optional[Set[str]]] = {}
@@ -572,6 +649,10 @@ def lint_source(source: str, filename: str = "<string>",
         timing = _TimingHygiene(filename)
         timing.visit(tree)
         findings.extend(timing.findings)
+    if is_pass_path(filename):
+        passes = _PassHygiene(filename)
+        passes.visit(tree)
+        findings.extend(passes.findings)
     noqa = _collect_noqa(source)
     out = []
     for f in findings:

@@ -1,0 +1,266 @@
+"""PTL601 — replay-equivalence verification for program passes.
+
+A program-optimization pass (paddle_tpu.static.passes) that changes
+replay semantics is the worst kind of bug: silently wrong numbers on
+every Executor run with the flag set.  This module is the verifier the
+PTL601 gate runs:
+
+* a RANDOMIZED program corpus (:func:`build_corpus`) — captured op
+  traces seeded to contain exactly the structures the passes claim to
+  handle: duplicate subexpressions (CSE), constant chains (folding),
+  dead branches (DCE), single-consumer chains (fusion), and a
+  writeback-carrying training tail (liveness roots);
+* :func:`verify_pass` / :func:`verify_registered_passes` — apply each
+  registered program pass (and the full default pipeline) to every
+  corpus program and require the optimized replay to produce allclose
+  outputs ON FRESH FEED VALUES (stale capture-time values are the
+  classic unsound-fold bug — replaying with the capture feeds would
+  never catch it);
+* a hazard re-scan: the optimized replay's jaxpr must not introduce
+  float64 hazards the original didn't have
+  (``graphcheck.check_jaxpr``), and :func:`static_fn_hazard_codes`
+  re-runs ``graphcheck.inspect_static_fn`` so the jit-side tests can
+  assert optimized ``@to_static`` functions stay hazard-free.
+
+Every verification emits a ``graph_pass`` observability event carrying
+the per-pass op-count/op-class delta and the allclose verdict.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .rules import Finding, make_finding
+
+_PASS_FILE = "paddle_tpu/static/passes/__init__.py"
+
+
+# ---------------------------------------------------------------------------
+# randomized corpus
+# ---------------------------------------------------------------------------
+
+def _build_entry(seed: int) -> Dict[str, Any]:
+    """One captured program with known-optimizable structure.  All
+    tensors are 4x4 f32 so every menu op composes; the RandomState
+    makes the tail deterministic per seed."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..core.tensor import Tensor
+    from ..static.capture import Program, capture_ops
+
+    rs = np.random.RandomState(seed)
+    prog = Program()
+    x = Tensor(jnp.asarray(rs.randn(4, 4).astype("float32")), name="x")
+    y = Tensor(jnp.asarray(rs.randn(4, 4).astype("float32")), name="y")
+    prog.add_placeholder("x", x)
+    prog.add_placeholder("y", y)
+    const = Tensor(jnp.asarray(rs.randn(4, 4).astype("float32")),
+                   name="c0")
+    w = paddle.create_parameter([4, 4], "float32", name=f"w{seed}")
+
+    with capture_ops(prog):
+        a = paddle.add(x, y)
+        b = paddle.add(x, y)                 # duplicate: CSE target
+        c = paddle.matmul(a, b)
+        k = paddle.scale(const, scale=2.0)   # constant chain: fold target
+        k2 = paddle.add(k, const)
+        d = paddle.tanh(c)                   # single-consumer chain: fuse
+        e = paddle.add(d, k2)
+        dead = paddle.multiply(x, const)     # unreachable from any fetch
+        dead = paddle.tanh(dead)             # noqa: F841 — DCE target
+        pool = [a, c, e, paddle.matmul(e, w)]
+        menu: List[Callable] = [
+            lambda u, v: paddle.add(u, v),
+            lambda u, v: paddle.subtract(u, v),
+            lambda u, v: paddle.multiply(u, v),
+            lambda u, v: paddle.matmul(u, v),
+            lambda u, v: paddle.tanh(u),
+            lambda u, v: paddle.scale(u, scale=0.5),
+        ]
+        for _ in range(int(rs.randint(3, 9))):
+            f = menu[int(rs.randint(len(menu)))]
+            u = pool[int(rs.randint(len(pool)))]
+            v = pool[int(rs.randint(len(pool)))]
+            pool.append(f(u, v))
+        out = pool[-1]
+        # a training-style tail: update math feeding ONLY a writeback
+        g = paddle.multiply(out, w)
+        new_w = paddle.subtract(w, paddle.scale(g, scale=0.1))
+    prog.writebacks.append((w, new_w))
+
+    feed_arrays = [jnp.asarray(rs.randn(4, 4).astype("float32")),
+                   jnp.asarray(rs.randn(4, 4).astype("float32"))]
+    return {"program": prog, "feed_names": ["x", "y"],
+            "fetches": [e, out], "feed_arrays": feed_arrays,
+            "label": f"corpus{seed}"}
+
+
+def build_corpus(n: int = 3, seed: int = 0) -> List[Dict[str, Any]]:
+    return [_build_entry(seed + i) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# replay + equivalence
+# ---------------------------------------------------------------------------
+
+def replay_outputs(program, feed_names: Sequence[str], fetches,
+                   feed_arrays) -> Tuple:
+    """Eager (unjitted) replay — exactly the dispatch path whose op
+    count the passes optimize."""
+    pure, externals = program.build_replay(feed_names, fetches)
+    return pure(tuple(feed_arrays), tuple(t._data for t in externals))
+
+
+def check_equivalence(original, optimized, feed_names, fetches,
+                      feed_arrays, rtol: float = 1e-5,
+                      atol: float = 1e-6) -> Dict[str, Any]:
+    want = replay_outputs(original, feed_names, fetches, feed_arrays)
+    got = replay_outputs(optimized, feed_names, fetches, feed_arrays)
+    max_err, ok = 0.0, len(want) == len(got)
+    if ok:
+        for a, b in zip(want, got):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape or not np.allclose(
+                    a, b, rtol=rtol, atol=atol, equal_nan=True):
+                ok = False
+            if a.shape == b.shape and a.size:
+                max_err = max(max_err, float(np.max(np.abs(
+                    a.astype("float64") - b.astype("float64")))))
+    return {"allclose": ok, "max_abs_err": max_err,
+            "ops_before": len(original.ops),
+            "ops_after": len(optimized.ops)}
+
+
+def _jaxpr_f64_hazards(program, feed_names, fetches, feed_arrays) -> int:
+    """float64 hazard count of the replay's jaxpr (graphcheck PTL204)."""
+    import jax
+
+    from .graphcheck import check_jaxpr
+    pure, externals = program.build_replay(feed_names, fetches)
+    jaxpr = jax.make_jaxpr(lambda f, e: pure(f, e))(
+        tuple(feed_arrays), tuple(t._data for t in externals))
+    return len(check_jaxpr(jaxpr)["float64_vars"])
+
+
+# ---------------------------------------------------------------------------
+# pass verification (the PTL601 gate)
+# ---------------------------------------------------------------------------
+
+def verify_pass(name: str, corpus: Optional[List[dict]] = None,
+                check_hazards: bool = True) -> List[Finding]:
+    """Replay-equivalence + hazard verification of one registered pass
+    over the corpus.  Returns PTL601 findings (empty = verified)."""
+    from ..observability import events
+    from ..static.passes import run_program_passes
+    findings: List[Finding] = []
+    for entry in corpus or build_corpus():
+        prog = entry["program"]
+        opt, report = run_program_passes(
+            prog, entry["fetches"], names=[name],
+            label=f"verify:{entry['label']}")
+        res = check_equivalence(prog, opt, entry["feed_names"],
+                                entry["fetches"], entry["feed_arrays"])
+        events.emit("graph_pass", pass_name=name,
+                    program=f"verify:{entry['label']}",
+                    ops_before=res["ops_before"],
+                    ops_after=res["ops_after"],
+                    removed=res["ops_before"] - res["ops_after"],
+                    allclose=res["allclose"])
+        if not res["allclose"]:
+            findings.append(make_finding(
+                "PTL601",
+                f"pass {name!r} broke replay equivalence on "
+                f"{entry['label']} (max |err| {res['max_abs_err']:.3g}, "
+                f"{res['ops_before']}->{res['ops_after']} ops)",
+                file=_PASS_FILE))
+            continue
+        if check_hazards:
+            try:
+                before = _jaxpr_f64_hazards(
+                    prog, entry["feed_names"], entry["fetches"],
+                    entry["feed_arrays"])
+                after = _jaxpr_f64_hazards(
+                    opt, entry["feed_names"], entry["fetches"],
+                    entry["feed_arrays"])
+            except Exception as e:
+                findings.append(make_finding(
+                    "PTL601",
+                    f"pass {name!r}: optimized replay of "
+                    f"{entry['label']} no longer traces "
+                    f"({type(e).__name__}: {e})", file=_PASS_FILE))
+                continue
+            if after > before:
+                findings.append(make_finding(
+                    "PTL601",
+                    f"pass {name!r} introduced {after - before} "
+                    f"float64 hazard(s) into {entry['label']}'s replay "
+                    "jaxpr (graphcheck PTL204 re-scan)",
+                    file=_PASS_FILE))
+    return findings
+
+
+def verify_registered_passes(corpus: Optional[List[dict]] = None,
+                             check_hazards: bool = True) -> List[Finding]:
+    """The full gate: every registered program pass individually, the
+    default pipeline end-to-end, and a registration-coverage check (a
+    pass registered outside the verified harness has no verifier
+    invocation — exactly the drift PTL601 exists to stop)."""
+    from ..distributed.passes.pass_base import PASS_REGISTRY
+    from ..static.passes import DEFAULT_PIPELINE, PROGRAM_PASSES
+    corpus = corpus or build_corpus()
+    findings: List[Finding] = []
+    for name in sorted(set(PASS_REGISTRY)):
+        if name.startswith("program_") and name not in PROGRAM_PASSES:
+            findings.append(make_finding(
+                "PTL601",
+                f"pass {name!r} is registered outside the verified "
+                "program-pass harness (register it via "
+                "static.passes so verify_registered_passes covers it)",
+                file=_PASS_FILE))
+    for name in PROGRAM_PASSES:
+        findings.extend(verify_pass(name, corpus,
+                                    check_hazards=check_hazards))
+    # the composed pipeline can break in ways no single pass does
+    # (ordering bugs, root-set drift between stages)
+    from ..observability import events
+    from ..static.passes import run_program_passes
+    for entry in corpus:
+        prog = entry["program"]
+        opt, report = run_program_passes(
+            prog, entry["fetches"], names=DEFAULT_PIPELINE,
+            label=f"verify-pipeline:{entry['label']}")
+        res = check_equivalence(prog, opt, entry["feed_names"],
+                                entry["fetches"], entry["feed_arrays"])
+        events.emit("graph_pass", pass_name="pipeline",
+                    program=f"verify-pipeline:{entry['label']}",
+                    ops_before=res["ops_before"],
+                    ops_after=res["ops_after"],
+                    removed=res["ops_before"] - res["ops_after"],
+                    op_class_delta=report["op_class_delta"] or None,
+                    allclose=res["allclose"])
+        if not res["allclose"]:
+            findings.append(make_finding(
+                "PTL601",
+                f"default pipeline broke replay equivalence on "
+                f"{entry['label']} (max |err| {res['max_abs_err']:.3g})",
+                file=_PASS_FILE))
+        elif res["ops_after"] >= res["ops_before"]:
+            findings.append(make_finding(
+                "PTL601",
+                f"default pipeline removed nothing from "
+                f"{entry['label']} ({res['ops_before']} ops) — the "
+                "corpus plants CSE/fold/DCE/fusion structure, so a "
+                "zero-delta pipeline means a pass stopped firing",
+                file=_PASS_FILE))
+    return findings
+
+
+def static_fn_hazard_codes(fn) -> List[str]:
+    """Re-run ``graphcheck.inspect_static_fn`` on a ``@to_static``
+    function and return its hazard codes — the jit-side assertion that
+    pass-optimized captures stay hazard-free (tests compare the
+    flag-on codes against flag-off)."""
+    from .graphcheck import inspect_static_fn
+    return sorted(f.code for f in inspect_static_fn(fn)["hazards"])

@@ -20,6 +20,9 @@ Code space:
   PTL5xx  observability hygiene rules (raw-timing bypasses in
           instrumented subsystems, event-schema drift; see lint.py and
           obs_check.py)
+  PTL6xx  program-pass hygiene rules (replay-equivalence verification
+          of registered graph passes, in-place _OpRecord mutation; see
+          pass_check.py and lint.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -298,6 +301,31 @@ _rule(
     "invisible until a dashboard breaks.",
     "Add the kind/field to observability.events.EVENT_SCHEMA and the "
     "schema doc, or fix the call site.")
+_rule(
+    "PTL601", "unverified-pass", ERROR,
+    "registered program pass fails (or lacks) replay-equivalence "
+    "verification",
+    "A graph pass that changes replay semantics produces silently wrong "
+    "numbers on every Executor/jit run with FLAGS_program_passes set — "
+    "and a pass registered outside the verified harness never gets the "
+    "corpus run at all.  The verifier also re-scans the optimized "
+    "replay's jaxpr so a pass cannot smuggle in float64 promotions.",
+    "Run paddle_tpu.analysis.pass_check.verify_registered_passes(); "
+    "fix the failing transform, or register the pass through "
+    "static.passes so the harness covers it.")
+_rule(
+    "PTL602", "oprecord-mutation", ERROR,
+    "program pass mutates an _OpRecord in place",
+    "_OpRecords are SHARED: the source Program, every clone, and any "
+    "SOT trace built from the same capture hold the same record "
+    "objects — an in-place edit rewrites history for all of them and "
+    "invalidates the replay-equivalence proof (the verifier compares "
+    "against the original, which just changed too).  Passes must build "
+    "new records and rebind Program.ops.",
+    "Construct a fresh _OpRecord with the substituted fields (see "
+    "static/passes/graph.py) instead of assigning to op.fn/op.kwargs/"
+    "op.inputs/op.outputs or calling mutators on them; a deliberate "
+    "edit takes '# noqa: PTL602' with a reason comment.")
 _rule(
     "PTL301", "cost-model-sanity", ERROR,
     "tuning cost model violates a physical invariant",

@@ -87,6 +87,12 @@ def new_pass(name: str, pass_attrs: Optional[Dict[str, Any]] = None) \
         -> PassBase:
     """ref: pass_base.py new_pass(name, attrs)."""
     cls = PASS_REGISTRY.get(name)
+    if cls is None and name.startswith("program_"):
+        # the program-level graph passes live in static/passes and
+        # register on import; resolve them lazily so new_pass works
+        # without the caller importing that package first
+        import paddle_tpu.static.passes  # noqa: F401
+        cls = PASS_REGISTRY.get(name)
     if cls is None:
         raise ValueError(
             f"unknown pass {name!r}; registered: {sorted(PASS_REGISTRY)}")

@@ -249,6 +249,15 @@ define_flag("observability_dir", "",
             "`python -m paddle_tpu.observability report`); "
             "empty: disabled",
             on_change=_apply_observability_dir)
+define_flag("program_passes", "",
+            "program-level optimization pass pipeline over captured "
+            "static Programs (static/passes) run by Executor/jit before "
+            "compilation.  '' disables; '1'/'default' runs the default "
+            "pipeline (CSE, constant folding, dead-op elimination, "
+            "chain fusion, remat/donation hints); or a comma-separated "
+            "explicit pass list (see "
+            "paddle_tpu.static.passes.PROGRAM_PASSES).  Every pass is "
+            "replay-equivalence verified (analysis.pass_check, PTL601)")
 define_flag("pallas_autotune_topk", 4,
             "measured autotune times only the cost model's top-K block "
             "candidates (0: time every valid candidate)")

@@ -172,6 +172,15 @@ class _Segment:
     def __init__(self, ops, in_ids, out_ids):
         self.in_ids = in_ids      # recorded-tensor ids, call order
         self.out_ids = out_ids
+        from ..flags import get_flag
+        if get_flag("program_passes"):
+            # jit-side program passes: dead-op elimination against the
+            # segment's live outputs shrinks what gets TRACED (CSE and
+            # fusion are XLA's job once the segment compiles).  in_ids
+            # stay as recorded — a pruned spec simply never reads the
+            # now-dead jit inputs
+            from ..static.passes import optimize_ops_for_jit
+            ops = optimize_ops_for_jit(ops, set(out_ids))
         self.n_ops = len(ops)
         id_pos = {tid: i for i, tid in enumerate(in_ids)}
         specs = [(op.fn, dict(op.kwargs), [id(t) for t in op.inputs],

@@ -86,6 +86,14 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
     # summary
     "dispatch_summary": {"ops": "object", "total": "int",
                          "host_transfers": "int", "window_s": "float"},
+    # one program-optimization pass applied to a captured Program
+    # (static/passes.run_program_passes) or verified against the
+    # randomized corpus (analysis.pass_check): op-count + op-class
+    # deltas are the graph features the learned perf model trains on
+    "graph_pass": {"pass_name": "str", "program": "str",
+                   "ops_before": "int", "ops_after": "int",
+                   "removed": "int", "hints": "int",
+                   "op_class_delta": "object", "allclose": "bool"},
     # inference server lifecycle (per-request traffic lives in metrics)
     "serving": {"action": "str", "url": "str"},
 }

@@ -207,10 +207,12 @@ class Executor:
             v = feed[n]
             feed_arrays.append(v._data if isinstance(v, Tensor)
                                else jnp.asarray(np.asarray(v)))
+        from ..flags import get_flag
+        passes_flag = str(get_flag("program_passes") or "")
         key = (id(program), len(program.ops), len(program.writebacks),
                tuple(feed_names),
                tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
-               tuple(id(t) for t in fetch_tensors))
+               tuple(id(t) for t in fetch_tensors), passes_flag)
         entry = self._cache.get(key)
         if entry is None:
             # write-back sources ride along as extra fetches: the pure
@@ -218,7 +220,17 @@ class Executor:
             # param/accumulator values after the step (the reference's
             # in-place optimizer ops, made explicit)
             wb_sources = [src for _, src in program.writebacks]
-            pure, externals = program.build_replay(
+            run_program = program
+            if passes_flag:
+                # the optimization pass pipeline (static/passes) runs on
+                # a COPY before compilation; the original program and
+                # its records are never touched, so a failed/disabled
+                # pipeline always falls back to the verbatim replay
+                from .passes import pipeline_names, run_program_passes
+                run_program, _report = run_program_passes(
+                    program, fetch_tensors + wb_sources,
+                    names=pipeline_names(passes_flag))
+            pure, externals = run_program.build_replay(
                 feed_names, fetch_tensors + wb_sources)
             fn = jax.jit(lambda f, e: pure(f, e))
             entry = (fn, externals)

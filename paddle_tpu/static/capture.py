@@ -49,6 +49,13 @@ class Program:
         # pure jitted replay (ref: the in-program sgd/adam ops the
         # StandaloneExecutor runs in place)
         self.writebacks: List = []
+        # annotations written by the optimization pass pipeline
+        # (static/passes): per-pass op-count stats, fusable chains the
+        # Pallas kernels can claim, remat/donation placement hints
+        self.pass_log: List[dict] = []
+        self.fusion_hints: List[dict] = []
+        self.remat_hints: List[dict] = []
+        self.donation_hints: List[dict] = []
 
     # -- capture ---------------------------------------------------------
     def _record(self, fn, kwargs, in_tensors, out_tensors, multi_out, name):
@@ -85,14 +92,36 @@ class Program:
         return None
 
     def list_vars(self):
-        return list(self.placeholders.values())
+        # placeholders AND op-produced vars — the same surface
+        # find_var_by_name resolves (ref: Program.list_vars yields every
+        # block var, not just the feeds)
+        seen = {id(t) for t in self.placeholders.values()}
+        out = list(self.placeholders.values())
+        for op in self.ops:
+            for t in op.outputs:
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
 
     def clone(self, for_test: bool = False) -> "Program":
         p = Program()
         p.ops = list(self.ops)
         p.placeholders = dict(self.placeholders)
         # a test clone serves inference: drop the training write-backs
-        p.writebacks = [] if for_test else list(self.writebacks)
+        # AND the update ops that exist only to feed them (grad ops,
+        # optimizer math) — otherwise the inference replay still pays
+        # the whole training tail (ref: Program.clone(for_test) prunes
+        # optimizer ops via the op-role flags; here dead-op elimination
+        # against the non-writeback outputs is the same statement)
+        if for_test and self.writebacks:
+            from .passes.graph import default_root_ids, run_dce
+            roots = default_root_ids(self)
+            roots -= {id(src) for _, src in self.writebacks}
+            p.ops, _ = run_dce(p.ops, roots)
+            p.writebacks = []
+        else:
+            p.writebacks = [] if for_test else list(self.writebacks)
         return p
 
     def __repr__(self):

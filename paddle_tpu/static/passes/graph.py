@@ -1,0 +1,493 @@
+"""Functional graph transforms over captured ``Program`` op lists.
+
+The Program IR (static/capture.py) is an SSA-ish op trace: each
+``_OpRecord`` holds the pure replay fn, its kwargs, and strong refs to
+the input/output Tensors; tensor *identity* (``id``) is the edge.  Every
+transform here is FUNCTIONAL — it returns a new op list built from new
+``_OpRecord`` instances where rewiring is needed and NEVER mutates a
+record in place (the PTL602 analysis rule holds this module to that;
+a mutated record would silently corrupt the original Program, every
+clone sharing it, and any SOT trace built from the same capture).
+
+Soundness model:
+
+* CSE may only merge two ops when they are *provably* the same
+  computation.  Op names alone are not enough — most ops dispatch
+  per-call closures (``lambda v: jnp.clip(v, lo, hi)``), so two ``clip``
+  records with identical inputs and empty kwargs can still differ in
+  the closed-over bounds.  The CSE key therefore includes the fn's code
+  object, its closure cell values and defaults (structurally hashed),
+  the resolved input ids, and the kwargs.  Anything that cannot be
+  hashed conservatively opts out of CSE.
+* Constant folding relies on a capture-time invariant: ops execute
+  eagerly during capture, so every recorded output Tensor already holds
+  its computed value.  An op whose inputs are all true constants
+  (never produced, not placeholders, not parameters/persistable
+  buffers, not writeback targets) can simply be DROPPED — consumers
+  then read the output tensor's live ``_data`` as a replay external,
+  which is exactly the folded value.
+* Fusion composes a single-consumer producer into its consumer: the
+  producer's fn runs inside the consumer's composite at the consumer's
+  position.  For pure SSA traces this reordering is unobservable; the
+  replayed op count (dispatch + trace overhead per step) drops by one
+  per composition — the program-level analogue of the mega-kernel
+  direction (ROADMAP; MPK, arXiv 2512.22219).
+* Everything bails out (returns the input unchanged) when the trace is
+  not SSA (a tensor id produced twice, or used before produced): those
+  traces encode mutation patterns whose replay is position-sensitive.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..capture import _OpRecord
+
+# ops whose replay draws on randomness or bakes a recording-time choice:
+# never CSE'd or folded (merging/folding would silently correlate or
+# freeze samples).  Fusion is fine — the fn still runs verbatim.
+IMPURE_NAMES = frozenset({
+    "dropout", "uniform", "uniform_", "randint", "randn", "rand",
+    "normal", "gaussian", "bernoulli", "multinomial", "randperm",
+    "exponential", "exponential_", "poisson", "standard_normal", "rrelu",
+})
+
+# ops a fused composite must never absorb or be absorbed into: compiled
+# chains (their cost model is their own), collectives (ordering is a
+# distributed contract), and the static-grad op (closes over a replay of
+# the surrounding program).
+FUSE_BARRIER_NAMES = frozenset({
+    "to_static", "sot_segment", "grad", "all_reduce", "all_gather",
+    "reduce_scatter", "broadcast", "alltoall", "send", "recv",
+    "sharding_constraint",
+})
+
+# cheap-to-recompute op classes: candidates for remat hints when their
+# output feeds several consumers (recomputing beats materializing)
+CHEAP_RECOMPUTE_NAMES = frozenset({
+    "add", "subtract", "multiply", "scale", "cast", "astype", "reshape",
+    "transpose", "concat", "getitem", "relu", "gelu", "silu", "tanh",
+    "sigmoid", "layer_norm", "rms_norm", "fused_rms_norm", "softmax",
+    "dropout",
+})
+
+_NORM_NAMES = frozenset({"layer_norm", "rms_norm", "fused_rms_norm"})
+_MATMUL_NAMES = frozenset({"matmul", "mm", "linear", "addmm", "bmm"})
+_ROPE_NAMES = frozenset({"fused_rotary_position_embedding", "rope",
+                         "rotary_position_embedding"})
+
+
+def op_display_name(op: _OpRecord) -> str:
+    return op.name or getattr(op.fn, "__name__", "op")
+
+
+# ---------------------------------------------------------------------------
+# op classification (the feature axis the learned perf model consumes —
+# arXiv 2008.01040 featurizes graphs by op class, not op identity)
+# ---------------------------------------------------------------------------
+
+_CLASS_TABLE = (
+    ("matmul", _MATMUL_NAMES | {"conv2d", "conv1d", "conv3d", "einsum"}),
+    ("attention", {"scaled_dot_product_attention", "flash_attention",
+                   "paged_attention", "memory_efficient_attention"}),
+    ("norm", _NORM_NAMES | {"batch_norm", "group_norm", "instance_norm"}),
+    ("embedding", {"embedding", "one_hot"}),
+    ("reduction", {"sum", "mean", "max", "min", "prod", "logsumexp",
+                   "argmax", "argmin", "all", "any", "norm", "std",
+                   "var", "cross_entropy", "softmax_with_cross_entropy"}),
+    ("layout", {"reshape", "transpose", "concat", "split", "getitem",
+                "setitem", "stack", "squeeze", "unsqueeze", "flatten",
+                "tile", "expand", "gather", "scatter", "pad", "roll",
+                "slice"}),
+    ("random", IMPURE_NAMES),
+    ("compiled", {"to_static", "sot_segment", "grad"}),
+    ("collective", {"all_reduce", "all_gather", "reduce_scatter",
+                    "broadcast", "alltoall", "send", "recv"}),
+)
+
+
+def op_class(name: str) -> str:
+    """Bucket an op name into the coarse class taxonomy."""
+    base = (name or "op").split("+")[0]
+    for cls, names in _CLASS_TABLE:
+        if base in names:
+            return cls
+    return "elementwise"
+
+
+def op_class_histogram(ops: Sequence[_OpRecord]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for op in ops:
+        # a fused composite counts every constituent op toward its class
+        for part in op_display_name(op).split("+"):
+            c = op_class(part)
+            out[c] = out.get(c, 0) + 1
+    return out
+
+
+def op_class_delta(before: Sequence[_OpRecord],
+                   after: Sequence[_OpRecord]) -> Dict[str, int]:
+    """after-minus-before per-class op counts (fused parts unrolled)."""
+    b, a = op_class_histogram(before), op_class_histogram(after)
+    return {k: a.get(k, 0) - b.get(k, 0)
+            for k in sorted(set(b) | set(a))
+            if a.get(k, 0) != b.get(k, 0)}
+
+
+# ---------------------------------------------------------------------------
+# SSA check — the precondition every transform requires
+# ---------------------------------------------------------------------------
+
+def is_ssa(ops: Sequence[_OpRecord]) -> bool:
+    """True iff no tensor id is produced twice and none is consumed
+    before the op that produces it (both patterns make replay meaning
+    depend on record position, which rewrites would scramble)."""
+    first_prod: Dict[int, int] = {}
+    for i, op in enumerate(ops):
+        for t in op.outputs:
+            if id(t) in first_prod:
+                return False
+            first_prod[id(t)] = i
+    for i, op in enumerate(ops):
+        for t in op.inputs:
+            j = first_prod.get(id(t))
+            if j is not None and j >= i:
+                return False
+    return True
+
+
+def _consumer_map(ops: Sequence[_OpRecord]) -> Dict[int, List[int]]:
+    """tensor id -> indices of ops that consume it (deduped per op)."""
+    out: Dict[int, List[int]] = {}
+    for i, op in enumerate(ops):
+        for tid in {id(t) for t in op.inputs}:
+            out.setdefault(tid, []).append(i)
+    return out
+
+
+def default_root_ids(program) -> Set[int]:
+    """Liveness roots when no explicit fetch list exists (clone,
+    PassBase.apply outside the pipeline): every writeback source, plus
+    every op output that nothing consumes and no writeback feeds on —
+    an unconsumed non-writeback output is a potential user fetch."""
+    wb_sources = {id(src) for _, src in program.writebacks}
+    consumed = {id(t) for op in program.ops for t in op.inputs}
+    roots = set(wb_sources)
+    for op in program.ops:
+        for t in op.outputs:
+            if id(t) not in consumed and id(t) not in wb_sources:
+                roots.add(id(t))
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# structural hashing for CSE keys
+# ---------------------------------------------------------------------------
+
+_MAX_HASH_ELEMS = 1024
+
+
+def _value_key(v: Any, depth: int = 0) -> Any:
+    if depth > 6:
+        return ("id", id(v))
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return ("c", repr(v))
+    if isinstance(v, (tuple, list)):
+        return ("seq", type(v).__name__,
+                tuple(_value_key(x, depth + 1) for x in v))
+    if isinstance(v, dict):
+        return ("map", tuple(sorted((repr(k), _value_key(x, depth + 1))
+                                    for k, x in v.items())))
+    if isinstance(v, slice):
+        return ("slice", repr(v))
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        try:
+            size = int(np.prod(shape)) if shape else 1
+            if size <= _MAX_HASH_ELEMS:
+                arr = np.asarray(v)
+                return ("arr", tuple(shape), str(dtype),
+                        hashlib.sha1(arr.tobytes()).hexdigest())
+        except Exception:
+            pass
+        return ("bigarr", id(v))
+    if callable(v):
+        return _callable_key(v, depth + 1)
+    return ("id", id(v))
+
+
+def _callable_key(fn: Callable, depth: int = 0) -> Optional[Any]:
+    """Structural identity of a replay fn.  Two fns with equal keys
+    compute the same function of their inputs; None means 'unknown —
+    do not CSE'."""
+    if depth > 4:
+        return None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # builtin / jnp ufunc: object identity is the only safe notion
+        return ("fobj", id(fn))
+    try:
+        cells = tuple(_value_key(c.cell_contents, depth + 1)
+                      for c in (fn.__closure__ or ()))
+    except ValueError:          # empty cell
+        return None
+    defaults = tuple(_value_key(d, depth + 1)
+                     for d in (fn.__defaults__ or ()))
+    kwdefaults = tuple(sorted(
+        (k, _value_key(v, depth + 1))
+        for k, v in (fn.__kwdefaults__ or {}).items()))
+    return ("fn", id(code), cells, defaults, kwdefaults)
+
+
+def _cse_key(op: _OpRecord, inputs: Sequence) -> Optional[Any]:
+    name = op_display_name(op)
+    if name in IMPURE_NAMES:
+        return None
+    fkey = _callable_key(op.fn)
+    if fkey is None:
+        return None
+    return (name, fkey, tuple(id(t) for t in inputs),
+            _value_key(dict(op.kwargs)), bool(op.multi_out),
+            len(op.outputs))
+
+
+# ---------------------------------------------------------------------------
+# the transforms
+# ---------------------------------------------------------------------------
+
+def run_cse(ops: Sequence[_OpRecord], root_ids: Set[int]
+            ) -> Tuple[List[_OpRecord], int]:
+    """Merge provably-identical ops; later duplicates are dropped and
+    their consumers rewired onto the first occurrence's outputs.  Ops
+    producing a root (fetched / writeback-source) tensor are kept — the
+    replay fetch list addresses tensors by identity."""
+    if not is_ssa(ops):
+        return list(ops), 0
+    seen: Dict[Any, _OpRecord] = {}
+    repl: Dict[int, Any] = {}       # old tensor id -> replacement Tensor
+    out: List[_OpRecord] = []
+    removed = 0
+    for op in ops:
+        new_inputs = [repl.get(id(t), t) for t in op.inputs]
+        if any(n is not o for n, o in zip(new_inputs, op.inputs)):
+            op = _OpRecord(op.fn, op.kwargs, new_inputs, op.outputs,
+                           op.multi_out, op.name)
+        key = _cse_key(op, op.inputs)
+        if key is not None:
+            prev = seen.get(key)
+            if prev is not None and \
+                    len(prev.outputs) == len(op.outputs) and \
+                    not any(id(t) in root_ids for t in op.outputs):
+                for old, new in zip(op.outputs, prev.outputs):
+                    repl[id(old)] = new
+                removed += 1
+                continue
+            if prev is None:
+                seen[key] = op
+        out.append(op)
+    return out, removed
+
+
+def run_constant_fold(ops: Sequence[_OpRecord], placeholder_ids: Set[int],
+                      protected_ids: Set[int]
+                      ) -> Tuple[List[_OpRecord], int]:
+    """Drop pure ops whose inputs are all compile-time constants.  The
+    outputs keep their capture-time ``_data`` and become replay
+    externals — the fold IS the eager value capture already computed.
+    ``protected_ids`` are tensors whose value may change between runs
+    (writeback targets); parameters and persistable buffers are
+    excluded by inspection."""
+    if not is_ssa(ops):
+        return list(ops), 0
+    produced: Set[int] = set()       # outputs of KEPT ops
+    folded_out: Set[int] = set()     # outputs of folded ops (constants)
+    out: List[_OpRecord] = []
+    removed = 0
+
+    def is_const(t) -> bool:
+        tid = id(t)
+        if tid in folded_out:
+            return True
+        if tid in produced or tid in placeholder_ids or \
+                tid in protected_ids:
+            return False
+        return not (t._is_param or t.persistable)
+
+    for op in ops:
+        name = op_display_name(op)
+        if name not in IMPURE_NAMES and name not in FUSE_BARRIER_NAMES \
+                and op.inputs and all(is_const(t) for t in op.inputs):
+            folded_out.update(id(t) for t in op.outputs)
+            removed += 1
+            continue
+        out.append(op)
+        produced.update(id(t) for t in op.outputs)
+    return out, removed
+
+
+def run_dce(ops: Sequence[_OpRecord], root_ids: Set[int]
+            ) -> Tuple[List[_OpRecord], int]:
+    """Drop ops whose outputs reach no root (fetch / writeback source)."""
+    if not is_ssa(ops):
+        return list(ops), 0
+    needed = set(root_ids)
+    kept_rev: List[_OpRecord] = []
+    removed = 0
+    for op in reversed(ops):
+        if any(id(t) in needed for t in op.outputs):
+            kept_rev.append(op)
+            needed.update(id(t) for t in op.inputs)
+        else:
+            removed += 1
+    kept_rev.reverse()
+    return kept_rev, removed
+
+
+def _fused_width(op: _OpRecord) -> int:
+    return op_display_name(op).count("+") + 1
+
+
+def _compose(prod: _OpRecord, cons: _OpRecord) -> _OpRecord:
+    """One composite record running ``prod`` then ``cons`` (with prod's
+    single output wired into every position it held in cons.inputs)."""
+    t = prod.outputs[0]
+    positions = [k for k, u in enumerate(cons.inputs) if u is t]
+    pfn, pkw, n_p = prod.fn, dict(prod.kwargs), len(prod.inputs)
+    cfn, ckw = cons.fn, dict(cons.kwargs)
+    n_c = len(cons.inputs)
+    pos_set = set(positions)
+
+    def fused(*xs, **kw):
+        # kwargs are baked below; **kw kept so signature stays generic
+        a = pfn(*xs[:n_p], **pkw)
+        rest = list(xs[n_p:])
+        full, ri = [], 0
+        for k in range(n_c):
+            if k in pos_set:
+                full.append(a)
+            else:
+                full.append(rest[ri])
+                ri += 1
+        return cfn(*full, **ckw)
+
+    new_inputs = list(prod.inputs) + [u for k, u in enumerate(cons.inputs)
+                                      if k not in pos_set]
+    return _OpRecord(fused, {}, new_inputs, cons.outputs, cons.multi_out,
+                     f"{op_display_name(prod)}+{op_display_name(cons)}")
+
+
+def collect_fusion_hints(ops: Sequence[_OpRecord]) -> List[dict]:
+    """Flag producer->consumer chains the Pallas fused kernels can
+    claim (norm+matmul, rope+QKV-projection) — pattern annotations,
+    independent of whether the rewrite fuses them."""
+    producer: Dict[int, int] = {}
+    for i, op in enumerate(ops):
+        for t in op.outputs:
+            producer[id(t)] = i
+    hints: List[dict] = []
+    for j, op in enumerate(ops):
+        cname = op_display_name(op)
+        for t in op.inputs:
+            i = producer.get(id(t))
+            if i is None:
+                continue
+            pname = op_display_name(ops[i])
+            pparts, cparts = set(pname.split("+")), set(cname.split("+"))
+            kind = None
+            if pparts & _NORM_NAMES and cparts & _MATMUL_NAMES:
+                kind = "norm_matmul"
+            elif (pparts & _MATMUL_NAMES and cparts & _ROPE_NAMES) or \
+                    (pparts & _ROPE_NAMES and cparts & _MATMUL_NAMES):
+                kind = "rope_qkv"
+            if kind:
+                hints.append({"kind": kind, "ops": [i, j],
+                              "chain": f"{pname}->{cname}",
+                              "claimable_by": "ops/pallas"})
+    return hints
+
+
+def run_fuse(ops: Sequence[_OpRecord], root_ids: Set[int],
+             max_width: int = 8) -> Tuple[List[_OpRecord], int]:
+    """Compose single-consumer producer->consumer chains into composite
+    records (dispatch/trace-count reduction; XLA sees the same math).
+    A producer is absorbable iff it has exactly one output, that output
+    is consumed by exactly one op and is not a root, and neither side
+    is a barrier op.  Runs to fixpoint so chains collapse fully."""
+    if not is_ssa(ops):
+        return list(ops), 0
+    ops = list(ops)
+    total = 0
+    while True:
+        producer: Dict[int, int] = {}
+        for i, op in enumerate(ops):
+            for t in op.outputs:
+                producer[id(t)] = i
+        consumers = _consumer_map(ops)
+        absorbed: Dict[int, int] = {}    # producer idx -> consumer idx
+        busy: Set[int] = set()           # ops already part of a fusion
+        for j, op in enumerate(ops):
+            if j in busy:
+                continue
+            cname = op_display_name(op)
+            if set(cname.split("+")) & FUSE_BARRIER_NAMES:
+                continue
+            for t in op.inputs:
+                i = producer.get(id(t))
+                if i is None or i in busy or i == j:
+                    continue
+                prod = ops[i]
+                pname = op_display_name(prod)
+                if (prod.multi_out or len(prod.outputs) != 1
+                        or set(pname.split("+")) & FUSE_BARRIER_NAMES
+                        or id(t) in root_ids
+                        or consumers.get(id(t), []) != [j]
+                        or _fused_width(prod) + _fused_width(op)
+                        > max_width):
+                    continue
+                absorbed[i] = j
+                busy.add(i)
+                busy.add(j)
+                break
+        if not absorbed:
+            return ops, total
+        by_consumer = {j: i for i, j in absorbed.items()}
+        out: List[_OpRecord] = []
+        for j, op in enumerate(ops):
+            if j in absorbed:            # moved into its consumer
+                continue
+            i = by_consumer.get(j)
+            out.append(_compose(ops[i], op) if i is not None else op)
+        ops = out
+        total += len(absorbed)
+
+
+def collect_remat_hints(ops: Sequence[_OpRecord]) -> List[dict]:
+    """Cheap ops whose output feeds >=2 consumers: recompute-in-place
+    candidates for the jax.checkpoint policy."""
+    consumers = _consumer_map(ops)
+    hints = []
+    for i, op in enumerate(ops):
+        name = op_display_name(op)
+        if set(name.split("+")) & CHEAP_RECOMPUTE_NAMES:
+            for t in op.outputs:
+                n = len(consumers.get(id(t), ()))
+                if n >= 2:
+                    hints.append({"kind": "remat", "op": i, "name": name,
+                                  "consumers": n})
+                    break
+    return hints
+
+
+def collect_donation_hints(program) -> List[dict]:
+    """Writeback targets double as replay externals: their input buffer
+    dies the moment the new value commits — donate it to XLA."""
+    hints = []
+    for target, _src in program.writebacks:
+        hints.append({"kind": "donate",
+                      "external": target.name or f"tensor@{id(target)}",
+                      "reason": "writeback target buffer is dead after "
+                                "the step commits"})
+    return hints

@@ -1,0 +1,475 @@
+"""Program-level optimization pass pipeline (paddle_tpu.static.passes)
++ its replay-equivalence verifier (analysis.pass_check, PTL601) and the
+PTL602 in-place-mutation lint rule.
+
+Structure:
+* unit semantics per pass (CSE soundness incl. closure values, constant
+  folding vs live feeds, DCE root handling, fusion barriers);
+* randomized-corpus property: every registered pass and the full
+  pipeline replay-allclose on fresh feed values (the `lint`-marked gate
+  twin of tools/run_analysis.py --pass-verify);
+* the golden decode test: the pipeline shrinks a captured GPT decode
+  program's replayed op count by >= 10% with allclose outputs and
+  `graph_pass` events logged;
+* integration: Executor behind FLAGS_program_passes, SOT-lite segment
+  DCE with hazard parity via graphcheck.inspect_static_fn;
+* satellites: Program.list_vars over op-produced vars,
+  Program.clone(for_test=True) dropping the training tail.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.analysis import pass_check
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static.capture import Program, capture_ops
+from paddle_tpu.static.passes import (DEFAULT_PIPELINE, PROGRAM_PASSES,
+                                      capture_decode_program, graph,
+                                      pipeline_names, run_program_passes)
+
+
+@pytest.fixture
+def passes_flag():
+    """Enable the pipeline for the test body, always restoring off."""
+    paddle.set_flags({"FLAGS_program_passes": "1"})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_program_passes": ""})
+
+
+def _capture(build):
+    """Capture build(feeds...) into a fresh program; returns
+    (program, feed_names, fetches)."""
+    import jax.numpy as jnp
+    prog = Program()
+    rs = np.random.RandomState(0)
+    x = Tensor(jnp.asarray(rs.randn(4, 4).astype("float32")), name="x")
+    y = Tensor(jnp.asarray(rs.randn(4, 4).astype("float32")), name="y")
+    prog.add_placeholder("x", x)
+    prog.add_placeholder("y", y)
+    with capture_ops(prog):
+        fetches = build(x, y)
+    return prog, ["x", "y"], list(fetches)
+
+
+def _fresh_feeds(seed=7):
+    import jax.numpy as jnp
+    rs = np.random.RandomState(seed)
+    return [jnp.asarray(rs.randn(4, 4).astype("float32")),
+            jnp.asarray(rs.randn(4, 4).astype("float32"))]
+
+
+def _assert_equiv(prog, opt, feed_names, fetches):
+    res = pass_check.check_equivalence(prog, opt, feed_names, fetches,
+                                       _fresh_feeds())
+    assert res["allclose"], res
+    return res
+
+
+# ---------------------------------------------------------------------------
+# per-pass semantics
+# ---------------------------------------------------------------------------
+
+def test_cse_merges_duplicates_and_rewires():
+    def build(x, y):
+        a = paddle.add(x, y)
+        b = paddle.add(x, y)          # identical computation
+        return [paddle.matmul(a, b)]
+    prog, feeds, fetches = _capture(build)
+    opt, rep = run_program_passes(prog, fetches, names=["program_cse"])
+    assert rep["ops_after"] == rep["ops_before"] - 1
+    _assert_equiv(prog, opt, feeds, fetches)
+    # the original program is untouched (passes work on a copy)
+    assert len(prog.ops) == rep["ops_before"]
+
+
+def test_cse_distinguishes_closure_values():
+    """Two same-name ops on the same inputs but different closed-over
+    constants must NOT merge — the soundness case the (name, input ids,
+    kwargs) key alone would get wrong."""
+    def build(x, y):
+        a = paddle.scale(x, scale=2.0)
+        b = paddle.scale(x, scale=3.0)
+        return [paddle.add(a, b)]
+    prog, feeds, fetches = _capture(build)
+    opt, rep = run_program_passes(prog, fetches, names=["program_cse"])
+    assert rep["ops_after"] == rep["ops_before"]
+    _assert_equiv(prog, opt, feeds, fetches)
+
+
+def test_constant_fold_drops_const_chain_keeps_feeds_live():
+    import jax.numpy as jnp
+    const = Tensor(jnp.asarray(np.full((4, 4), 2.0, "float32")))
+
+    def build(x, y):
+        k = paddle.scale(const, scale=0.5)    # const chain
+        k2 = paddle.add(k, const)
+        live = paddle.add(x, y)               # feed-dependent: NOT const
+        return [paddle.add(live, k2)]
+    prog, feeds, fetches = _capture(build)
+    opt, rep = run_program_passes(prog, fetches,
+                                  names=["program_constant_fold"])
+    assert rep["ops_after"] == rep["ops_before"] - 2
+    # equivalence on FRESH feed values proves nothing feed-dependent
+    # was frozen at its capture-time value
+    _assert_equiv(prog, opt, feeds, fetches)
+
+
+def test_constant_fold_never_folds_parameters():
+    w = paddle.create_parameter([4, 4], "float32", name="w_fold")
+
+    def build(x, y):
+        wk = paddle.scale(w, scale=2.0)       # param-derived: not const
+        return [paddle.add(x, wk)]
+    prog, feeds, fetches = _capture(build)
+    opt, rep = run_program_passes(prog, fetches,
+                                  names=["program_constant_fold"])
+    assert rep["ops_after"] == rep["ops_before"]
+
+
+def test_dce_drops_dead_branch_keeps_writeback_sources():
+    w = paddle.create_parameter([4, 4], "float32", name="w_dce")
+
+    def build(x, y):
+        live = paddle.tanh(paddle.matmul(x, y))
+        dead = paddle.multiply(x, y)
+        paddle.tanh(dead)                     # dead chain
+        new_w = paddle.subtract(w, paddle.scale(live, scale=0.1))
+        build.new_w = new_w
+        return [live]
+    prog, feeds, fetches = _capture(build)
+    prog.writebacks.append((w, build.new_w))
+    opt, rep = run_program_passes(prog, fetches, names=["program_dce"])
+    assert rep["ops_after"] == rep["ops_before"] - 2
+    # the update tail feeding the writeback source survived
+    assert any(op.name == "subtract" for op in opt.ops)
+    _assert_equiv(prog, opt, feeds, fetches)
+
+
+def test_fuse_composes_chains_and_respects_sharing():
+    def build(x, y):
+        a = paddle.matmul(x, y)     # single consumer -> fusable
+        b = paddle.tanh(a)
+        shared = paddle.add(b, y)   # two consumers -> barrier
+        c = paddle.scale(shared, scale=0.5)
+        d = paddle.abs(shared)
+        return [paddle.add(c, d)]
+    prog, feeds, fetches = _capture(build)
+    opt, rep = run_program_passes(prog, fetches, names=["program_fuse"])
+    assert rep["ops_after"] < rep["ops_before"]
+    names = [graph.op_display_name(op) for op in opt.ops]
+    # the matmul+tanh(+add) chain collapsed into one composite...
+    assert any("matmul+tanh" in n for n in names)
+    # ...but the shared tensor's producer was not duplicated or fused
+    # past its consumers
+    _assert_equiv(prog, opt, feeds, fetches)
+
+
+def test_fusion_hints_flag_norm_matmul_chains():
+    prog = Program()
+    x = Tensor(np.random.RandomState(0).randn(2, 8, 16)
+               .astype("float32"), name="x")
+    prog.add_placeholder("x", x)
+    ln = paddle.nn.LayerNorm(16)
+    lin = paddle.nn.Linear(16, 16)
+    with capture_ops(prog):
+        out = lin(ln(x))
+    opt, rep = run_program_passes(prog, [out], names=["program_fuse"])
+    kinds = {h["kind"] for h in opt.fusion_hints}
+    assert "norm_matmul" in kinds
+    assert all(h["claimable_by"] == "ops/pallas"
+               for h in opt.fusion_hints)
+
+
+def test_remat_and_donation_hints():
+    w = paddle.create_parameter([4, 4], "float32", name="w_hint")
+
+    def build(x, y):
+        cheap = paddle.add(x, y)              # cheap, multi-consumer
+        u = paddle.matmul(cheap, w)
+        v = paddle.matmul(w, cheap)
+        new_w = paddle.subtract(w, paddle.scale(u, scale=0.01))
+        build.new_w = new_w
+        return [u, v]
+    prog, feeds, fetches = _capture(build)
+    prog.writebacks.append((w, build.new_w))
+    opt, _ = run_program_passes(prog, fetches,
+                                names=["program_remat_hints"])
+    assert any(h["kind"] == "remat" and h["consumers"] >= 2
+               for h in opt.remat_hints)
+    assert any(h["kind"] == "donate" and h["external"] == "w_hint"
+               for h in opt.donation_hints)
+
+
+def test_remat_pass_conflicts_with_recompute_pass():
+    """PassManager incompatibility does real work across families."""
+    from paddle_tpu.distributed.passes import (PassContext, PassManager,
+                                               new_pass)
+    prog, _, fetches = _capture(lambda x, y: [paddle.add(x, y)])
+    manager = PassManager([new_pass("auto_parallel_recompute"),
+                           new_pass("program_remat_hints")])
+    with pytest.raises(ValueError, match="conflicts"):
+        manager.apply(prog, None, PassContext())
+
+
+# ---------------------------------------------------------------------------
+# verification harness (the PTL601 gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_registered_passes_verify_clean():
+    assert pass_check.verify_registered_passes() == []
+
+
+def test_pipeline_property_on_randomized_corpus():
+    for entry in pass_check.build_corpus(n=3, seed=11):
+        prog = entry["program"]
+        opt, rep = run_program_passes(prog, entry["fetches"],
+                                      names=DEFAULT_PIPELINE)
+        res = pass_check.check_equivalence(
+            prog, opt, entry["feed_names"], entry["fetches"],
+            entry["feed_arrays"])
+        assert res["allclose"], (entry["label"], res)
+        assert res["ops_after"] < res["ops_before"]
+
+
+def test_verifier_catches_a_broken_pass():
+    """A pass that drops a LIVE op must fail verification — the
+    verifier's reason to exist."""
+    from paddle_tpu.distributed.passes.pass_base import PASS_REGISTRY
+    from paddle_tpu.static.passes import PROGRAM_PASSES, ProgramPassBase
+
+    from paddle_tpu.distributed.passes import register_pass
+
+    @register_pass("program_break_everything")
+    class _Broken(ProgramPassBase):
+        def _apply_single_impl(self, main_program, startup, context):
+            before = list(main_program.ops)
+            # drop the FIRST op: a fetched value's ancestor, so the
+            # replay silently falls back to its stale capture-time data
+            main_program.ops = before[1:]
+            self._record_stats(context, main_program, before, 1)
+
+    PROGRAM_PASSES.append("program_break_everything")
+    try:
+        findings = pass_check.verify_pass("program_break_everything",
+                                          pass_check.build_corpus(1, 3))
+        assert findings and all(f.code == "PTL601" for f in findings)
+    finally:
+        PROGRAM_PASSES.remove("program_break_everything")
+        PASS_REGISTRY.pop("program_break_everything", None)
+
+
+def test_verifier_flags_unharnessed_registration():
+    from paddle_tpu.distributed.passes import register_pass
+    from paddle_tpu.distributed.passes.pass_base import PASS_REGISTRY
+    from paddle_tpu.static.passes import ProgramPassBase
+
+    @register_pass("program_sneaky_noop")
+    class _Sneaky(ProgramPassBase):
+        def _apply_single_impl(self, main_program, startup, context):
+            pass
+
+    try:
+        findings = pass_check.verify_registered_passes(
+            pass_check.build_corpus(1, 4), check_hazards=False)
+        assert any("program_sneaky_noop" in f.message and
+                   f.code == "PTL601" for f in findings)
+    finally:
+        PASS_REGISTRY.pop("program_sneaky_noop", None)
+
+
+@pytest.mark.lint
+def test_ptl602_flags_oprecord_mutation():
+    from paddle_tpu.analysis import lint_source
+    bad = ("def rewrite(ops):\n"
+           "    for op in ops:\n"
+           "        op.fn = None\n"
+           "        op.inputs.append(1)\n"
+           "        op.kwargs['k'] = 2\n")
+    fs = lint_source(bad, "paddle_tpu/static/passes/bad.py")
+    codes = [f.code for f in fs]
+    assert codes.count("PTL602") == 3
+    # out of scope: the same source elsewhere is not a pass file
+    assert "PTL602" not in [f.code for f in
+                            lint_source(bad, "paddle_tpu/other.py")]
+    ok = ("def rewrite(ops):\n"
+          "    out = [rebuild(op) for op in ops]\n"
+          "    prog.ops = out\n")
+    assert "PTL602" not in [
+        f.code for f in
+        lint_source(ok, "paddle_tpu/static/passes/ok.py")]
+
+
+@pytest.mark.lint
+def test_pass_rules_registered():
+    from paddle_tpu.analysis import RULES
+    assert RULES["PTL601"].severity == "error"
+    assert RULES["PTL602"].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# golden: captured GPT decode program
+# ---------------------------------------------------------------------------
+
+def _tiny_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(0)
+    cfg = GPTConfig(num_layers=2, hidden_size=64, num_heads=4,
+                    vocab_size=512, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def test_gpt_decode_program_shrinks_at_least_ten_pct(tmp_path):
+    model = _tiny_gpt()
+    ids = Tensor(np.random.RandomState(0)
+                 .randint(0, 512, (2, 8)).astype("int64"))
+    prog, feed_names, fetches, tok = capture_decode_program(model, ids)
+    paddle.set_flags({"FLAGS_observability_dir": str(tmp_path)})
+    try:
+        opt, rep = run_program_passes(prog, fetches, label="gpt_decode")
+    finally:
+        paddle.set_flags({"FLAGS_observability_dir": ""})
+    # the acceptance bar: >=10% replayed-op-count reduction, allclose
+    assert rep["reduction_pct"] >= 10.0, rep
+    res = pass_check.check_equivalence(prog, opt, feed_names, fetches,
+                                       [tok])
+    assert res["allclose"], res
+    # CSE+DCE alone also shrink-or-hold; fusion does the heavy lifting
+    assert any(h["kind"] == "norm_matmul" for h in opt.fusion_hints)
+    # graph_pass events landed, one per pass, schema-shaped
+    from paddle_tpu.observability.events import read_events
+    evs = read_events(str(tmp_path), kinds=["graph_pass"])
+    assert {e["pass_name"] for e in evs} == set(DEFAULT_PIPELINE)
+    assert all(e["program"] == "gpt_decode" for e in evs)
+    fuse = next(e for e in evs if e["pass_name"] == "program_fuse")
+    assert fuse["ops_before"] - fuse["ops_after"] == fuse["removed"] > 0
+
+
+def test_gpt_decode_golden_cse_dce_never_grow():
+    model = _tiny_gpt()
+    ids = Tensor(np.random.RandomState(1)
+                 .randint(0, 512, (2, 4)).astype("int64"))
+    prog, feed_names, fetches, tok = capture_decode_program(model, ids)
+    opt, rep = run_program_passes(
+        prog, fetches, names=["program_cse", "program_dce"])
+    assert rep["ops_after"] <= rep["ops_before"]
+    res = pass_check.check_equivalence(prog, opt, feed_names, fetches,
+                                       [tok])
+    assert res["allclose"]
+
+
+# ---------------------------------------------------------------------------
+# integration: Executor + SOT-lite behind FLAGS_program_passes
+# ---------------------------------------------------------------------------
+
+def test_executor_pipeline_parity(passes_flag):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("xp", [4, 4], "float32")
+        h = paddle.tanh(paddle.matmul(x, x))
+        paddle.multiply(h, h)                  # dead
+        out = paddle.add(h, paddle.add(x, x))
+    exe = static.Executor()
+    feed = {"xp": np.random.RandomState(3).randn(4, 4)
+            .astype("float32")}
+    r_on = exe.run(prog, feed=feed, fetch_list=[out])[0]
+    paddle.set_flags({"FLAGS_program_passes": ""})
+    r_off = exe.run(prog, feed=feed, fetch_list=[out])[0]
+    np.testing.assert_allclose(r_on, r_off, rtol=1e-6)
+
+
+def test_pipeline_names_parsing():
+    assert pipeline_names("") == ()
+    assert pipeline_names("1") == DEFAULT_PIPELINE
+    assert pipeline_names("default") == DEFAULT_PIPELINE
+    assert pipeline_names("program_dce, program_cse") == \
+        ("program_dce", "program_cse")
+    with pytest.raises(ValueError, match="unknown pass"):
+        pipeline_names("program_nope")
+    for name in DEFAULT_PIPELINE:
+        assert name in PROGRAM_PASSES
+
+
+def test_sot_segment_dce_parity_and_hazards(passes_flag):
+    """A graph-broken @to_static function with dead work inside a
+    segment: pass-optimized replay matches eager/off outputs, and the
+    re-run of graphcheck.inspect_static_fn shows no new hazards."""
+    from paddle_tpu.jit import to_static
+
+    def body(a):
+        b = paddle.tanh(a)
+        paddle.multiply(b, b)               # dead inside the segment
+        s = float(b.sum())                  # graph break
+        return paddle.add(b, paddle.to_tensor(np.float32(s)))
+
+    a = Tensor(np.random.RandomState(5).randn(3, 3).astype("float32"))
+    f_on = to_static(body)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f_on(a)
+        r_on = f_on(a)                      # compiled replay
+    hazards_on = pass_check.static_fn_hazard_codes(f_on)
+
+    paddle.set_flags({"FLAGS_program_passes": ""})
+    f_off = to_static(body)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f_off(a)
+        r_off = f_off(a)
+    hazards_off = pass_check.static_fn_hazard_codes(f_off)
+    np.testing.assert_allclose(np.asarray(r_on._data),
+                               np.asarray(r_off._data), rtol=1e-6)
+    assert hazards_on == hazards_off
+
+
+# ---------------------------------------------------------------------------
+# satellites: Program surface fixes
+# ---------------------------------------------------------------------------
+
+def test_list_vars_includes_op_produced_vars():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("lv_x", [4], "float32")
+        y = paddle.scale(x, scale=2.0)
+        y.name = "lv_y"
+        z = paddle.add(y, y)
+        z.name = "lv_z"
+    names = [t.name for t in prog.list_vars()]
+    assert "lv_x" in names and "lv_y" in names and "lv_z" in names
+    # parity with find_var_by_name's resolution surface
+    for n in ("lv_x", "lv_y", "lv_z"):
+        assert prog.find_var_by_name(n) is not None
+    # no duplicates
+    assert len(names) == len(set(names))
+
+
+def test_clone_for_test_drops_training_tail():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("ct_x", [4], "float32")
+        w = paddle.create_parameter([4], "float32", name="ct_w")
+        loss = paddle.sum(paddle.multiply(x, w))
+        (g,) = static.gradients([loss], [w])
+        new_w = paddle.subtract(w, paddle.scale(g, scale=0.1))
+    prog.writebacks.append((w, new_w))
+    test_prog = prog.clone(for_test=True)
+    assert test_prog.writebacks == []
+    assert len(test_prog.ops) < len(prog.ops)
+    assert not any(op.name == "grad" for op in test_prog.ops)
+    exe = static.Executor()
+    feed = {"ct_x": np.arange(4, dtype="float32")}
+    got = exe.run(test_prog, feed=feed, fetch_list=[loss])[0]
+    want = exe.run(prog, feed=feed, fetch_list=[loss])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # a train clone keeps the tail and the writebacks
+    train_prog = prog.clone(for_test=False)
+    assert len(train_prog.ops) == len(prog.ops)
+    assert len(train_prog.writebacks) == 1

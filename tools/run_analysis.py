@@ -9,9 +9,12 @@ distributed/checkpoint/, and inference/).  This is the scriptable twin
 of `pytest -m lint` for environments without pytest:
 
     python tools/run_analysis.py            # lint + registry + cost model
-                                            # + event schema
+                                            # + event schema + pass verify
     python tools/run_analysis.py --no-registry   # skip the registry pass
                                                  # (no jax import)
+    python tools/run_analysis.py --no-pass-verify  # skip the program-
+                                                 # pass replay-equivalence
+                                                 # gate (PTL601)
     python tools/run_analysis.py --no-cost-model # skip the tuning
                                                  # cost-model sanity pass
     python tools/run_analysis.py --no-metrics-schema  # skip the
@@ -53,6 +56,9 @@ def main(argv=None) -> int:
                          "explicit opt-in spelling")
     ap.add_argument("--no-metrics-schema", action="store_true",
                     help="skip the observability event-schema pass")
+    ap.add_argument("--no-pass-verify", action="store_true",
+                    help="skip the program-pass replay-equivalence "
+                         "verification (PTL601; imports jax)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("paths", nargs="*",
                     help="override the default lint targets")
@@ -78,6 +84,10 @@ def main(argv=None) -> int:
     if not args.no_metrics_schema:
         from paddle_tpu.analysis.obs_check import check_event_schema
         findings.extend(check_event_schema(_REPO))
+    if not args.no_pass_verify:
+        from paddle_tpu.analysis.pass_check import \
+            verify_registered_passes
+        findings.extend(verify_registered_passes())
 
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
     errors = [f for f in findings if f.severity == "error"]
@@ -90,7 +100,8 @@ def main(argv=None) -> int:
               f"{len(errors)} error(s) over {len(targets)} target(s)"
               + ("" if args.no_registry else " + registry")
               + ("" if args.no_cost_model else " + cost-model")
-              + ("" if args.no_metrics_schema else " + event-schema"))
+              + ("" if args.no_metrics_schema else " + event-schema")
+              + ("" if args.no_pass_verify else " + pass-verify"))
     return 1 if errors else 0
 
 
