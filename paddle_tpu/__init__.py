@@ -14,6 +14,13 @@ import os as _os
 # framework default float stays float32.
 import jax as _jax
 _jax.config.update("jax_enable_x64", True)
+if not hasattr(_jax, "enable_x64"):
+    # jax >= 0.4.27 removed the deprecated jax.enable_x64 alias; the
+    # Pallas kernels trace under `with jax.enable_x64(False)` (their
+    # literals must stay 32-bit with the global x64 default above), so
+    # restore the alias from its new home
+    from jax.experimental import enable_x64 as _enable_x64
+    _jax.enable_x64 = _enable_x64
 
 __version__ = "0.3.0"  # kept in sync with paddle.version.full_version
 

@@ -78,6 +78,15 @@ PASS_GLOBS = (
     "*/static/passes/*.py",
 )
 
+# Pallas kernel files (PTL603 scope): array constructors inside kernel
+# bodies (functions taking *_ref refs) must pin 32-bit dtypes — the
+# package runs with jax_enable_x64 on, so an unpinned literal under an
+# outer jit silently promotes to f64/i64
+KERNEL_GLOBS = (
+    "*/ops/pallas/*.py",
+    "*/ops/flash_attention.py",
+)
+
 _HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
 _HOST_CASTS = {"float", "int", "bool"}
 _TRACED_DECORATORS = {"to_static", "train_step", "TrainStep"}
@@ -602,6 +611,97 @@ def is_pass_path(path: str) -> bool:
     return any(fnmatch.fnmatch(p, g) for g in PASS_GLOBS)
 
 
+# jnp/np array constructors whose default dtype follows the x64 flag
+_UNPINNED_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange",
+                          "asarray", "array", "linspace", "eye"}
+_CONSTRUCTOR_ROOTS = {"jnp", "np", "numpy"}
+_DTYPE_LEAVES = {
+    "bool", "bool_", "int8", "int16", "int32", "int64", "uint8",
+    "uint16", "uint32", "uint64", "float16", "float32", "float64",
+    "bfloat16", "complex64", "complex128", "dtype",
+}
+# bare builtins as a dtype argument resolve to f64/i64 under x64 — the
+# hazard spelled differently, never a valid pin
+_AMBIGUOUS_DTYPE_NAMES = {"float", "int"}
+
+
+def _looks_like_dtype(node: ast.AST) -> Optional[bool]:
+    """True: a pinned dtype argument; False: an ambiguous (float/int)
+    one; None: not a dtype-shaped argument at all."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True                      # explicit 'float32'/'int64'
+    if isinstance(node, ast.Name):
+        if node.id in _AMBIGUOUS_DTYPE_NAMES:
+            return False
+        return True if node.id in _DTYPE_LEAVES else None
+    if isinstance(node, ast.Attribute):
+        leaf = node.attr
+        if leaf in _DTYPE_LEAVES:
+            return True                  # jnp.float32, x.dtype, ...
+        return None
+    return None
+
+
+class _KernelLiteralHygiene(ast.NodeVisitor):
+    """PTL603: unpinned array-constructor literals inside Pallas kernel
+    bodies (functions taking ``*_ref`` refs), scoped to KERNEL_GLOBS.
+    With jax_enable_x64 globally on, ``jnp.zeros(shape)`` /
+    ``jnp.arange(n)`` traced under an outer jit materialize f64/i64."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+        self._kernel_depth = 0
+
+    def _visit_func(self, node):
+        is_kernel = any(a.arg.endswith("_ref")
+                        for a in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs))
+        self._kernel_depth += 1 if is_kernel else 0
+        for child in node.body:
+            self.visit(child)
+        self._kernel_depth -= 1 if is_kernel else 0
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node):
+        if self._kernel_depth:
+            dotted = _dotted(node.func)
+            parts = (dotted or "").split(".")
+            if len(parts) == 2 and parts[0] in _CONSTRUCTOR_ROOTS \
+                    and parts[1] in _UNPINNED_CONSTRUCTORS:
+                verdicts = [_looks_like_dtype(a) for a in node.args]
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        verdicts.append(_looks_like_dtype(kw.value))
+                if any(v is False for v in verdicts):
+                    self.findings.append(make_finding(
+                        "PTL603",
+                        f"{dotted}() in a Pallas kernel body pins its "
+                        "dtype with bare float/int — that resolves to "
+                        "f64/i64 under the global x64 default; use the "
+                        "explicit 32-bit jnp dtype",
+                        file=self.filename, line=node.lineno,
+                        col=node.col_offset))
+                elif not any(v is True for v in verdicts):
+                    self.findings.append(make_finding(
+                        "PTL603",
+                        f"{dotted}() in a Pallas kernel body has no "
+                        "pinned dtype — under an outer jit with the "
+                        "global x64 default this materializes "
+                        "f64/i64; pass jnp.float32/jnp.int32 "
+                        "explicitly",
+                        file=self.filename, line=node.lineno,
+                        col=node.col_offset))
+        self.generic_visit(node)
+
+
+def is_kernel_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in KERNEL_GLOBS)
+
+
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> None (bare noqa: suppress all) | set of codes."""
     out: Dict[int, Optional[Set[str]]] = {}
@@ -653,6 +753,10 @@ def lint_source(source: str, filename: str = "<string>",
         passes = _PassHygiene(filename)
         passes.visit(tree)
         findings.extend(passes.findings)
+    if is_kernel_path(filename):
+        kernels = _KernelLiteralHygiene(filename)
+        kernels.visit(tree)
+        findings.extend(kernels.findings)
     noqa = _collect_noqa(source)
     out = []
     for f in findings:

@@ -327,6 +327,21 @@ _rule(
     "op.inputs/op.outputs or calling mutators on them; a deliberate "
     "edit takes '# noqa: PTL602' with a reason comment.")
 _rule(
+    "PTL603", "unpinned-kernel-literal", ERROR,
+    "array constructor without a pinned dtype inside a Pallas kernel "
+    "body",
+    "The package runs with jax_enable_x64 globally on; inside a kernel "
+    "traced under an OUTER jit, an unpinned constructor literal "
+    "(jnp.zeros(shape), jnp.arange(n), jnp.full(s, -1e9)) silently "
+    "materializes f64/i64 — Mosaic either rejects the lowering or the "
+    "promotion spreads through the kernel (jax 0.4.37 behavior; the "
+    "kernels' enable_x64(False) wrapper only covers values created "
+    "inside it, not literals traced from the caller).",
+    "Pin every constructor: jnp.zeros(shape, jnp.float32), "
+    "jnp.full(s, v, jnp.float32), broadcasted_iota(jnp.int32, ...); "
+    "bare float/int as a dtype argument is the same hazard spelled "
+    "differently — use the explicit 32-bit jnp dtype.")
+_rule(
     "PTL301", "cost-model-sanity", ERROR,
     "tuning cost model violates a physical invariant",
     "The analytic model (paddle_tpu.tuning.cost_model) prunes which "

@@ -152,6 +152,24 @@ define_flag("use_pallas_adamw", True,
             "route the AdamW update through the fused Pallas kernel on TPU")
 define_flag("use_pallas_rope", True,
             "route rotary embedding through the fused Pallas kernel on TPU")
+define_flag("use_pallas_fused_decode", True,
+            "route the compiled decode loop's per-token body through the "
+            "fused Pallas decode kernels (rope+QKV, attention+cache-"
+            "append, norm+MLP) on TPU; the jnp reference composition "
+            "runs elsewhere")
+define_flag("megakernel_decode", False,
+            "generate() runs the whole token loop as ONE jitted "
+            "lax.while_loop program (models/generation.decode_loop): "
+            "preallocated token buffer, donated KV-cache carries, "
+            "on-device sampling + EOS tracking — zero host transfers "
+            "per token.  Beam search, paged caches and models without "
+            "a decode-step builder fall back to the eager loop "
+            "(observable via the decode_loop event)")
+define_flag("eager_finished_sync_every", 8,
+            "eager decode loop: poll finished.all() on the host only "
+            "every K generated tokens (the exact eager stop point is "
+            "reconstructed from the token buffer, so outputs are "
+            "unchanged); 1 restores the per-token sync")
 def _apply_transfer_guard(val: str):
     """Race-detection aid (SURVEY.md §5): surface implicit host<->device
     transfers — the TPU analogue of the reference's stream-safety

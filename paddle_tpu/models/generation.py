@@ -8,6 +8,25 @@ Pallas flash kernel's bottom-right causal alignment (q_offset) was
 built for.  Sampling draws from the framework RNG (``paddle.seed``
 deterministic).
 
+Two decode engines share this module:
+
+* the **eager loop** — one host-dispatched model call per token,
+  writing into a preallocated token buffer (``lax.dynamic_update_slice``
+  — no O(n²) concat growth) with the ``finished.all()`` host sync
+  hoisted to every ``FLAGS_eager_finished_sync_every`` tokens (the
+  exact eager stop column is reconstructed from the buffer, so outputs
+  are unchanged);
+* the **compiled mega-kernel loop** (``decode_loop``, behind
+  ``FLAGS_megakernel_decode`` — MPK, PAPERS.md arXiv 2512.22219): the
+  whole token loop runs inside ONE jitted ``lax.while_loop`` whose body
+  is the model's cache-aware single-token step built from the fused
+  Pallas decode kernels (``ops/pallas/fused_decode``), with on-device
+  sampling and EOS tracking — zero host transfers per token, KV caches
+  donated to the loop carry.  Beam search / paged caches / models
+  without a ``build_decode_step`` fall back to the eager loop; every
+  call emits a ``decode_loop`` observability event saying which engine
+  ran.
+
 Models without cache plumbing fall back to full-prefix recompute per
 step (``use_cache=False``) — identical tokens, O(n^2) instead of O(n).
 """
@@ -20,14 +39,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..flags import get_flag
 from ..random_state import default_generator
 
-__all__ = ["generate"]
+__all__ = ["generate", "decode_loop"]
+
+_GREEDY = ("greedy_search", "greedy")
 
 
-def _sample(logits_row, decode_strategy, temperature, top_k, top_p):
-    """One next-token choice from [B, V] logits."""
-    if decode_strategy in ("greedy_search", "greedy"):
+def _sample_logits(logits_row, key, decode_strategy, temperature, top_k,
+                   top_p):
+    """One next-token choice from [B, V] logits — pure jnp, the key
+    passed explicitly so the SAME function is the eager sampler and the
+    compiled loop body's sampler (token-for-token parity by
+    construction)."""
+    if decode_strategy in _GREEDY:
         return jnp.argmax(logits_row, axis=-1)
     logits = logits_row.astype(jnp.float32)
     if temperature and temperature != 1.0:
@@ -43,8 +69,15 @@ def _sample(logits_row, decode_strategy, temperature, top_k, top_p):
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    key = default_generator.next_key()
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def _sample(logits_row, decode_strategy, temperature, top_k, top_p):
+    """Eager-path sampler: draws its key from the framework RNG."""
+    key = None if decode_strategy in _GREEDY \
+        else default_generator.next_key()
+    return _sample_logits(logits_row, key, decode_strategy, temperature,
+                          top_k, top_p)
 
 
 def _reorder_past(past, beam_idx):
@@ -239,6 +272,149 @@ def _to_paged(past, batch, max_total):
     return views
 
 
+# ---------------------------------------------------------------------------
+# the compiled mega-kernel decode engine
+# ---------------------------------------------------------------------------
+
+def _megakernel_fallback_reason(model, decode_strategy, num_beams,
+                                use_paged_cache, supports_cache,
+                                max_new_tokens) -> Optional[str]:
+    """None when the compiled loop can run this request; else the
+    (stable, event-logged) reason the eager loop runs instead."""
+    if num_beams > 1:
+        return "beam_search"
+    if decode_strategy not in _GREEDY + ("sampling",):
+        return f"strategy:{decode_strategy}"
+    if use_paged_cache:
+        return "paged_cache"
+    if not supports_cache:
+        return "no_kv_cache"
+    if not hasattr(model, "build_decode_step"):
+        return "no_decode_step_builder"
+    if int(max_new_tokens) <= 0:
+        return "nothing_to_generate"
+    return None
+
+
+def _build_decode_program(step_fn, *, s_prompt, max_new, strategy,
+                          temperature, top_k, top_p, eos_token_id):
+    """One jitted program running the ENTIRE token loop in a
+    lax.while_loop — sample on device, track EOS on device, step the
+    model through the fused decode kernels.  The preallocated token
+    buffer and KV caches are DONATED loop carries (they are also
+    outputs, so XLA reuses their buffers in place across the loop —
+    the donation_hints follow-on from the pass pipeline)."""
+    sampling = strategy not in _GREEDY
+
+    def program(params, tokens, caches, last_logits, key):
+        b = tokens.shape[0]
+
+        def cond(carry):
+            i, _, finished, _, _, _ = carry
+            live = i < max_new
+            if eos_token_id is not None:
+                live = jnp.logical_and(
+                    live, jnp.logical_not(jnp.all(finished)))
+            return live
+
+        def body(carry):
+            i, tokens, finished, key, logits, caches = carry
+            sub = None
+            if sampling:
+                key, sub = jax.random.split(key)
+            nxt = _sample_logits(logits, sub, strategy, temperature,
+                                 top_k, top_p)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None].astype(tokens.dtype),
+                (jnp.int32(0), jnp.int32(s_prompt) + i))
+            pos = jnp.int32(s_prompt) + i
+            logits, caches = step_fn(params, nxt, caches, pos)
+            return (i + jnp.int32(1), tokens, finished, key, logits,
+                    caches)
+
+        init = (jnp.int32(0), tokens,
+                jnp.zeros((b,), bool), key, last_logits, caches)
+        i, tokens, _, key, _, caches = jax.lax.while_loop(cond, body,
+                                                          init)
+        return tokens, i, key, caches
+
+    # CPU has no donation support (jax warns and ignores) — donate only
+    # where it buys the in-place carry reuse
+    donate = (1, 2) if jax.default_backend() != "cpu" else ()
+    return jax.jit(program, donate_argnums=donate)
+
+
+def _compiled_decode(model, arr, max_new_tokens, decode_strategy,
+                     temperature, top_k, top_p, eos_token_id,
+                     last_only):
+    """Prefill eagerly once, then hand the whole token loop to the
+    cached jitted program.  Exactly ONE host sync (the generated-token
+    count, to slice the buffer) per call."""
+    kw = {"last_logits_only": True} if last_only else {}
+    logits, past = model(Tensor(arr), use_cache=True, **kw)
+    params, step_fn = model.build_decode_step()
+    last_logits = jnp.asarray(logits._data)[:, -1, :]
+    sampling = decode_strategy not in _GREEDY
+    key = default_generator.get_state() if sampling \
+        else jax.random.PRNGKey(0)
+
+    # preallocate the full [B, S_prompt+max_new] token buffer and the
+    # fixed-shape KV caches — donated to the program, so the loop
+    # updates them in place on accelerator backends
+    b, s_prompt = int(arr.shape[0]), int(arr.shape[1])
+    s_total = s_prompt + int(max_new_tokens)
+    tokens = jnp.zeros((b, s_total), arr.dtype)
+    tokens = jax.lax.dynamic_update_slice(tokens, arr, (0, 0))
+    caches = []
+    for k, v in past:
+        ka, va = jnp.asarray(k._data), jnp.asarray(v._data)
+        kc = jnp.zeros((b, s_total) + ka.shape[2:], ka.dtype)
+        vc = jnp.zeros((b, s_total) + va.shape[2:], va.dtype)
+        caches.append(
+            (jax.lax.dynamic_update_slice(kc, ka, (0, 0, 0, 0)),
+             jax.lax.dynamic_update_slice(vc, va, (0, 0, 0, 0))))
+    caches = tuple(caches)
+
+    programs = model.__dict__.setdefault("_megakernel_programs", {})
+    ckey = (tuple(arr.shape), str(arr.dtype), int(max_new_tokens),
+            str(decode_strategy), float(temperature or 1.0),
+            int(top_k or 0), float(top_p or 1.0),
+            None if eos_token_id is None else int(eos_token_id),
+            tuple((tuple(k.shape), str(k.dtype)) for k, _ in caches),
+            # kernel routing is decided at trace time — a flag flip
+            # must build a fresh program, not replay the stale route
+            bool(get_flag("use_pallas_fused_decode")),
+            bool(get_flag("pallas_interpret")))
+    prog = programs.get(ckey)
+    if prog is None:
+        prog = _build_decode_program(
+            step_fn, s_prompt=s_prompt,
+            max_new=int(max_new_tokens), strategy=decode_strategy,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id)
+        programs[ckey] = prog
+    tokens, n_steps, key_out, _ = prog(params, tokens, caches,
+                                       last_logits, key)
+    n = int(n_steps)                       # the one host sync
+    if sampling:
+        default_generator.set_state(key_out)
+    return tokens[:, :s_prompt + n], n
+
+
+def decode_loop(model, input_ids, **kwargs):
+    """The compiled mega-kernel decode entry: ``generate`` with the
+    whole token loop inside one jitted ``lax.while_loop`` (fused
+    rope+QKV / attention+cache-append / norm+MLP kernels, on-device
+    sampling + EOS, donated KV carries — zero host transfers per
+    token).  Unsupported requests (beam search, paged cache, models
+    without ``build_decode_step``) fall back to the eager loop; the
+    ``decode_loop`` observability event records which engine ran."""
+    return generate(model, input_ids, _megakernel=True, **kwargs)
+
+
 def generate(model, input_ids, max_new_tokens: int = 20,
              max_length: Optional[int] = None,
              decode_strategy: str = "greedy_search",
@@ -247,9 +423,12 @@ def generate(model, input_ids, max_new_tokens: int = 20,
              num_beams: int = 1, length_penalty: float = 1.0,
              pad_token_id: Optional[int] = None,
              use_cache: bool = True, use_paged_cache: bool = False,
+             _megakernel: Optional[bool] = None,
              **unused):
     """Returns a Tensor [B, S_prompt + n_generated] of token ids."""
     import inspect
+
+    from ..observability import events
     ids = input_ids if isinstance(input_ids, Tensor) else Tensor(
         np.asarray(input_ids))
     if max_length is not None:
@@ -271,11 +450,34 @@ def generate(model, input_ids, max_new_tokens: int = 20,
     params = inspect.signature(fwd).parameters
     supports_cache = use_cache and "use_cache" in params
     last_only = supports_cache and "last_logits_only" in params
+    mk_requested = bool(get_flag("megakernel_decode")) \
+        if _megakernel is None else bool(_megakernel)
+    mk_reason = _megakernel_fallback_reason(
+        model, decode_strategy, num_beams, use_paged_cache,
+        supports_cache, max_new_tokens) if mk_requested else None
     was_training = getattr(model, "training", False)
     if hasattr(model, "eval"):
         model.eval()
     try:
         arr = jnp.asarray(ids._data)
+        if mk_requested and mk_reason is None:
+            out, n_gen = _compiled_decode(
+                model, arr, max_new_tokens, decode_strategy,
+                temperature, top_k, top_p, eos_token_id, last_only)
+            events.emit("decode_loop", model=type(model).__name__,
+                        batch=int(arr.shape[0]),
+                        prompt_len=int(arr.shape[1]),
+                        max_new_tokens=int(max_new_tokens),
+                        generated=n_gen, strategy=decode_strategy,
+                        compiled=True)
+            return Tensor(out)
+        if mk_requested:
+            events.emit("decode_loop", model=type(model).__name__,
+                        batch=int(arr.shape[0]),
+                        prompt_len=int(arr.shape[1]),
+                        max_new_tokens=int(max_new_tokens),
+                        strategy=decode_strategy, compiled=False,
+                        fallback=mk_reason)
         # num_beams == 1 beam_search degenerates to greedy (the HF /
         # PaddleNLP convention)
         if num_beams > 1:
@@ -310,21 +512,48 @@ def generate(model, input_ids, max_new_tokens: int = 20,
                                  arr.shape[1] + int(max_new_tokens))
         else:
             logits = model(Tensor(arr))
-        for _ in range(int(max_new_tokens)):
+        # eager loop over a PREALLOCATED buffer: one dynamic_update_slice
+        # per token instead of an O(n²) concat chain, and the
+        # finished.all() host sync hoisted to every K tokens
+        s_prompt = int(arr.shape[1])
+        max_new = int(max_new_tokens)
+        buf = jnp.zeros((arr.shape[0], s_prompt + max_new), arr.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, arr, (0, 0))
+        cur = s_prompt
+        sync_every = max(int(get_flag("eager_finished_sync_every")
+                             or 1), 1)
+        stopped = False
+        for it in range(max_new):
             nxt = _sample(jnp.asarray(logits._data)[:, -1, :],
                           decode_strategy, temperature, top_k, top_p)
             if eos_token_id is not None:
                 nxt = jnp.where(finished, eos_token_id, nxt)
                 finished = finished | (nxt == eos_token_id)
-            arr = jnp.concatenate([arr, nxt[:, None].astype(arr.dtype)],
-                                  axis=1)
-            if eos_token_id is not None and bool(finished.all()):
+            buf = jax.lax.dynamic_update_slice(
+                buf, nxt[:, None].astype(buf.dtype), (0, cur))
+            cur += 1
+            if eos_token_id is not None and \
+                    (it == max_new - 1
+                     or it % sync_every == sync_every - 1) and \
+                    bool(finished.all()):
+                stopped = True
                 break
-            if supports_cache:
-                logits, past = model(Tensor(arr[:, -1:]), past=past,
-                                     use_cache=True)
-            else:
-                logits = model(Tensor(arr))
+            if it < max_new - 1:
+                if supports_cache:
+                    logits, past = model(Tensor(buf[:, cur - 1:cur]),
+                                         past=past, use_cache=True)
+                else:
+                    logits = model(Tensor(buf[:, :cur]))
+        if stopped:
+            # reconstruct the exact per-token stop column: every row
+            # finished at its FIRST generated eos, and the original
+            # loop broke right after the last row finished — columns
+            # past that point are all-eos padding the hoisted sync let
+            # through
+            gen = np.asarray(buf[:, s_prompt:cur])
+            first_eos = (gen == eos_token_id).argmax(axis=1)
+            cur = s_prompt + int(first_eos.max()) + 1
+        arr = buf[:, :cur]
     finally:
         if was_training and hasattr(model, "train"):
             model.train()

@@ -286,6 +286,81 @@ class GPTForPretraining(nn.Layer):
         from .generation import generate
         return generate(self, input_ids, **kwargs)
 
+    def build_decode_step(self):
+        """Cache-aware single-token forward usable under trace (the
+        compiled ``decode_loop``'s per-token body): returns
+        ``(params, step_fn)`` where ``step_fn(params, tok [B], caches,
+        pos) -> (logits [B, V], caches)`` is a pure jnp function over
+        FIXED-shape preallocated caches ``[B, S_total, nh, hd]`` —
+        shapes never grow, so the whole loop lives in one
+        ``lax.while_loop``.  Params ride as jit arguments (weight
+        updates between calls never retrace)."""
+        return _build_gpt_decode_step(self)
+
+
+def _build_gpt_decode_step(model: "GPTForPretraining"):
+    import jax.numpy as jnp
+
+    from ..ops.pallas import fused_decode as _fd
+
+    c = model.config
+    gpt = model.gpt
+    H = c.hidden_size
+    nh = c.num_heads
+    hd = H // nh
+    tied = bool(c.tie_word_embeddings)
+
+    blocks = []
+    for blk in gpt.layers:
+        qkv_w = blk.attn.qkv_proj.weight._data        # [H, 3H], packed
+        qkv_b = blk.attn.qkv_proj.bias._data          # (3, nh, hd) cols
+        blocks.append({
+            "ln1_w": blk.ln1.weight._data, "ln1_b": blk.ln1.bias._data,
+            "wq": qkv_w[:, :H], "wk": qkv_w[:, H:2 * H],
+            "wv": qkv_w[:, 2 * H:],
+            "bq": qkv_b[:H], "bk": qkv_b[H:2 * H], "bv": qkv_b[2 * H:],
+            "wo": blk.attn.out_proj.weight._data,
+            "bo": blk.attn.out_proj.bias._data,
+            "ln2_w": blk.ln2.weight._data, "ln2_b": blk.ln2.bias._data,
+            "w1": blk.mlp.fc1.weight._data, "b1": blk.mlp.fc1.bias._data,
+            "w2": blk.mlp.fc2.weight._data, "b2": blk.mlp.fc2.bias._data,
+        })
+    params = {
+        "wte": gpt.embeddings.word_embeddings.weight._data,
+        "wpe": gpt.embeddings.position_embeddings.weight._data,
+        "blocks": blocks,
+        "lnf_w": gpt.final_ln.weight._data,
+        "lnf_b": gpt.final_ln.bias._data,
+        "lm_w": None if tied else model.lm_head_weight._data,
+    }
+
+    def step_fn(p, tok, caches, pos):
+        x = jnp.take(p["wte"], tok, axis=0) \
+            + jnp.take(p["wpe"], pos, axis=0)
+        new_caches = []
+        for i, bp in enumerate(p["blocks"]):
+            h = _fd.reference_layer_norm(x, bp["ln1_w"], bp["ln1_b"],
+                                         1e-5)
+            q, k, v = _fd.rope_qkv(h, bp["wq"], bp["wk"], bp["wv"],
+                                   bp["bq"], bp["bk"], bp["bv"],
+                                   n_heads=nh, n_kv=nh, head_dim=hd)
+            ctx, kc, vc = _fd.attend_cache_append(
+                q, k, v, caches[i][0], caches[i][1], pos)
+            new_caches.append((kc, vc))
+            x = x + (jnp.matmul(ctx.reshape(-1, H), bp["wo"])
+                     + bp["bo"])
+            x = x + _fd.norm_mlp(x, kind="layer_norm",
+                                 norm_w=bp["ln2_w"], norm_b=bp["ln2_b"],
+                                 w1=bp["w1"], b1=bp["b1"],
+                                 w2=bp["w2"], b2=bp["b2"],
+                                 eps=1e-5, act="gelu_tanh")
+        h = _fd.reference_layer_norm(x, p["lnf_w"], p["lnf_b"], 1e-5)
+        w = p["wte"] if tied else p["lm_w"]
+        logits = jnp.matmul(h, jnp.swapaxes(w, -1, -2))
+        return logits, tuple(new_caches)
+
+    return params, step_fn
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """Next-token cross entropy (vocab-parallel safe)."""

@@ -344,6 +344,87 @@ class LlamaForCausalLM(nn.Layer):
         from .generation import generate
         return generate(self, input_ids, **kwargs)
 
+    def build_decode_step(self):
+        """Cache-aware single-token forward usable under trace (the
+        compiled ``decode_loop``'s per-token body): returns
+        ``(params, step_fn)`` with ``step_fn(params, tok [B], caches,
+        pos) -> (logits [B, V], caches)`` pure over FIXED-shape
+        ``[B, S_total, n_kv, hd]`` caches — rope rows gathered at
+        ``pos``, GQA heads expanded inside the fused attention."""
+        return _build_llama_decode_step(self)
+
+
+def _build_llama_decode_step(model: "LlamaForCausalLM"):
+    from ..ops.pallas import fused_decode as _fd
+
+    c = model.config
+    llama = model.llama
+    nh = c.num_heads
+    nkv = c.num_kv_heads
+    hd = c.hidden_size // nh
+    tied = bool(c.tie_word_embeddings)
+    act = c.hidden_act
+    eps = float(c.rms_eps)
+    scale = float(c.embed_scale)
+
+    layers = []
+    for lyr in llama.layers:
+        att = lyr.self_attn
+        layers.append({
+            "ln1_w": lyr.input_layernorm.weight._data,
+            "wq": att.q_proj.weight._data,
+            "wk": att.k_proj.weight._data,
+            "wv": att.v_proj.weight._data,
+            "bq": None if att.q_proj.bias is None
+            else att.q_proj.bias._data,
+            "bk": None if att.k_proj.bias is None
+            else att.k_proj.bias._data,
+            "bv": None if att.v_proj.bias is None
+            else att.v_proj.bias._data,
+            "wo": att.o_proj.weight._data,
+            "ln2_w": lyr.post_attention_layernorm.weight._data,
+            "wg": lyr.mlp.gate_proj.weight._data,
+            "wu": lyr.mlp.up_proj.weight._data,
+            "wd": lyr.mlp.down_proj.weight._data,
+        })
+    # the rope tables are identical across layers (same config)
+    att0 = llama.layers[0].self_attn
+    params = {
+        "embed": llama.embed_tokens.weight._data,
+        "cos": att0._cos, "sin": att0._sin,
+        "layers": layers,
+        "norm_w": llama.norm.weight._data,
+        "lm_w": None if tied else model.lm_head_weight._data,
+    }
+
+    def step_fn(p, tok, caches, pos):
+        x = jnp.take(p["embed"], tok, axis=0)
+        if scale != 1.0:
+            x = x * scale
+        cos_row = jnp.take(p["cos"], pos, axis=0)     # [hd]
+        sin_row = jnp.take(p["sin"], pos, axis=0)
+        new_caches = []
+        for i, lp in enumerate(p["layers"]):
+            h = _fd.reference_rms_norm(x, lp["ln1_w"], eps)
+            q, k, v = _fd.rope_qkv(h, lp["wq"], lp["wk"], lp["wv"],
+                                   lp["bq"], lp["bk"], lp["bv"],
+                                   cos_row, sin_row, n_heads=nh,
+                                   n_kv=nkv, head_dim=hd, neox=False)
+            ctx, kc, vc = _fd.attend_cache_append(
+                q, k, v, caches[i][0], caches[i][1], pos)
+            new_caches.append((kc, vc))
+            x = x + jnp.matmul(ctx.reshape(-1, nh * hd), lp["wo"])
+            x = x + _fd.norm_mlp(x, kind="rms_norm",
+                                 norm_w=lp["ln2_w"], w_gate=lp["wg"],
+                                 w1=lp["wu"], w2=lp["wd"], eps=eps,
+                                 act=act)
+        h = _fd.reference_rms_norm(x, p["norm_w"], eps)
+        w = p["embed"] if tied else p["lm_w"]
+        logits = jnp.matmul(h, jnp.swapaxes(w, -1, -2))
+        return logits, tuple(new_caches)
+
+    return params, step_fn
+
 
 class LlamaPretrainingCriterion(nn.Layer):
     """Next-token CE, vocab-parallel safe (ref: same name)."""

@@ -96,6 +96,12 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
                    "op_class_delta": "object", "allclose": "bool"},
     # inference server lifecycle (per-request traffic lives in metrics)
     "serving": {"action": "str", "url": "str"},
+    # one generate() call routed through the mega-kernel decode gate
+    # (models/generation): which engine ran and why
+    "decode_loop": {"model": "str", "batch": "int", "prompt_len": "int",
+                    "max_new_tokens": "int", "generated": "int",
+                    "strategy": "str", "compiled": "bool",
+                    "fallback": "str"},
 }
 
 _lock = threading.Lock()

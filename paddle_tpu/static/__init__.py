@@ -232,12 +232,46 @@ class Executor:
                     names=pipeline_names(passes_flag))
             pure, externals = run_program.build_replay(
                 feed_names, fetch_tensors + wb_sources)
-            fn = jax.jit(lambda f, e: pure(f, e))
-            entry = (fn, externals)
+            # donation_hints follow-on: a writeback target's buffer is
+            # dead the moment the new value commits — split those
+            # externals into their own argument and donate it to XLA
+            # (the replay's output has the same shape/dtype, so the
+            # buffer is reused in place).  CPU has no donation support.
+            don_idx: list = []
+            if getattr(run_program, "donation_hints", None):
+                wb_ids = {id(t) for t, _ in program.writebacks}
+                don_idx = [i for i, t in enumerate(externals)
+                           if id(t) in wb_ids]
+            if don_idx:
+                keep_idx = [i for i in range(len(externals))
+                            if i not in set(don_idx)]
+                n_ext = len(externals)
+
+                def rejoin(feed, kept, donated, _k=tuple(keep_idx),
+                           _d=tuple(don_idx), _n=n_ext):
+                    ext = [None] * _n
+                    for pos, a in zip(_k, kept):
+                        ext[pos] = a
+                    for pos, a in zip(_d, donated):
+                        ext[pos] = a
+                    return pure(feed, tuple(ext))
+
+                donate_kw = {} if jax.default_backend() == "cpu" \
+                    else {"donate_argnums": (2,)}
+                fn = jax.jit(rejoin, **donate_kw)
+            else:
+                keep_idx = list(range(len(externals)))
+                fn = jax.jit(lambda f, e: pure(f, e))
+            entry = (fn, externals, tuple(keep_idx), tuple(don_idx))
             self._cache[key] = entry
-        fn, externals = entry
+        fn, externals, keep_idx, don_idx = entry
         ext_arrays = [t._data for t in externals]
-        outs = fn(tuple(feed_arrays), tuple(ext_arrays))
+        if don_idx:
+            outs = fn(tuple(feed_arrays),
+                      tuple(ext_arrays[i] for i in keep_idx),
+                      tuple(ext_arrays[i] for i in don_idx))
+        else:
+            outs = fn(tuple(feed_arrays), tuple(ext_arrays))
         n_fetch = len(fetch_tensors)
         for (target, _), val in zip(program.writebacks, outs[n_fetch:]):
             target._data = val

@@ -37,7 +37,8 @@ from ..capture import Program, capture_ops
 from . import graph
 from .graph import (collect_donation_hints, collect_fusion_hints,
                     collect_remat_hints, default_root_ids, op_class,
-                    op_class_delta, op_class_histogram, run_cse,
+                    op_class_delta, op_class_histogram,
+                    run_claim_fused_kernels, run_cse,
                     run_constant_fold, run_dce, run_fuse)
 
 __all__ = [
@@ -48,10 +49,12 @@ __all__ = [
 ]
 
 # registration order == default pipeline order: CSE first exposes
-# constants (merged duplicates), folding shrinks what DCE walks, fusion
-# runs on the cleaned graph, hints annotate the final shape
+# constants (merged duplicates), folding shrinks what DCE walks, kernel
+# claiming rewrites flagged chains onto real fused kernels BEFORE the
+# generic fuser composes them away, hints annotate the final shape
 DEFAULT_PIPELINE = ("program_cse", "program_constant_fold", "program_dce",
-                    "program_fuse", "program_remat_hints")
+                    "program_claim_fused_kernels", "program_fuse",
+                    "program_remat_hints")
 
 # every program-level pass name (the PTL601 verifier iterates this)
 PROGRAM_PASSES: List[str] = []
@@ -132,6 +135,26 @@ class ProgramDCEPass(ProgramPassBase):
         self._record_stats(context, main_program, before, removed)
 
 
+@_program_pass("program_claim_fused_kernels")
+class ProgramClaimFusedKernelsPass(ProgramPassBase):
+    """Let the ops/pallas fused kernels CLAIM the flagged norm→matmul
+    ``fusion_hints`` chains: each accepted claim replaces the two
+    records with ONE record replaying through
+    ``ops.pallas.fused_decode.norm_matmul`` (Pallas on eligible
+    backends, reference composition elsewhere).  Claims are validated
+    numerically against the capture-time values before acceptance —
+    see graph.run_claim_fused_kernels."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        before = list(main_program.ops)
+        main_program.ops, claimed = run_claim_fused_kernels(
+            before, self._roots(main_program, context))
+        main_program.fusion_hints = (list(main_program.fusion_hints)
+                                     + claimed)
+        self._record_stats(context, main_program, before, len(claimed),
+                           hints=len(claimed))
+
+
 @_program_pass("program_fuse")
 class ProgramFusePass(ProgramPassBase):
     """Compose single-consumer op chains into one replay record each
@@ -149,9 +172,12 @@ class ProgramFusePass(ProgramPassBase):
             removed = 0
         main_program.ops = ops
         # hints describe the CAPTURED chains (pre-rewrite indices) —
-        # the rewrite collapses exactly the pairs a claimant would scan
+        # the rewrite collapses exactly the pairs a claimant would
+        # scan; chains already claimed by the kernel-claim pass are
+        # preserved (appended) rather than overwritten
         hints = collect_fusion_hints(before)
-        main_program.fusion_hints = hints
+        main_program.fusion_hints = (list(main_program.fusion_hints)
+                                     + hints)
         self._record_stats(context, main_program, before, removed,
                            hints=len(hints))
 

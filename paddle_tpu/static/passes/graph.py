@@ -464,6 +464,150 @@ def run_fuse(ops: Sequence[_OpRecord], root_ids: Set[int],
         total += len(absorbed)
 
 
+_MISSING = object()
+
+
+def _free_value(fn: Callable, name: str) -> Any:
+    """Value of a closure cell by name, or _MISSING."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return _MISSING
+    try:
+        idx = code.co_freevars.index(name)
+    except ValueError:
+        return _MISSING
+    try:
+        return (fn.__closure__ or ())[idx].cell_contents
+    except (IndexError, ValueError):
+        return _MISSING
+
+
+def _make_claimed_fn(kind: str, eps: float, has_norm_bias: bool,
+                     transpose_y: bool, has_mm_bias: bool) -> Callable:
+    """Replay fn for a claimed norm→matmul chain: routes through the
+    fused Pallas ``norm_matmul`` kernel when its gate allows, else the
+    reference composition with the same numerics."""
+    n_norm = 3 if has_norm_bias else 2
+
+    def claimed_norm_matmul(*xs):
+        import jax.numpy as jnp
+
+        from ...ops.pallas import fused_decode as _fd
+        x, nw = xs[0], xs[1]
+        nb = xs[2] if has_norm_bias else None
+        w = xs[n_norm]
+        bias = xs[n_norm + 1] if has_mm_bias else None
+        if transpose_y:
+            w = jnp.swapaxes(w, -1, -2)
+        return _fd.norm_matmul(x, nw, nb, w, bias, kind=kind, eps=eps)
+
+    return claimed_norm_matmul
+
+
+def _claim_norm_matmul(prod: _OpRecord, cons: _OpRecord,
+                       consumers: Dict[int, List[int]], j: int,
+                       root_ids: Set[int]) -> Optional[_OpRecord]:
+    """Build the fused-kernel record for a flagged norm→matmul chain,
+    or None when the chain's parameters can't be recovered.  The
+    candidate is validated NUMERICALLY against the capture-time output
+    values before it is accepted — a closure-extraction mismatch can
+    never silently change replay semantics."""
+    if prod.multi_out or len(prod.outputs) != 1 or cons.multi_out:
+        return None
+    t = prod.outputs[0]
+    if id(t) in root_ids or consumers.get(id(t), []) != [j]:
+        return None
+    if not cons.inputs or cons.inputs[0] is not t \
+            or any(u is t for u in cons.inputs[1:]):
+        return None
+    pname, cname = op_display_name(prod), op_display_name(cons)
+    eps = _free_value(prod.fn, "epsilon")
+    if not isinstance(eps, (int, float)):
+        return None
+    if pname == "layer_norm":
+        kind, has_norm_bias = "layer_norm", True
+        if len(prod.inputs) != 3:         # weight+bias is the hot shape
+            return None
+        axes = _free_value(prod.fn, "axes")
+        ndim = len(getattr(prod.inputs[0]._data, "shape", ()))
+        if axes is not _MISSING and tuple(axes) != (ndim - 1,):
+            return None
+    else:
+        kind, has_norm_bias = "rms_norm", False
+        if len(prod.inputs) != 2:         # a trailing bias-add opts out
+            return None
+    if cname == "matmul":
+        if len(cons.inputs) != 2:
+            return None
+        if _free_value(cons.fn, "transpose_x") is True:
+            return None
+        transpose_y = bool(_free_value(cons.fn, "transpose_y") is True)
+        has_mm_bias = False
+    elif cname == "linear":
+        if len(cons.inputs) not in (2, 3):
+            return None
+        transpose_y = False
+        has_mm_bias = len(cons.inputs) == 3
+    else:
+        return None
+    w = cons.inputs[1]._data
+    if len(getattr(w, "shape", ())) != 2:
+        return None
+    fn = _make_claimed_fn(kind, float(eps), has_norm_bias, transpose_y,
+                          has_mm_bias)
+    inputs = list(prod.inputs) + list(cons.inputs[1:])
+    try:
+        got = np.asarray(fn(*[u._data for u in inputs]))
+        want = np.asarray(cons.outputs[0]._data)
+        if got.shape != want.shape or not np.allclose(
+                got, want, rtol=1e-4, atol=1e-5):
+            return None
+    except Exception:
+        return None
+    return _OpRecord(fn, {}, inputs, cons.outputs, cons.multi_out,
+                     f"{pname}+{cname}")
+
+
+def run_claim_fused_kernels(ops: Sequence[_OpRecord],
+                            root_ids: Set[int]
+                            ) -> Tuple[List[_OpRecord], List[dict]]:
+    """Rewrite flagged norm→matmul ``fusion_hints`` chains onto the
+    fused Pallas ``norm_matmul`` kernel record (the 'kernels CLAIM the
+    hints' follow-on from the pass-pipeline PR).  Each accepted claim
+    drops the norm record and replaces the matmul record with one
+    fused record whose replay routes through ``ops/pallas``.  Returns
+    the rewritten op list and the hint dicts that were claimed
+    (annotated ``claimed=True`` — they join ``Program.fusion_hints``
+    so the annotation surface still describes every captured chain)."""
+    if not is_ssa(ops):
+        return list(ops), []
+    consumers = _consumer_map(ops)
+    claimed: Dict[int, int] = {}          # producer idx -> consumer idx
+    claimed_hints: List[dict] = []
+    new_records: Dict[int, _OpRecord] = {}
+    busy: Set[int] = set()
+    for h in collect_fusion_hints(ops):
+        if h["kind"] != "norm_matmul":
+            continue
+        i, j = h["ops"]
+        if i in busy or j in busy:
+            continue
+        rec = _claim_norm_matmul(ops[i], ops[j], consumers, j, root_ids)
+        if rec is None:
+            continue
+        claimed[i] = j
+        claimed_hints.append(dict(
+            h, claimed=True,
+            claimed_by="ops.pallas.fused_decode.norm_matmul"))
+        new_records[j] = rec
+        busy.update((i, j))
+    if not claimed:
+        return list(ops), []
+    out = [new_records.get(k, op) for k, op in enumerate(ops)
+           if k not in claimed]
+    return out, claimed_hints
+
+
 def collect_remat_hints(ops: Sequence[_OpRecord]) -> List[dict]:
     """Cheap ops whose output feeds >=2 consumers: recompute-in-place
     candidates for the jax.checkpoint policy."""

@@ -153,6 +153,36 @@ def test_ptl010_float64_fires():
     assert sum(1 for f in fs if f.code == "PTL010") == 2
 
 
+def test_ptl603_unpinned_kernel_literal_fires():
+    """PTL603 (scoped to ops/pallas kernel files): constructors without
+    a pinned dtype inside *_ref kernel bodies; bare float/int as the
+    dtype is the same hazard; host helpers in the same file are NOT
+    kernel bodies."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def _fwd_kernel(x_ref, o_ref):\n"
+        "    acc = jnp.zeros((8, 128))\n"              # unpinned
+        "    i = jnp.arange(8)\n"                      # unpinned
+        "    m = jnp.full((8, 1), -1e9, float)\n"      # bare float
+        "    ok = jnp.zeros((8, 128), jnp.float32)\n"  # pinned
+        "    ok2 = jnp.full((8, 1), -1e9, dtype=jnp.float32)\n"
+        "    o_ref[...] = acc\n"
+        "def host_helper(shape):\n"
+        "    return jnp.zeros(shape)\n")               # not a kernel
+    fs = lint_source(src, "paddle_tpu/ops/pallas/fake.py")
+    hits = [f for f in fs if f.code == "PTL603"]
+    assert len(hits) == 3, [f.render() for f in fs]
+    assert all(f.severity == "error" for f in hits)
+    # outside the kernel globs the rule never fires
+    fs2 = lint_source(src, "paddle_tpu/nn/other.py")
+    assert not [f for f in fs2 if f.code == "PTL603"]
+    # noqa suppression works per line
+    src_noqa = src.replace("jnp.zeros((8, 128))\n",
+                           "jnp.zeros((8, 128))  # noqa: PTL603\n")
+    fs3 = lint_source(src_noqa, "paddle_tpu/ops/pallas/fake.py")
+    assert len([f for f in fs3 if f.code == "PTL603"]) == 2
+
+
 def test_clean_snippet_is_clean():
     src = (
         "@to_static\n"

@@ -473,3 +473,136 @@ def test_clone_for_test_drops_training_tail():
     train_prog = prog.clone(for_test=False)
     assert len(train_prog.ops) == len(prog.ops)
     assert len(train_prog.writebacks) == 1
+
+
+# ---------------------------------------------------------------------------
+# program_claim_fused_kernels: the Pallas kernels CLAIM the flagged
+# norm+matmul fusion_hints chains (PR 5 follow-on)
+# ---------------------------------------------------------------------------
+
+def _decode_program(model, ids):
+    model.eval()
+    return capture_decode_program(model, Tensor(ids))
+
+
+def test_claim_fused_kernels_gpt_replay_equivalence():
+    """Flagged layer_norm→linear chains on a captured GPT decode step
+    are rewritten onto ops.pallas.fused_decode.norm_matmul records —
+    replay stays allclose on the live feed, and the claimed hints are
+    preserved (annotated) on the optimized program."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(0)
+    m = GPTForPretraining(GPTConfig(
+        num_layers=2, hidden_size=64, num_heads=4, vocab_size=128,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0))
+    ids = np.random.RandomState(0).randint(0, 128, (2, 6)).astype("int64")
+    prog, feeds, fetches, tok = _decode_program(m, ids)
+    opt, rep = run_program_passes(
+        prog, fetches, names=["program_claim_fused_kernels"],
+        label="gpt_claim")
+    claimed = rep["passes"][0]["removed"]
+    assert claimed >= 1, rep
+    assert any((op.name or "").startswith("layer_norm+")
+               for op in opt.ops)
+    assert all(h.get("claimed") for h in opt.fusion_hints)
+    assert all(h["claimed_by"].startswith("ops.pallas")
+               for h in opt.fusion_hints)
+    res = pass_check.check_equivalence(prog, opt, feeds, fetches, [tok])
+    assert res["allclose"], res
+
+
+def test_claim_fused_kernels_llama_rms_chain():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(1)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64,
+        max_position_embeddings=64))
+    ids = np.array([[3, 9, 17, 25]], np.int64)
+    prog, feeds, fetches, tok = _decode_program(m, ids)
+    opt, rep = run_program_passes(
+        prog, fetches, names=["program_claim_fused_kernels"],
+        label="llama_claim")
+    # the final rms_norm→lm-head matmul is the single-consumer chain
+    # (the block norms feed several projections, so they stay)
+    assert rep["passes"][0]["removed"] >= 1, rep
+    assert any((op.name or "").startswith("rms_norm+")
+               for op in opt.ops)
+    res = pass_check.check_equivalence(prog, opt, feeds, fetches, [tok])
+    assert res["allclose"], res
+
+
+def test_claim_pass_in_default_pipeline_stays_equivalent():
+    """The full default pipeline (claim BEFORE the generic fuser) keeps
+    the captured GPT decode replay allclose and still fuses."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    paddle.seed(2)
+    m = GPTForPretraining(GPTConfig(
+        num_layers=2, hidden_size=64, num_heads=4, vocab_size=128,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0))
+    ids = np.random.RandomState(2).randint(0, 128, (1, 4)).astype("int64")
+    prog, feeds, fetches, tok = _decode_program(m, ids)
+    assert "program_claim_fused_kernels" in DEFAULT_PIPELINE
+    opt, rep = run_program_passes(prog, fetches, label="gpt_full")
+    assert rep["reduction_pct"] >= 10.0
+    res = pass_check.check_equivalence(prog, opt, feeds, fetches, [tok])
+    assert res["allclose"], res
+
+
+def test_claim_refuses_multi_consumer_and_root_chains():
+    """A norm output consumed twice (or fetched) must NOT be claimed —
+    the rewrite would drop a live producer."""
+    from paddle_tpu.incubate.nn.functional import fused_rms_norm
+    prog = Program()
+    x = Tensor(np.random.RandomState(3).randn(4, 8).astype("float32"),
+               name="cx")
+    w = paddle.create_parameter([8], "float32", name="cw")
+    mm_w = paddle.create_parameter([8, 8], "float32", name="cmw")
+    prog.add_placeholder("cx", x)
+    with capture_ops(prog):
+        n, _ = fused_rms_norm(x, w, epsilon=1e-6)
+        a = paddle.matmul(n, mm_w)
+        b = paddle.add(n, n)          # second consumer of the norm
+        out = paddle.add(a, b)
+    ops, claimed = graph.run_claim_fused_kernels(
+        prog.ops, {id(out)})
+    assert claimed == []
+    assert len(ops) == len(prog.ops)
+    # single-consumer chain DOES claim
+    prog2 = Program()
+    prog2.add_placeholder("cx", x)
+    with capture_ops(prog2):
+        n2, _ = fused_rms_norm(x, w, epsilon=1e-6)
+        out2 = paddle.matmul(n2, mm_w)
+    ops2, claimed2 = graph.run_claim_fused_kernels(
+        prog2.ops, {id(out2)})
+    assert len(claimed2) == 1 and claimed2[0]["kind"] == "norm_matmul"
+    assert len(ops2) == len(prog2.ops) - 1
+
+
+def test_executor_donates_writeback_externals(passes_flag):
+    """donation_hints follow-on: with the pipeline on and writebacks
+    present, the Executor routes writeback-target externals through the
+    donated argument (split/rejoin), and repeated runs keep updating
+    the target correctly from its committed value."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("dx", [4], "float32")
+        w = paddle.create_parameter([4], "float32", name="dw")
+        g = paddle.multiply(x, w)
+        new_w = paddle.subtract(w, paddle.scale(g, scale=0.5))
+        out = paddle.sum(paddle.multiply(x, w))
+    prog.writebacks.append((w, new_w))
+    exe = static.Executor()
+    feed = {"dx": np.ones(4, np.float32)}
+    w0 = w.numpy().copy()
+    exe.run(prog, feed=feed, fetch_list=[out])
+    w1 = w.numpy().copy()
+    np.testing.assert_allclose(w1, w0 - 0.5 * w0, rtol=1e-6)
+    exe.run(prog, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(w.numpy(), w1 - 0.5 * w1, rtol=1e-6)
+    # the cache entry actually carries a donated split (hints present)
+    entry = next(iter(exe._cache.values()))
+    assert entry[3], "writeback externals were not split for donation"
