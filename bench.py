@@ -340,6 +340,85 @@ def _measure_megakernel_decode(on_tpu):
     }
 
 
+def _measure_serving(on_tpu):
+    """Continuous-batching serving engine vs sequential generate():
+    aggregate tokens/sec and p50/p99 request latency at N concurrent
+    streams (the paddle_tpu.serving acceptance metric — the engine
+    must beat the sequential baseline >= 2x at >= 8 streams on the
+    CPU smoke config).  Latency quantiles come straight from the
+    engine's registry histograms."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.engine import _REQ_LATENCY, _TTFT
+
+    paddle.seed(0)
+    cfg = GPTConfig(num_layers=4, hidden_size=128, num_heads=4,
+                    vocab_size=512, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    n_streams, prompt_len, n_new = 8, 16, 16
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 512, (prompt_len,)).tolist()
+               for _ in range(n_streams)]
+
+    # sequential baseline: one eager generate() per request, one after
+    # another (the pre-engine serving shape); warm once for compiles
+    model.generate(Tensor(np.asarray([prompts[0]], "int64")),
+                   max_new_tokens=n_new)
+    t0 = time.perf_counter()
+    for p in prompts:
+        model.generate(Tensor(np.asarray([p], "int64")),
+                       max_new_tokens=n_new)
+    seq_s = time.perf_counter() - t0
+    seq_tps = n_streams * n_new / seq_s
+
+    engine = ServingEngine(model, max_batch=n_streams, page_size=16,
+                           prefix_caching=False)
+    with engine:
+        # warm the prefill + decode program buckets outside the timing
+        engine.submit(prompts[0], max_new_tokens=2).wait(timeout=120)
+        lat_before = _REQ_LATENCY.labels(engine=engine.engine_id) \
+            .hist.count
+        t0 = time.perf_counter()
+        reqs = []
+
+        def _one(p):
+            reqs.append(engine.submit(p, max_new_tokens=n_new))
+
+        threads = [threading.Thread(target=_one, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in list(reqs):
+            r.wait(timeout=300)
+        eng_s = time.perf_counter() - t0
+        lat = _REQ_LATENCY.labels(engine=engine.engine_id).hist
+        ttft = _TTFT.labels(engine=engine.engine_id).hist
+        stats = engine.stats()
+    eng_tps = n_streams * n_new / eng_s
+    return {
+        "model": "gpt-4l-h128", "streams": n_streams,
+        "prompt_len": prompt_len, "new_tokens": n_new,
+        "sequential_tokens_per_sec": round(seq_tps, 2),
+        "engine_tokens_per_sec": round(eng_tps, 2),
+        "speedup": round(eng_tps / seq_tps, 3),
+        # registry-histogram snapshot (counts include the warm request;
+        # quantiles are dominated by the timed batch)
+        "request_latency": lat.summary(),
+        "ttft": ttft.summary(),
+        "timed_requests": lat.count - lat_before,
+        "engine_stats": stats,
+    }
+
+
 def _measure_decode(on_tpu):
     """Decode tokens/sec through the paged KV cache (serving axis):
     batch-8 greedy decode on a 125M-class decoder."""
@@ -479,6 +558,13 @@ def run_bench():
         out["megakernel_decode"] = _measure_megakernel_decode(on_tpu)
     except Exception as e:  # noqa: BLE001
         out["megakernel_decode"] = {"error": str(e)[-200:]}
+
+    # continuous-batching serving: engine vs sequential generate() at
+    # 8 concurrent streams + registry latency histograms
+    try:
+        out["serving"] = _measure_serving(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        out["serving"] = {"error": str(e)[-200:]}
 
     # per-config table (VERDICT r3 weak 1: a single point is not a
     # table): with budget to spare, add a batch-scaling point and a

@@ -70,6 +70,17 @@ TIMING_GLOBS = (
     "*/tuning/*.py",
     "*/resilience/*.py",
     "*/inference/*.py",
+    "*/serving/*.py",
+)
+
+# continuous-batching serving files (PTL701 scope): step-loop code
+# paths (functions named *step*/*loop*) must not read device values
+# back to the host — every sync serializes the whole batch pipeline
+# per token.  The ONE sanctioned read is the per-iteration admission
+# boundary (a reasoned noqa)
+SERVING_GLOBS = (
+    "*/serving/scheduler.py",
+    "*/serving/engine.py",
 )
 
 # program-pass files (PTL602 scope): graph passes must build new
@@ -611,6 +622,99 @@ def is_pass_path(path: str) -> bool:
     return any(fnmatch.fnmatch(p, g) for g in PASS_GLOBS)
 
 
+# PTL701: device-sync shapes that stall the serving batch pipeline
+_SYNC_METHODS = {"item", "numpy", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+               "numpy.array", "jax.device_get"}
+_BOOL_CASTS = {"bool", "int", "float"}
+
+
+class _ServingStepHygiene(ast.NodeVisitor):
+    """PTL701: host syncs inside serving step-loop code paths, scoped
+    to SERVING_GLOBS.  Active only inside functions whose name contains
+    ``step`` or ``loop`` (the per-iteration hot path): flags
+    ``.item()``/``.numpy()``/``.tolist()``/``.block_until_ready()``,
+    ``np.asarray``/``np.array``/``jax.device_get`` calls, and
+    ``finished.all()``-style reads steering an ``if``/``while`` or a
+    bool/int/float cast.  The single per-iteration admission-boundary
+    read carries a reasoned noqa."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+        self._depth = 0
+        self._seen: Set[Tuple[int, int]] = set()
+
+    def _flag(self, node: ast.AST, what: str):
+        if (node.lineno, node.col_offset) in self._seen:
+            return                         # bool(x.all()) inside an if
+        self._seen.add((node.lineno, node.col_offset))
+        self.findings.append(make_finding(
+            "PTL701",
+            f"{what} inside a serving step-loop code path is a host "
+            "sync — it serializes the batch pipeline per token; keep "
+            "values on device (the one admission-boundary read takes "
+            "a reasoned noqa)",
+            file=self.filename, line=node.lineno, col=node.col_offset))
+
+    def _visit_func(self, node):
+        name = node.name.lower()
+        hot = "step" in name or "loop" in name
+        self._depth += 1 if hot else 0
+        for child in node.body:
+            self.visit(child)
+        self._depth -= 1 if hot else 0
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    @staticmethod
+    def _is_reduction_read(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("all", "any")
+                and not node.args and not node.keywords)
+
+    def _check_test(self, test: ast.AST):
+        for sub in ast.walk(test):
+            if self._is_reduction_read(sub):
+                self._flag(sub, f".{sub.func.attr}() in a branch "
+                                "condition")
+
+    def visit_If(self, node):
+        if self._depth:
+            self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self._depth:
+            self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if self._depth:
+            dotted = _dotted(node.func)
+            if dotted in _SYNC_CALLS:
+                self._flag(node, f"{dotted}()")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS \
+                    and not node.args and not node.keywords:
+                self._flag(node, f".{node.func.attr}()")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in _BOOL_CASTS and node.args \
+                    and self._is_reduction_read(node.args[0]):
+                # key the finding on the INNER read so an if-wrapped
+                # bool(x.all()) is reported once
+                self._flag(node.args[0], f"{node.func.id}(... "
+                           f".{node.args[0].func.attr}())")
+        self.generic_visit(node)
+
+
+def is_serving_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in SERVING_GLOBS)
+
+
 # jnp/np array constructors whose default dtype follows the x64 flag
 _UNPINNED_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange",
                           "asarray", "array", "linspace", "eye"}
@@ -757,6 +861,10 @@ def lint_source(source: str, filename: str = "<string>",
         kernels = _KernelLiteralHygiene(filename)
         kernels.visit(tree)
         findings.extend(kernels.findings)
+    if is_serving_path(filename):
+        serving = _ServingStepHygiene(filename)
+        serving.visit(tree)
+        findings.extend(serving.findings)
     noqa = _collect_noqa(source)
     out = []
     for f in findings:

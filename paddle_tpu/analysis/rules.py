@@ -23,6 +23,8 @@ Code space:
   PTL6xx  program-pass hygiene rules (replay-equivalence verification
           of registered graph passes, in-place _OpRecord mutation; see
           pass_check.py and lint.py)
+  PTL7xx  serving hygiene rules (host syncs in continuous-batching
+          step-loop code paths; see lint.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -341,6 +343,20 @@ _rule(
     "jnp.full(s, v, jnp.float32), broadcasted_iota(jnp.int32, ...); "
     "bare float/int as a dtype argument is the same hazard spelled "
     "differently — use the explicit 32-bit jnp dtype.")
+_rule(
+    "PTL701", "serving-step-host-sync", ERROR,
+    "host sync inside a serving step-loop code path",
+    "The continuous-batching engine's throughput rests on the step "
+    "loop staying asynchronous: one jitted ragged step per iteration, "
+    "device values never read back except at the single admission "
+    "boundary.  A stray .item()/.numpy()/np.asarray or a "
+    "finished.all()-style branch condition inside serving/scheduler "
+    "or serving/engine step-loop functions serializes every batch "
+    "iteration on a device round-trip — the eager-decode pathology "
+    "the engine exists to remove.",
+    "Keep the value on device (sample/compare with jnp inside the "
+    "jitted step) or move the read to the per-iteration admission "
+    "boundary, which takes '# noqa: PTL701' with a reason comment.")
 _rule(
     "PTL301", "cost-model-sanity", ERROR,
     "tuning cost model violates a physical invariant",

@@ -110,6 +110,11 @@ define_flag("use_pallas_softmax_ce", True,
 define_flag("use_pallas_paged_attention", True,
             "route paged KV-cache decode attention through the TPU "
             "Pallas kernel (jnp reference elsewhere)")
+define_flag("use_pallas_ragged_attention", True,
+            "route the serving engine's mixed prefill/decode batches "
+            "through the one-launch ragged paged attention Pallas "
+            "kernel (per-sequence lengths + page tables as scalar-"
+            "prefetch refs) on TPU; the jnp reference runs elsewhere")
 define_flag("use_pallas_layer_norm", True,
             "route last-axis layer_norm with weight+bias through the "
             "Pallas fused kernel on TPU")
@@ -165,6 +170,13 @@ define_flag("megakernel_decode", False,
             "per token.  Beam search, paged caches and models without "
             "a decode-step builder fall back to the eager loop "
             "(observable via the decode_loop event)")
+define_flag("serving_engine", False,
+            "route InferenceServer POST /generate through the "
+            "continuous-batching ServingEngine (paddle_tpu.serving): "
+            "iteration-level admission, ragged paged attention, prefix-"
+            "cache sharing, per-request token streaming.  Off: the "
+            "endpoint answers 404 and only the npz /predict path "
+            "serves")
 define_flag("eager_finished_sync_every", 8,
             "eager decode loop: poll finished.all() on the host only "
             "every K generated tokens (the exact eager stop point is "

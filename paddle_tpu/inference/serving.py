@@ -9,11 +9,21 @@ The wire format is npz both ways (dense arrays, zero deps):
 
 - ``POST /predict`` — body: ``np.savez`` of named inputs (or positional
   ``input_0..``); response: npz of ``output_i`` arrays.
+- ``POST /generate`` — continuous-batching LLM serving (engine mode,
+  behind ``FLAGS_serving_engine`` with a ``paddle_tpu.serving.
+  ServingEngine`` attached): JSON request ``{"input_ids": [...],
+  "max_new_tokens", "eos_token_id", "temperature", "stream"}``;
+  streaming responses are newline-delimited JSON — one
+  ``{"token": id}`` line per generated token as the batch iterations
+  land, closed by ``{"done": true, "tokens": [...]}``.  Streaming
+  responses count against ``max_in_flight`` and are DRAINED by
+  ``stop()`` exactly like /predict bodies.
 - ``GET /health`` — JSON with the model's input names and serving
   counters (served / in_flight / rejected / errors / bad_requests).
 - ``GET /metrics`` — Prometheus text exposition of the process metrics
   registry (request counts by outcome, request-latency histogram,
-  in-flight and queue-depth gauges — plus whatever every other
+  in-flight and queue-depth gauges, the serving engine's
+  queue/occupancy/latency families — plus whatever every other
   subsystem registered).
 
 The serving counters live in ``paddle_tpu.observability.metrics`` (one
@@ -62,7 +72,7 @@ from ..observability import metrics as _metrics
 from ..observability import events as _events
 from ..resilience.retry import with_retries
 
-__all__ = ["InferenceServer", "serve", "predict_http"]
+__all__ = ["InferenceServer", "serve", "predict_http", "generate_http"]
 
 # one family set for every server in the process; children are labelled
 # per server instance so /health stays instance-scoped while GET
@@ -89,11 +99,21 @@ _SERVER_SEQ = itertools.count(1)
 class InferenceServer:
     """Serve one Predictor over HTTP (bounded load, draining stop)."""
 
-    def __init__(self, predictor, host: str = "127.0.0.1", port: int = 0,
-                 max_in_flight: int = 8):
+    def __init__(self, predictor=None, host: str = "127.0.0.1",
+                 port: int = 0, max_in_flight: int = 8, engine=None,
+                 stream_timeout: float = 120.0):
         if isinstance(predictor, Config):
             predictor = create_predictor(predictor)
+        if predictor is None and engine is None:
+            raise ValueError("InferenceServer needs a predictor, an "
+                             "engine, or both")
         self.predictor = predictor
+        # continuous-batching ServingEngine (paddle_tpu.serving) — the
+        # /generate route serves from it when FLAGS_serving_engine is
+        # on; lifecycle stays the caller's (stop() drains the HTTP
+        # streams but does not stop the engine)
+        self.engine = engine
+        self.stream_timeout = float(stream_timeout)
         self.max_in_flight = int(max_in_flight)
         self._lock = threading.Lock()          # predictor execution
         self._state = threading.Condition()    # in-flight accounting
@@ -138,7 +158,12 @@ class InferenceServer:
                     self._reply(404, b'{"error": "unknown path"}')
                     return
                 info = {"status": "ok",
-                        "inputs": outer.predictor.get_input_names(),
+                        "inputs": (outer.predictor.get_input_names()
+                                   if outer.predictor is not None
+                                   else []),
+                        "engine": (outer.engine.stats()
+                                   if outer.engine is not None
+                                   else None),
                         "served": outer.served,
                         "in_flight": outer._in_flight,
                         "rejected": outer.rejected,
@@ -147,12 +172,18 @@ class InferenceServer:
                 self._reply(200, json.dumps(info).encode())
 
             def do_POST(self):
-                if self.path != "/predict":
+                if self.path == "/generate":
+                    handler = self._do_generate
+                elif self.path == "/predict":
+                    handler = self._do_predict
+                else:
                     self._reply(404, b'{"error": "unknown path"}')
                     return
                 if not outer._admit():
                     # overloaded (or draining): shed load NOW rather
-                    # than queueing unbounded on the predictor lock
+                    # than queueing unbounded on the predictor lock.
+                    # Streaming /generate responses pass through the
+                    # same gate, so stop() drains them identically
                     self._reply(503, json.dumps(
                         {"error": "overloaded: "
                          f"{outer.max_in_flight} requests in flight"}
@@ -163,9 +194,90 @@ class InferenceServer:
                     # whatever its outcome (400/500/200 all cost the
                     # client this wall time)
                     with outer._h_latency.time():
-                        self._do_predict()
+                        handler()
                 finally:
                     outer._release()
+
+            def _do_generate(self):
+                from ..flags import get_flag
+                if outer.engine is None or \
+                        not get_flag("serving_engine"):
+                    outer._c_bad.inc()
+                    self._reply(404, json.dumps(
+                        {"error": "serving engine not enabled "
+                                  "(FLAGS_serving_engine)"}).encode())
+                    return
+                # ---- parse phase: failures are the CLIENT's -> 400
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    spec = json.loads(self.rfile.read(n) or b"{}")
+                    ids = spec["input_ids"]
+                    if not isinstance(ids, list) or not ids:
+                        raise ValueError("input_ids must be a "
+                                         "non-empty list of token ids")
+                    kw = {"max_new_tokens":
+                          int(spec.get("max_new_tokens", 32)),
+                          "temperature":
+                          float(spec.get("temperature", 0.0))}
+                    if spec.get("eos_token_id") is not None:
+                        kw["eos_token_id"] = int(spec["eos_token_id"])
+                except Exception as e:  # noqa: PTL401, BLE001 —
+                    # answered to the client as HTTP 400
+                    outer._c_bad.inc()
+                    self._reply(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
+                req = outer.engine.submit(ids, **kw)
+                if req.done and req.error:
+                    # rejected at admission (too long, queue full):
+                    # still the request's shape, not our failure
+                    outer._c_bad.inc()
+                    self._reply(400, json.dumps(
+                        {"error": req.error}).encode())
+                    return
+                if not spec.get("stream", True):
+                    try:
+                        toks = req.wait(timeout=outer.stream_timeout)
+                    except Exception as e:  # noqa: PTL401, BLE001 —
+                        # reported as HTTP 500; the loop survives
+                        outer._c_errors.inc()
+                        self._reply(500, json.dumps(
+                            {"error": f"{type(e).__name__}: "
+                                      f"{e}"}).encode())
+                        return
+                    outer._c_served.inc()
+                    self._reply(200, json.dumps(
+                        {"tokens": toks,
+                         "request_id": req.id}).encode())
+                    return
+                # ---- streaming: newline-delimited JSON, one line per
+                # token as each batch iteration lands; the response is
+                # close-delimited (HTTP/1.0) and the final line carries
+                # done=true so a truncated stream is detectable
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Request-Id", req.id)
+                self.end_headers()
+                try:
+                    for tok in req.stream(timeout=outer.stream_timeout):
+                        self.wfile.write(json.dumps(
+                            {"token": int(tok)}).encode() + b"\n")
+                        self.wfile.flush()
+                    self.wfile.write(json.dumps(
+                        {"done": True, "tokens": req.tokens,
+                         "request_id": req.id}).encode() + b"\n")
+                    outer._c_served.inc()
+                except Exception as e:  # noqa: PTL401, BLE001 —
+                    # headers are already on the wire: report the
+                    # failure IN-BAND (the done-line protocol) and
+                    # keep the serving loop alive
+                    outer._c_errors.inc()
+                    try:
+                        self.wfile.write(json.dumps(
+                            {"error": f"{type(e).__name__}: "
+                                      f"{e}"}).encode() + b"\n")
+                    except OSError:
+                        pass            # client already hung up
 
             def _do_predict(self):
                 # ---- parse phase: failures are the CLIENT's -> 400
@@ -347,3 +459,45 @@ def predict_http(url: str, *inputs: np.ndarray, timeout: float = 30.0,
                         retry_on=_retriable_http,
                         base_delay=retry_backoff, max_delay=2.0,
                         label="predict_http")
+
+
+def generate_http(url: str, input_ids, max_new_tokens: int = 32,
+                  eos_token_id: Optional[int] = None,
+                  temperature: float = 0.0, timeout: float = 120.0,
+                  retries: int = 4, retry_backoff: float = 0.1):
+    """Streaming client for the engine-mode ``POST /generate`` route:
+    a generator yielding token ids as the server's batch iterations
+    land.  Connection establishment (incl. the 503 overload answer)
+    retries with the shared backoff; once the stream starts, a
+    truncated response (no ``done`` line) raises."""
+    import urllib.request
+    body = {"input_ids": [int(t) for t in np.asarray(
+        input_ids).reshape(-1)], "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature), "stream": True}
+    if eos_token_id is not None:
+        body["eos_token_id"] = int(eos_token_id)
+    data = json.dumps(body).encode()
+
+    def _connect():
+        req = urllib.request.Request(url.rstrip("/") + "/generate",
+                                     data=data, method="POST")
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    resp = with_retries(_connect, attempts=max(1, int(retries)),
+                        retry_on=_retriable_http,
+                        base_delay=retry_backoff, max_delay=2.0,
+                        label="generate_http")
+    with resp:
+        done = False
+        for line in resp:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            if "error" in row:
+                raise RuntimeError(f"server error: {row['error']}")
+            if row.get("done"):
+                done = True
+                break
+            yield int(row["token"])
+    if not done:
+        raise RuntimeError("generate stream truncated (no done line)")

@@ -42,7 +42,7 @@ from ..core.tensor import Tensor
 from ..flags import get_flag
 from ..random_state import default_generator
 
-__all__ = ["generate", "decode_loop"]
+__all__ = ["generate", "decode_loop", "build_ragged_decode_step"]
 
 _GREEDY = ("greedy_search", "greedy")
 
@@ -402,6 +402,159 @@ def _compiled_decode(model, arr, max_new_tokens, decode_strategy,
     if sampling:
         default_generator.set_state(key_out)
     return tokens[:, :s_prompt + n], n
+
+
+# ---------------------------------------------------------------------------
+# the ragged batched decode step (continuous-batching serving engine)
+# ---------------------------------------------------------------------------
+
+def _scatter_pages(pages, vals, page_ids, slots):
+    """Write one step's new k/v rows into the page pools.  ``pages
+    [nkv, P, ps, hd]``; ``vals [B, Q, nkv, hd]``; ``page_ids/slots
+    [B, Q]`` (padding slots target the engine's sink page, never read
+    back)."""
+    nkv, hd = vals.shape[2], vals.shape[3]
+    flat = jnp.swapaxes(vals.reshape(-1, nkv, hd), 0, 1)   # [nkv, BQ, hd]
+    return pages.at[:, page_ids.reshape(-1), slots.reshape(-1)].set(
+        flat.astype(pages.dtype))
+
+
+def _last_valid_rows(h, q_lens):
+    """Gather each sequence's LAST valid query row from ``h [B, Q, H]``
+    (row ``q_lens[b] - 1``; padding slots clamp to row 0) — the lm-head
+    matmul then runs on [B, H] instead of every padded token."""
+    b, qw = h.shape[0], h.shape[1]
+    idx = jnp.clip(q_lens.astype(jnp.int32) - jnp.int32(1),
+                   jnp.int32(0), jnp.int32(qw - 1))
+    return h[jnp.arange(b, dtype=jnp.int32), idx]
+
+
+def build_ragged_decode_step(model):
+    """Cache-aware BATCHED decode step over paged KV pools — the
+    continuous-batching serving engine's per-iteration body (ragged
+    carries: per-sequence lengths and page tables instead of the
+    compiled loop's one dense ``pos``).
+
+    Returns ``(params, step)`` with::
+
+        step(params, tok [B, Q], pos [B, Q], pools, page_ids [B, Q],
+             slots [B, Q], kv_lens [B], q_lens [B], tables [B, ppseq])
+          -> (last_logits [B, V], pools')
+
+    where ``pools`` is a per-layer tuple of ``(k_pages, v_pages)``
+    ``[nkv, P, ps, hd]`` pools shared by every sequence.  Each
+    sequence contributes ``q_lens[b]`` new tokens this step (a prefill
+    chunk or one decode token, padded to the batch-wide ``Q``); their
+    k/v land at ``(page_ids, slots)`` BEFORE the one-launch ragged
+    paged attention, so the new tokens attend to themselves causally —
+    the same order as ``attend_cache_append``.  Numerics mirror the
+    model's ``build_decode_step`` body exactly (same norm references,
+    fp32 attention statistics), so engine output is token-for-token
+    the eager ``generate`` output.
+
+    Works for any model whose ``build_decode_step`` params carry the
+    GPT (``blocks``) or LLaMA (``layers``) layout."""
+    from ..ops.pallas import fused_decode as _fd
+    from ..ops.pallas.ragged_paged_attention import ragged_paged_attention
+
+    params, _ = model.build_decode_step()
+    c = model.config
+    nh = int(c.num_heads)
+    hidden = int(c.hidden_size)
+    hd = hidden // nh
+    tied = bool(c.tie_word_embeddings)
+
+    if "blocks" in params:                              # GPT family
+        def step(p, tok, pos, pools, page_ids, slots, kv_lens, q_lens,
+                 tables):
+            b, qw = tok.shape
+            x = jnp.take(p["wte"], tok, axis=0) \
+                + jnp.take(p["wpe"], pos, axis=0)        # [B, Q, H]
+            new_pools = []
+            for i, bp in enumerate(p["blocks"]):
+                h = _fd.reference_layer_norm(x, bp["ln1_w"],
+                                             bp["ln1_b"], 1e-5)
+                h2 = h.reshape(b * qw, hidden)
+                qp = (jnp.matmul(h2, bp["wq"]) + bp["bq"]) \
+                    .reshape(b, qw, nh, hd)
+                kp = (jnp.matmul(h2, bp["wk"]) + bp["bk"]) \
+                    .reshape(b, qw, nh, hd)
+                vp = (jnp.matmul(h2, bp["wv"]) + bp["bv"]) \
+                    .reshape(b, qw, nh, hd)
+                kpg = _scatter_pages(pools[i][0], kp, page_ids, slots)
+                vpg = _scatter_pages(pools[i][1], vp, page_ids, slots)
+                new_pools.append((kpg, vpg))
+                ctx = ragged_paged_attention(qp, kpg, vpg, kv_lens,
+                                             q_lens, tables)
+                x = x + (jnp.matmul(ctx.reshape(b, qw, hidden),
+                                    bp["wo"]) + bp["bo"])
+                x = x + _fd.norm_mlp(
+                    x.reshape(b * qw, hidden), kind="layer_norm",
+                    norm_w=bp["ln2_w"], norm_b=bp["ln2_b"],
+                    w1=bp["w1"], b1=bp["b1"], w2=bp["w2"], b2=bp["b2"],
+                    eps=1e-5, act="gelu_tanh").reshape(b, qw, hidden)
+            h = _fd.reference_layer_norm(x, p["lnf_w"], p["lnf_b"],
+                                         1e-5)
+            w = p["wte"] if tied else p["lm_w"]
+            logits = jnp.matmul(_last_valid_rows(h, q_lens),
+                                jnp.swapaxes(w, -1, -2))
+            return logits, tuple(new_pools)
+
+        return params, step
+
+    if "layers" in params:                              # LLaMA family
+        nkv = int(c.num_kv_heads)
+        eps = float(c.rms_eps)
+        act = c.hidden_act
+        scale = float(c.embed_scale)
+
+        def step(p, tok, pos, pools, page_ids, slots, kv_lens, q_lens,
+                 tables):
+            b, qw = tok.shape
+            x = jnp.take(p["embed"], tok, axis=0)
+            if scale != 1.0:
+                x = x * scale
+            cos = jnp.take(p["cos"], pos, axis=0)[:, :, None, :]
+            sin = jnp.take(p["sin"], pos, axis=0)[:, :, None, :]
+            new_pools = []
+            for i, lp in enumerate(p["layers"]):
+                h = _fd.reference_rms_norm(x, lp["ln1_w"], eps)
+                h2 = h.reshape(b * qw, hidden)
+                qp = jnp.matmul(h2, lp["wq"]).reshape(b, qw, nh, hd)
+                kp = jnp.matmul(h2, lp["wk"]).reshape(b, qw, nkv, hd)
+                vp = jnp.matmul(h2, lp["wv"]).reshape(b, qw, nkv, hd)
+                if lp["bq"] is not None:
+                    qp = qp + lp["bq"].reshape(nh, hd)
+                if lp["bk"] is not None:
+                    kp = kp + lp["bk"].reshape(nkv, hd)
+                if lp["bv"] is not None:
+                    vp = vp + lp["bv"].reshape(nkv, hd)
+                qp = _fd.reference_rope_rows(qp, cos, sin)
+                kp = _fd.reference_rope_rows(kp, cos, sin)
+                kpg = _scatter_pages(pools[i][0], kp, page_ids, slots)
+                vpg = _scatter_pages(pools[i][1], vp, page_ids, slots)
+                new_pools.append((kpg, vpg))
+                ctx = ragged_paged_attention(qp, kpg, vpg, kv_lens,
+                                             q_lens, tables)
+                x = x + jnp.matmul(ctx.reshape(b, qw, nh * hd),
+                                   lp["wo"])
+                x = x + _fd.norm_mlp(
+                    x.reshape(b * qw, hidden), kind="rms_norm",
+                    norm_w=lp["ln2_w"], w_gate=lp["wg"], w1=lp["wu"],
+                    w2=lp["wd"], eps=eps,
+                    act=act).reshape(b, qw, hidden)
+            h = _fd.reference_rms_norm(x, p["norm_w"], eps)
+            w = p["embed"] if tied else p["lm_w"]
+            logits = jnp.matmul(_last_valid_rows(h, q_lens),
+                                jnp.swapaxes(w, -1, -2))
+            return logits, tuple(new_pools)
+
+        return params, step
+
+    raise TypeError(
+        f"{type(model).__name__}.build_decode_step() params carry "
+        "neither a GPT ('blocks') nor a LLaMA ('layers') layout — "
+        "build_ragged_decode_step has no adapter for it")
 
 
 def decode_loop(model, input_ids, **kwargs):

@@ -297,6 +297,13 @@ class GPTForPretraining(nn.Layer):
         updates between calls never retrace)."""
         return _build_gpt_decode_step(self)
 
+    def build_ragged_decode_step(self):
+        """Batched serving-engine step over paged KV pools (per-
+        sequence lengths + page tables — ragged carries).  See
+        models.generation.build_ragged_decode_step."""
+        from .generation import build_ragged_decode_step
+        return build_ragged_decode_step(self)
+
 
 def _build_gpt_decode_step(model: "GPTForPretraining"):
     import jax.numpy as jnp

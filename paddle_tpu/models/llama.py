@@ -353,6 +353,13 @@ class LlamaForCausalLM(nn.Layer):
         ``pos``, GQA heads expanded inside the fused attention."""
         return _build_llama_decode_step(self)
 
+    def build_ragged_decode_step(self):
+        """Batched serving-engine step over paged KV pools (per-
+        sequence lengths + page tables — ragged carries).  See
+        models.generation.build_ragged_decode_step."""
+        from .generation import build_ragged_decode_step
+        return build_ragged_decode_step(self)
+
 
 def _build_llama_decode_step(model: "LlamaForCausalLM"):
     from ..ops.pallas import fused_decode as _fd

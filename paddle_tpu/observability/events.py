@@ -96,6 +96,18 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
                    "op_class_delta": "object", "allclose": "bool"},
     # inference server lifecycle (per-request traffic lives in metrics)
     "serving": {"action": "str", "url": "str"},
+    # continuous-batching engine (paddle_tpu.serving): a request joined
+    # the running batch (possibly resuming after eviction)
+    "serving_admit": {"request": "str", "prompt_len": "int",
+                      "cached_tokens": "int", "queue_s": "float",
+                      "resumed": "bool"},
+    # one ragged batch iteration (mixed prefill+decode, one launch)
+    "batch_step": {"batch": "int", "prefill_seqs": "int",
+                   "decode_seqs": "int", "q_width": "int",
+                   "tokens": "int", "queue_depth": "int"},
+    # a running sequence was preempted for pages and requeued
+    "evict": {"request": "str", "kv_len": "int", "n_generated": "int",
+              "reason": "str"},
     # one generate() call routed through the mega-kernel decode gate
     # (models/generation): which engine ran and why
     "decode_loop": {"model": "str", "batch": "int", "prompt_len": "int",

@@ -1,1 +1,2 @@
 from . import flash_attention
+from . import ragged_paged_attention

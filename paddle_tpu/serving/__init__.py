@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — continuous-batching LLM serving.
+
+The subsystem that joins the framework's decode pieces into a serving
+engine (ROADMAP: "Continuous-batching LLM serving with ragged paged
+attention"):
+
+* :class:`~.scheduler.Scheduler` — Orca-style iteration-level request
+  admission over a refcounted KV page pool: mixed prefill+decode
+  steps, immediate page free on EOS, eviction/requeue under page
+  pressure;
+* ``ops.pallas.ragged_paged_attention`` — the one-launch kernel that
+  attends a whole ragged batch (per-sequence lengths + page tables as
+  scalar-prefetch refs);
+* :class:`~.prefix_cache.PrefixCache` — content-hashed, refcounted
+  sharing of immutable prompt-prefix pages across requests;
+* :class:`~.engine.ServingEngine` — the streaming front-end, also
+  reachable over HTTP through ``inference.InferenceServer`` behind
+  ``FLAGS_serving_engine`` (``POST /generate``, NDJSON token stream).
+
+Quick start::
+
+    from paddle_tpu.serving import ServingEngine
+    with ServingEngine(model, max_batch=8) as eng:
+        req = eng.submit(prompt_ids, max_new_tokens=32, eos_token_id=2)
+        for tok in req.stream():
+            ...
+
+``python -m paddle_tpu.serving`` runs a self-contained demo (tiny GPT,
+concurrent streams, engine stats).
+"""
+from .engine import ServingEngine
+from .prefix_cache import PrefixCache
+from .scheduler import PagePool, Request, Scheduler, StepPlan
+
+__all__ = ["ServingEngine", "PrefixCache", "PagePool", "Request",
+           "Scheduler", "StepPlan"]

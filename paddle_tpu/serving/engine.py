@@ -1,0 +1,387 @@
+"""Continuous-batching serving engine — the front-end that joins the
+scheduler, the ragged paged attention step and the prefix cache into
+one token-streaming service.
+
+One background thread runs the iteration loop: every pass it asks the
+scheduler for a :class:`~.scheduler.StepPlan` (admitting / evicting at
+token-iteration granularity), executes ONE jitted ragged step for the
+whole mixed prefill+decode batch (``models.generation.
+build_ragged_decode_step`` + the one-launch ragged paged attention
+kernel), samples the next token per sequence ON DEVICE, and reads the
+sampled row back in a single host sync at the admission boundary —
+the only device read in the loop (PTL701).
+
+Programs are cached per query-chunk width ``Q`` (bucketed to powers of
+two), so steady-state decode (``Q == 1``) is exactly one compiled
+program regardless of batch composition, and the page pools ride as
+DONATED jit arguments — XLA reuses their buffers in place across
+iterations on accelerator backends.
+
+Observability: ``serving_admit`` / ``batch_step`` / ``evict`` events
+(see docs/observability_events.md), queue-depth + batch-occupancy
+gauges, per-request end-to-end and time-to-first-token histograms —
+all through the PR 4 metrics registry, which is what ``GET /metrics``
+exports when the engine serves behind ``InferenceServer``
+(``FLAGS_serving_engine``).  Each step also emits ``serving_prefill``
+/ ``serving_decode`` markers into the op-dispatch stream
+(``core.dispatch.observe_op_stream``) carrying the REAL fed-token
+counts, so tests and the analyzer can prove prefix-cache sharing
+skips prefill work.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ServingEngine"]
+
+from ..observability import events as _events
+from ..observability import metrics as _metrics
+from .prefix_cache import PrefixCache
+from .scheduler import PagePool, Request, Scheduler
+
+_QUEUE_DEPTH = _metrics.gauge(
+    "paddle_serving_engine_queue_depth",
+    "requests waiting for a batch slot", labels=("engine",))
+_OCCUPANCY = _metrics.gauge(
+    "paddle_serving_engine_batch_occupancy",
+    "sequences in the running batch", labels=("engine",))
+_REQ_LATENCY = _metrics.histogram(
+    "paddle_serving_engine_request_seconds",
+    "end-to-end request wall time (queue + prefill + decode)",
+    labels=("engine",), buckets=_metrics.TIME_BUCKETS)
+_TTFT = _metrics.histogram(
+    "paddle_serving_engine_ttft_seconds",
+    "submit-to-first-token wall time",
+    labels=("engine",), buckets=_metrics.TIME_BUCKETS)
+_STEP_LATENCY = _metrics.histogram(
+    "paddle_serving_engine_step_seconds",
+    "one ragged batch iteration (dispatch + boundary sync)",
+    labels=("engine",), buckets=_metrics.TIME_BUCKETS)
+_TOKENS = _metrics.counter(
+    "paddle_serving_engine_tokens_total",
+    "tokens processed, by phase (prefill: prompt KV built; decode: "
+    "generated)", labels=("engine", "phase"))
+_EVICTIONS = _metrics.counter(
+    "paddle_serving_engine_evictions_total",
+    "running sequences preempted for pages", labels=("engine",))
+_STEPS = _metrics.counter(
+    "paddle_serving_engine_steps_total",
+    "ragged batch iterations executed", labels=("engine",))
+
+_ENGINE_SEQ = itertools.count(1)
+
+
+def _bucket(n: int) -> int:
+    """Next power of two >= n — bounds program-compile count to
+    log2(max prompt length) buckets."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ServingEngine:
+    """Continuous-batching LLM serving over one model.
+
+    ``submit()`` returns a :class:`~.scheduler.Request` whose
+    ``stream()`` yields generated token ids live and whose ``wait()``
+    blocks for the full result.  Greedy by default; a per-request
+    ``temperature > 0`` samples on device from the engine's PRNG
+    stream.  Use as a context manager or call ``start()``/``stop()``.
+    """
+
+    def __init__(self, model, *, max_batch: int = 8, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_pages_per_seq: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 max_queue: int = 1024, max_prefill_chunk: int = 0,
+                 prefix_caching: bool = True, seed: int = 0,
+                 dtype: str = "float32"):
+        import jax
+        import jax.numpy as jnp
+        if hasattr(model, "eval"):
+            model.eval()
+        self.model = model
+        self._params, self._step_fn = model.build_ragged_decode_step()
+        cfg = model.config
+        nh = int(cfg.num_heads)
+        hidden = int(cfg.hidden_size)
+        hd = hidden // nh
+        nkv = int(getattr(cfg, "num_kv_heads", nh) or nh)
+        n_layers = len(self._params["blocks"] if "blocks" in self._params
+                       else self._params["layers"])
+        ps = int(page_size)
+        max_pos = int(getattr(cfg, "max_position_embeddings", 1024))
+        if max_pages_per_seq is None:
+            max_pages_per_seq = -(-max_pos // ps)
+        if num_pages is None:
+            # every slot can hold a max-length sequence, plus the sink
+            num_pages = int(max_batch) * int(max_pages_per_seq) + 1
+        self.pool = PagePool(num_pages, ps)
+        self.prefix_cache = PrefixCache(self.pool) if prefix_caching \
+            else None
+        self.scheduler = Scheduler(
+            self.pool, max_batch, max_pages_per_seq,
+            prefix_cache=self.prefix_cache, max_queue=max_queue,
+            max_prefill_chunk=max_prefill_chunk)
+        self.max_batch = int(max_batch)
+        self.default_eos = None if eos_token_id is None \
+            else int(eos_token_id)
+        self._pools = tuple(
+            (jnp.zeros((nkv, num_pages, ps, hd), dtype),
+             jnp.zeros((nkv, num_pages, ps, hd), dtype))
+            for _ in range(n_layers))
+        self._key = jax.random.PRNGKey(int(seed))
+        self._programs: dict = {}
+        self.engine_id = str(next(_ENGINE_SEQ))
+        eid = self.engine_id
+        self._g_queue = _QUEUE_DEPTH.labels(engine=eid)
+        self._g_occ = _OCCUPANCY.labels(engine=eid)
+        self._h_latency = _REQ_LATENCY.labels(engine=eid)
+        self._h_ttft = _TTFT.labels(engine=eid)
+        self._h_step = _STEP_LATENCY.labels(engine=eid)
+        self._c_prefill = _TOKENS.labels(engine=eid, phase="prefill")
+        self._c_decode = _TOKENS.labels(engine=eid, phase="decode")
+        self._c_evict = _EVICTIONS.labels(engine=eid)
+        self._c_steps = _STEPS.labels(engine=eid)
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._accepting = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        with self._wake:
+            if self._running:
+                return self
+            self._running = True
+            self._accepting = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"serving-engine-"
+                                             f"{self.engine_id}")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting requests; with ``drain`` finish every
+        admitted/queued request first (bounded by ``timeout``), else
+        fail them fast."""
+        with self._wake:
+            self._accepting = False
+            self._wake.notify_all()
+        if drain:
+            deadline = time.monotonic() + float(timeout)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self.scheduler.has_work():
+                        break
+                time.sleep(0.01)
+        with self._wake:
+            self._running = False
+            # fail whatever is left (drain timeout, or drain=False)
+            leftovers = list(self.scheduler.waiting) \
+                + list(self.scheduler.running)
+            self.scheduler.waiting.clear()
+            for seq in leftovers:
+                self.scheduler.finish(seq, error="engine stopped")
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- request side ----------------------------------------------------
+    def submit(self, input_ids, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0,
+               request_id: Optional[str] = None) -> Request:
+        """Queue one generation request; returns the live handle."""
+        req = Request(input_ids, max_new_tokens=max_new_tokens,
+                      eos_token_id=(self.default_eos if eos_token_id
+                                    is None else eos_token_id),
+                      temperature=temperature, request_id=request_id)
+        with self._wake:
+            if not self._accepting:
+                req._finish(error="engine is not accepting requests")
+                return req
+            self.scheduler.submit(req)
+            self._g_queue.set(self.scheduler.queue_depth())
+            self._wake.notify()
+        return req
+
+    def generate(self, input_ids, **kw):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(input_ids, **kw).wait()
+
+    # -- the iteration loop ----------------------------------------------
+    def _loop(self):
+        while True:
+            with self._wake:
+                if not self._running:
+                    return
+                if not self.scheduler.has_work():
+                    self._wake.wait(0.05)
+                    continue
+                plan, admitted, evicted = self.scheduler.plan_step()
+                now = time.monotonic()
+                for seq in admitted:
+                    _events.emit(
+                        "serving_admit", request=seq.req.id,
+                        prompt_len=len(seq.req.prompt),
+                        cached_tokens=seq.cached_tokens,
+                        queue_s=round(now - seq.req.submitted_at, 6),
+                        resumed=seq.req.evictions > 0)
+                for seq in evicted:
+                    self._c_evict.inc()
+                    _events.emit(
+                        "evict", request=seq.req.id,
+                        kv_len=len(seq.tokens),
+                        n_generated=seq.n_generated,
+                        reason="page_exhaustion")
+                self._g_queue.set(self.scheduler.queue_depth())
+                self._g_occ.set(len(self.scheduler.running))
+            if plan is None:
+                # runnable work exists but no pages/slots right now
+                # (e.g. the queue head cannot fit until a decode
+                # finishes) — yield briefly instead of spinning
+                time.sleep(0.005)
+                continue
+            try:
+                self._run_step(plan)
+            except Exception as e:  # noqa: BLE001 — a failed step must
+                # fail its requests loudly, not hang their consumers
+                import warnings
+                warnings.warn(f"serving step failed: "
+                              f"{type(e).__name__}: {e}", stacklevel=1)
+                with self._wake:
+                    for seq in list(plan.seqs):
+                        self.scheduler.finish(
+                            seq, error=f"{type(e).__name__}: {e}")
+
+    def _run_step(self, plan):
+        from ..core.dispatch import _emit_op_event
+        qw = _bucket(plan.tok.shape[1])
+        prog = self._program(qw)
+        pad = qw - plan.tok.shape[1]
+        tok = np.pad(plan.tok, ((0, 0), (0, pad)))
+        pos = np.pad(plan.pos, ((0, 0), (0, pad)))
+        page_ids = np.pad(plan.page_ids, ((0, 0), (0, pad)),
+                          constant_values=self.pool.sink)
+        slots = np.pad(plan.slots, ((0, 0), (0, pad)))
+        with self._h_step.time():
+            nxt, self._pools, self._key = prog(
+                self._params, tok, pos, self._pools, page_ids, slots,
+                plan.kv_lens, plan.q_lens, plan.tables, plan.temps,
+                self._key)
+            # THE per-iteration boundary sync: exactly one device read
+            # per batch step, fanned out to every request's stream —
+            # admission, eviction and EOS all key off it
+            toks = np.asarray(nxt)  # noqa: PTL701 — admission boundary
+        # dispatch-stream markers with the REAL fed-token counts (the
+        # prefix-cache FLOPs-skip proof reads these)
+        if plan.fed_prefill:
+            _emit_op_event("serving_prefill",
+                           [np.empty((plan.fed_prefill,), "int8")],
+                           [], True)
+        if plan.fed_decode:
+            _emit_op_event("serving_decode",
+                           [np.empty((plan.fed_decode,), "int8")],
+                           [], True)
+        with self._wake:
+            self.scheduler.commit(plan)
+            self._c_steps.inc()
+            self._c_prefill.inc(plan.fed_prefill)
+            now = time.monotonic()
+            for i, seq in enumerate(plan.seqs):
+                if seq.kv_len < len(seq.tokens):
+                    continue        # chunked prefill still in flight
+                req = seq.req
+                tok_i = int(toks[i])
+                seq.tokens.append(tok_i)
+                req._emit(tok_i)
+                self._c_decode.inc()
+                if len(req.tokens) == 1:
+                    self._h_ttft.observe(now - req.submitted_at)
+                eos = req.eos_token_id
+                if (eos is not None and tok_i == eos) or \
+                        len(req.tokens) >= req.max_new_tokens:
+                    if self.prefix_cache is not None and \
+                            not seq.cache_inserted:
+                        self._cache_prompt(seq)
+                    self.scheduler.finish(seq)
+                    self._h_latency.observe(now - req.submitted_at)
+                elif self.prefix_cache is not None and \
+                        not seq.cache_inserted:
+                    self._cache_prompt(seq)
+            self._g_occ.set(len(self.scheduler.running))
+            _events.emit("batch_step", batch=len(plan.seqs),
+                         prefill_seqs=plan.n_prefill,
+                         decode_seqs=plan.n_decode,
+                         q_width=int(qw),
+                         tokens=plan.fed_prefill + plan.fed_decode,
+                         queue_depth=self.scheduler.queue_depth())
+
+    def _cache_prompt(self, seq):
+        """Share the finished prompt's full pages through the prefix
+        cache (once per admission; pages the sequence itself borrowed
+        from the cache are skipped)."""
+        self.prefix_cache.insert(seq.req.prompt, seq.pages,
+                                 shared=seq.shared)
+        seq.cache_inserted = True
+
+    # -- the jitted ragged program ---------------------------------------
+    def _program(self, qw: int):
+        import jax
+        import jax.numpy as jnp
+        from ..flags import get_flag
+        key = (qw, bool(get_flag("use_pallas_ragged_attention")),
+               bool(get_flag("use_pallas_fused_decode")),
+               bool(get_flag("pallas_interpret")))
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        step = self._step_fn
+
+        def program(params, tok, pos, pools, page_ids, slots, kv_lens,
+                    q_lens, tables, temps, rng):
+            logits, pools = step(params, tok, pos, pools, page_ids,
+                                 slots, kv_lens, q_lens, tables)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            rng, sub = jax.random.split(rng)
+            t32 = temps.astype(jnp.float32)
+            scaled = logits.astype(jnp.float32) \
+                / jnp.maximum(t32, jnp.float32(1e-6))[:, None]
+            sampled = jax.random.categorical(sub, scaled, axis=-1) \
+                .astype(jnp.int32)
+            nxt = jnp.where(t32 > jnp.float32(0.0), sampled, greedy)
+            return nxt, pools, rng
+
+        # pools are index 3; donated so XLA reuses the page buffers in
+        # place across iterations (CPU has no donation support)
+        donate = (3,) if jax.default_backend() != "cpu" else ()
+        prog = jax.jit(program, donate_argnums=donate)
+        self._programs[key] = prog
+        return prog
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        out = {"engine": self.engine_id,
+               "queue_depth": self.scheduler.queue_depth(),
+               "running": len(self.scheduler.running),
+               "evictions": self.scheduler.evictions,
+               "free_pages": self.pool.available(),
+               "programs": len(self._programs)}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out

@@ -1,0 +1,584 @@
+"""Continuous-batching serving engine (paddle_tpu.serving): ragged
+paged attention kernel parity, scheduler/page-pool lifecycle, prefix
+cache sharing, engine-vs-generate() parity, HTTP /generate streaming,
+and the PTL701 step-loop hygiene rule."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.flags import get_flags, set_flags
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import PagePool, Request, Scheduler, ServingEngine
+from paddle_tpu.serving.prefix_cache import PrefixCache
+
+
+@pytest.fixture
+def flags_guard():
+    keep = get_flags(["FLAGS_serving_engine", "FLAGS_pallas_interpret",
+                      "FLAGS_use_pallas_ragged_attention"])
+    yield
+    set_flags(keep)
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    paddle.seed(0)
+    cfg = GPTConfig(num_layers=2, hidden_size=64, num_heads=4,
+                    vocab_size=128, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _greedy_reference(model, prompts, n_new):
+    out = []
+    for p in prompts:
+        ids = Tensor(np.asarray([p], "int64"))
+        toks = model.generate(ids, max_new_tokens=n_new,
+                              decode_strategy="greedy")
+        out.append(np.asarray(toks._data)[0, len(p):].tolist())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention kernel
+# ---------------------------------------------------------------------------
+
+def _rand_case(rs, nh, nkv, b=4, qw=8, hd=16, ps=4, ppseq=6, p_total=32):
+    import jax.numpy as jnp
+    q = jnp.asarray(rs.randn(b, qw, nh, hd).astype("float32"))
+    kp = jnp.asarray(rs.randn(nkv, p_total, ps, hd).astype("float32"))
+    vp = jnp.asarray(rs.randn(nkv, p_total, ps, hd).astype("float32"))
+    # mixed batch: full prefill, decode, empty padding slot, mid chunk
+    kv_lens = jnp.asarray(np.array([13, 1, 0, 24], "int32"))
+    q_lens = jnp.asarray(np.array([8, 1, 0, 3], "int32"))
+    tables = jnp.asarray(rs.permutation(p_total)[:b * ppseq]
+                         .reshape(b, ppseq).astype("int32"))
+    return q, kp, vp, kv_lens, q_lens, tables
+
+
+@pytest.mark.parametrize("nh,nkv", [(4, 4), (4, 2)],
+                         ids=["mha", "gqa"])
+def test_ragged_kernel_matches_reference_interpret(flags_guard, rng,
+                                                   nh, nkv):
+    """Interpret-mode Pallas kernel == jnp reference on a mixed
+    prefill/decode batch with uneven per-sequence lengths (incl. GQA
+    and an empty padding slot)."""
+    from paddle_tpu.ops.pallas import ragged_paged_attention as rpa
+    set_flags({"FLAGS_pallas_interpret": True})
+    q, kp, vp, kv_lens, q_lens, tables = _rand_case(rng, nh, nkv)
+    ref = rpa.ragged_paged_attention_ref(q, kp, vp, kv_lens, q_lens,
+                                         tables)
+    out = rpa.ragged_paged_attention(q, kp, vp, kv_lens, q_lens, tables)
+    for b in range(q.shape[0]):
+        n = int(q_lens[b])
+        if n:
+            np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                       np.asarray(ref)[b, :n],
+                                       rtol=2e-5, atol=2e-5)
+    # the zero-length padding row must be exactly zero, never NaN
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.all(np.asarray(out)[2] == 0.0)
+
+
+def test_ragged_reference_matches_dense_attention(rng):
+    """The jnp reference == a per-sequence dense causal attention
+    oracle built independently in numpy."""
+    from paddle_tpu.ops.pallas import ragged_paged_attention as rpa
+    nh, nkv, hd, ps = 4, 2, 8, 4
+    q, kp, vp, kv_lens, q_lens, tables = _rand_case(
+        rng, nh, nkv, hd=hd, ps=ps)
+    out = np.asarray(rpa.ragged_paged_attention_ref(
+        q, kp, vp, kv_lens, q_lens, tables))
+    qn, kpn, vpn = (np.asarray(a) for a in (q, kp, vp))
+    tb = np.asarray(tables)
+    rep = nh // nkv
+    for b in range(qn.shape[0]):
+        kv_len, q_len = int(kv_lens[b]), int(q_lens[b])
+        if q_len == 0:
+            continue
+        # gather this sequence's context densely: [kv_len, nkv, hd]
+        k = np.concatenate([kpn[:, p].transpose(1, 0, 2)
+                            for p in tb[b]], axis=0)[:kv_len]
+        v = np.concatenate([vpn[:, p].transpose(1, 0, 2)
+                            for p in tb[b]], axis=0)[:kv_len]
+        start = kv_len - q_len
+        for i in range(q_len):
+            for h in range(nh):
+                g = h // rep
+                scores = (k[:start + i + 1, g] @ qn[b, i, h]) \
+                    / np.sqrt(hd)
+                w = np.exp(scores - scores.max())
+                w /= w.sum()
+                want = w @ v[:start + i + 1, g]
+                np.testing.assert_allclose(out[b, i, h], want,
+                                           rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# page pool + scheduler
+# ---------------------------------------------------------------------------
+
+def test_page_pool_refcount_lifecycle():
+    pool = PagePool(num_pages=4, page_size=8)
+    assert pool.sink == 3 and pool.available() == 3
+    a = pool.alloc()
+    pool.ref(a)
+    assert pool.refcount(a) == 2
+    pool.unref(a)
+    assert pool.available() == 2           # still held once
+    pool.unref(a)
+    assert pool.available() == 3           # back on the free list
+    with pytest.raises(ValueError):
+        pool.unref(a)                      # double free is loud
+    for _ in range(3):
+        pool.alloc()
+    with pytest.raises(RuntimeError):
+        pool.alloc()                       # the sink is never handed out
+
+
+def test_scheduler_admission_completion_and_plan_layout():
+    pool = PagePool(num_pages=16, page_size=4)
+    sched = Scheduler(pool, max_batch=2, max_pages_per_seq=4)
+    r1 = Request([1, 2, 3, 4, 5], max_new_tokens=3)
+    r2 = Request([7, 8], max_new_tokens=3)
+    r3 = Request([9], max_new_tokens=3)
+    for r in (r1, r2, r3):
+        sched.submit(r)
+    plan, admitted, evicted = sched.plan_step()
+    # iteration-level admission: only max_batch sequences run; r3 waits
+    assert len(admitted) == 2 and not evicted
+    assert plan.tok.shape == (2, 5)        # widest prompt pads the step
+    assert plan.q_lens.tolist()[:2] == [5, 2]
+    assert plan.kv_lens.tolist()[:2] == [5, 2]
+    # page/slot layout: token t of seq 0 -> page[t//4], slot t%4
+    s0 = plan.seqs[0]
+    assert plan.page_ids[0, :5].tolist() == [s0.pages[0]] * 4 \
+        + [s0.pages[1]]
+    assert plan.slots[0, :5].tolist() == [0, 1, 2, 3, 0]
+    # padding of the short row scatters into the sink page
+    assert plan.page_ids[1, 2:].tolist() == [pool.sink] * 3
+    sched.commit(plan)
+    # finishing frees pages IMMEDIATELY and r3 admits next plan
+    held = pool.available()
+    sched.finish(plan.seqs[0])
+    assert pool.available() == held + 2
+    assert r1.done
+    plan2, admitted2, _ = sched.plan_step()
+    assert [s.req.id for s in admitted2] == [r3.id]
+
+
+def test_scheduler_eviction_requeues_and_protects_planned():
+    # 2 allocatable pages + sink: both prompts fit, growth does not
+    pool = PagePool(num_pages=3, page_size=4)
+    sched = Scheduler(pool, max_batch=2, max_pages_per_seq=2)
+    r1 = Request([1, 2, 3, 4], max_new_tokens=4)
+    r2 = Request([5, 6, 7], max_new_tokens=4)
+    sched.submit(r1)
+    sched.submit(r2)
+    plan, admitted, evicted = sched.plan_step()
+    assert len(admitted) == 2 and not evicted
+    sched.commit(plan)
+    # r1 decodes into a second page: zero free pages -> the YOUNGEST
+    # running sequence (r2) is preempted and requeued at the front
+    plan.seqs[0].tokens.append(10)
+    plan.seqs[1].tokens.append(11)
+    plan2, _, evicted2 = sched.plan_step()
+    assert [s.req.id for s in evicted2] == [r2.id]
+    assert r2.evictions == 1
+    # the victim is NOT in the plan (its pages were reallocated) and
+    # the protected grower is
+    assert [s.req.id for s in plan2.seqs] == [r1.id]
+    assert sched.queue_depth() == 1
+    sched.commit(plan2)
+    # finish r1 -> r2 re-admits and re-prefills its kept tokens
+    sched.finish(plan2.seqs[0])
+    plan3, admitted3, _ = sched.plan_step()
+    assert [s.req.id for s in admitted3] == [r2.id]
+    assert plan3.q_lens.tolist()[0] == 3
+
+
+def test_request_too_long_fails_fast():
+    pool = PagePool(num_pages=8, page_size=4)
+    sched = Scheduler(pool, max_batch=2, max_pages_per_seq=2)
+    r = Request(list(range(6)), max_new_tokens=4)   # 10 > 2*4
+    sched.submit(r)
+    assert r.done
+    with pytest.raises(RuntimeError, match="at most 8"):
+        r.wait(timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_share_release_reuse_lifecycle():
+    pool = PagePool(num_pages=10, page_size=4)
+    cache = PrefixCache(pool)
+    prompt = list(range(11))               # 2 full pages + partial
+    pages = [pool.alloc(), pool.alloc(), pool.alloc()]
+    assert cache.insert(prompt, pages) == 2    # partial page not cached
+    assert pool.refcount(pages[0]) == 2 and pool.refcount(pages[2]) == 1
+
+    # full match on the shared prefix
+    assert cache.match(prompt) == pages[:2]
+    # partial overlap: first page shared, second diverges
+    other = prompt[:4] + [99, 98, 97, 96, 1, 2]
+    assert cache.match(other) == pages[:1]
+    # owner releases: cache refs keep the full pages alive
+    for p in pages:
+        pool.unref(p)
+    assert pool.refcount(pages[0]) == 1 and pool.refcount(pages[2]) == 0
+    # reuse: a later identical prompt still matches
+    assert cache.match(prompt) == pages[:2]
+    # pressure reclaim frees cache-only pages LRU-first
+    freed = cache.reclaim(2)
+    assert freed == 2 and len(cache) == 0
+    assert pool.refcount(pages[0]) == 0
+
+
+def test_prefix_cache_hash_collision_never_shares():
+    pool = PagePool(num_pages=10, page_size=4)
+    cache = PrefixCache(pool, hash_fn=lambda prev, toks: "SAME")
+    a = pool.alloc()
+    cache.insert([1, 2, 3, 4], [a])
+    # different content, same (degenerate) hash: must MISS, not share
+    assert cache.match([5, 6, 7, 8]) == []
+    assert cache.stats()["collisions"] == 1
+    assert cache.match([1, 2, 3, 4]) == [a]
+
+
+def test_prefix_cache_skips_prefill_flops(gpt_model):
+    """A shared-prefix request must skip the prefill work: the
+    dispatch stream's serving_prefill markers carry the REAL fed-token
+    counts (core.dispatch.observe_op_stream)."""
+    from paddle_tpu.core.dispatch import observe_op_stream
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, 128, (24,)).tolist()
+    events = []
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    with engine, observe_op_stream(events.append):
+        cold = engine.submit(prompt, max_new_tokens=4).wait(timeout=60)
+        n_cold = sum(ev.in_avals[0][0][0] for ev in events
+                     if ev.op_name == "serving_prefill")
+        events.clear()
+        warm = engine.submit(prompt, max_new_tokens=4).wait(timeout=60)
+        n_warm = sum(ev.in_avals[0][0][0] for ev in events
+                     if ev.op_name == "serving_prefill")
+    assert cold == warm                    # sharing never changes tokens
+    assert n_cold == 24
+    # only the boundary token re-feeds (its page rewrite is value-
+    # identical); 24 -> 1 is the skipped-prefill-FLOPs proof
+    assert n_warm == 1
+    assert engine.prefix_cache.stats()["hits"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_generate_gpt(gpt_model):
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (n,)).tolist() for n in (5, 9, 16, 3)]
+    want = _greedy_reference(gpt_model, prompts, 8)
+    engine = ServingEngine(gpt_model, max_batch=4, page_size=8)
+    with engine:
+        reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        got = [r.wait(timeout=120) for r in reqs]
+    assert got == want
+
+
+def test_engine_matches_generate_llama_gqa():
+    from paddle_tpu.models import LlamaForCausalLM, llama_config
+    paddle.seed(0)
+    cfg = llama_config("tiny")
+    assert cfg.num_kv_heads < cfg.num_heads       # GQA is exercised
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, cfg.vocab_size, (n,)).tolist()
+               for n in (7, 12)]
+    want = _greedy_reference(m, prompts, 6)
+    engine = ServingEngine(m, max_batch=2, page_size=8)
+    with engine:
+        got = [engine.submit(p, max_new_tokens=6).wait(timeout=120)
+               for p in prompts]
+    assert got == want
+
+
+def test_engine_eos_stops_and_frees_pages(gpt_model):
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 128, (5,)).tolist()
+    [full] = _greedy_reference(gpt_model, [prompt], 8)
+    # pick an eos the greedy run first emits MIDWAY so the truncation
+    # is observable (seed 0: [67 x5, 63, 63, 63] -> eos=63)
+    eos = next(t for t in full if t != full[0])
+    # eager generate() with the same eos is the parity oracle
+    want_t = gpt_model.generate(Tensor(np.asarray([prompt], "int64")),
+                                max_new_tokens=8, eos_token_id=eos,
+                                decode_strategy="greedy")
+    want = np.asarray(want_t._data)[0, len(prompt):].tolist()
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    with engine:
+        free0 = engine.pool.available()
+        req = engine.submit(prompt, max_new_tokens=8, eos_token_id=eos)
+        got = req.wait(timeout=60)
+        deadline = time.monotonic() + 5
+        while engine.pool.available() < free0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        # stop-on-EOS: truncated at the first eos, pages back in the
+        # pool immediately
+        assert got == want
+        assert got[-1] == eos and eos not in got[:-1]
+        assert len(got) < 8
+        assert engine.pool.available() == free0
+
+
+def test_engine_streams_tokens_incrementally(gpt_model):
+    rs = np.random.RandomState(2)
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    with engine:
+        req = engine.submit(rs.randint(0, 128, (6,)).tolist(),
+                            max_new_tokens=5)
+        seen = list(req.stream(timeout=60))
+    assert len(seen) == 5 and seen == req.tokens
+    assert req.first_token_at is not None
+    assert req.finished_at >= req.first_token_at
+
+
+def test_engine_eviction_under_pressure_keeps_tokens(gpt_model):
+    """Page exhaustion preempts a sequence and requeues it; outputs
+    stay token-for-token identical to the unpressured run."""
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, 128, (12,)).tolist() for _ in range(3)]
+    want = _greedy_reference(gpt_model, prompts, 12)
+    engine = ServingEngine(gpt_model, max_batch=3, page_size=8,
+                           num_pages=8, max_pages_per_seq=4,
+                           prefix_caching=False)
+    with engine:
+        reqs = [engine.submit(p, max_new_tokens=12) for p in prompts]
+        got = [r.wait(timeout=120) for r in reqs]
+    assert engine.scheduler.evictions >= 1
+    assert got == want
+    assert engine.pool.available() == engine.pool.num_pages - 1
+
+
+def test_engine_temperature_sampling_runs(gpt_model):
+    rs = np.random.RandomState(4)
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    with engine:
+        req = engine.submit(rs.randint(0, 128, (6,)).tolist(),
+                            max_new_tokens=6, temperature=1.0)
+        toks = req.wait(timeout=60)
+    assert len(toks) == 6
+    assert all(0 <= t < 128 for t in toks)
+
+
+def test_engine_emits_observability_events(gpt_model, tmp_path):
+    from paddle_tpu.observability import events as obs_events
+    rs = np.random.RandomState(5)
+    set_flags({"FLAGS_observability_dir": str(tmp_path)})
+    try:
+        engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+        with engine:
+            engine.submit(rs.randint(0, 128, (9,)).tolist(),
+                          max_new_tokens=4).wait(timeout=60)
+    finally:
+        set_flags({"FLAGS_observability_dir": ""})
+    kinds = [e["kind"] for e in obs_events.read_events(str(tmp_path))]
+    assert "serving_admit" in kinds
+    assert "batch_step" in kinds
+    admits = [e for e in obs_events.read_events(str(tmp_path))
+              if e["kind"] == "serving_admit"]
+    assert admits[0]["prompt_len"] == 9
+
+
+# ---------------------------------------------------------------------------
+# HTTP /generate (engine mode)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def http_engine(gpt_model, flags_guard):
+    from paddle_tpu.inference.serving import InferenceServer
+    set_flags({"FLAGS_serving_engine": True})
+    engine = ServingEngine(gpt_model, max_batch=4, page_size=8)
+    engine.start()
+    srv = InferenceServer(engine=engine, max_in_flight=16).start()
+    yield srv, engine
+    try:
+        srv.stop()
+    finally:
+        engine.stop()
+
+
+def test_generate_http_stream_and_nonstream(http_engine, gpt_model):
+    from paddle_tpu.inference.serving import generate_http
+    srv, _ = http_engine
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 128, (9,)).tolist()
+    [want] = _greedy_reference(gpt_model, [prompt], 6)
+    # streaming NDJSON
+    got = list(generate_http(srv.url, prompt, max_new_tokens=6))
+    assert got == want
+    # non-streaming JSON body
+    body = json.dumps({"input_ids": prompt, "max_new_tokens": 6,
+                       "stream": False}).encode()
+    req = urllib.request.Request(srv.url + "/generate", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        payload = json.loads(r.read())
+    assert payload["tokens"] == want
+    # health surfaces the engine stats
+    with urllib.request.urlopen(srv.url + "/health", timeout=10) as r:
+        h = json.loads(r.read())
+    assert h["engine"]["queue_depth"] == 0
+    # /metrics exports the engine families
+    with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "paddle_serving_engine_request_seconds_bucket" in text
+    assert "paddle_serving_engine_queue_depth" in text
+
+
+def test_generate_http_bad_request_and_flag_gate(http_engine,
+                                                 gpt_model):
+    srv, _ = http_engine
+    # malformed body -> 400
+    req = urllib.request.Request(srv.url + "/generate",
+                                 data=b"not json", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+    # over-long request -> 400 at admission, not a hang
+    body = json.dumps({"input_ids": list(range(1000)),
+                       "max_new_tokens": 5000}).encode()
+    req = urllib.request.Request(srv.url + "/generate", data=body,
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+    # flag off -> 404 (the engine route is opt-in)
+    set_flags({"FLAGS_serving_engine": False})
+    body = json.dumps({"input_ids": [1, 2, 3]}).encode()
+    req = urllib.request.Request(srv.url + "/generate", data=body,
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 404
+    set_flags({"FLAGS_serving_engine": True})
+
+
+def test_stop_drains_inflight_stream_and_sheds_late_arrivals(
+        gpt_model, flags_guard):
+    """The drain satellite: stop() must finish an in-flight STREAMING
+    response before closing the socket, while a late arrival answers
+    503 + Retry-After exactly like the non-streaming path."""
+    from paddle_tpu.inference.serving import (InferenceServer,
+                                              generate_http)
+    set_flags({"FLAGS_serving_engine": True})
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    engine.start()
+    # max_in_flight=1: the stream occupies the only slot, so the late
+    # arrival hits the same 503 gate stop()'s _closing flag uses
+    srv = InferenceServer(engine=engine, max_in_flight=1).start()
+    rs = np.random.RandomState(0)
+    result = {}
+
+    def _long_stream():
+        result["toks"] = list(generate_http(
+            srv.url, rs.randint(0, 128, (8,)).tolist(),
+            max_new_tokens=24, retries=1))
+
+    t = threading.Thread(target=_long_stream)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:          # wait until admitted
+        with srv._state:
+            if srv._in_flight == 1:
+                break
+        time.sleep(0.005)
+    body = json.dumps({"input_ids": [1, 2, 3]}).encode()
+    req = urllib.request.Request(srv.url + "/generate", data=body,
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 503
+    assert e.value.headers.get("Retry-After") == "1"
+    # stop() must DRAIN the stream: all 24 tokens arrive, no truncation
+    stopper = threading.Thread(target=lambda: srv.stop(drain_timeout=30))
+    stopper.start()
+    t.join(timeout=60)
+    stopper.join(timeout=60)
+    engine.stop()
+    assert len(result.get("toks", [])) == 24
+
+
+# ---------------------------------------------------------------------------
+# PTL701 — serving step-loop host-sync rule
+# ---------------------------------------------------------------------------
+
+_PTL701_BAD = '''
+import numpy as np
+
+def run_step(plan, tokens, finished):
+    host = np.asarray(tokens)
+    if bool(finished.all()):
+        return host
+    while finished.any():
+        pass
+    return tokens.item()
+'''
+
+_PTL701_OK = '''
+import numpy as np
+
+def run_step(plan, tokens):
+    toks = np.asarray(tokens)  # noqa: PTL701 - admission boundary
+    return toks
+
+def build_tables(seqs):
+    # host bookkeeping OUTSIDE step-loop functions is fine
+    return np.asarray([s.pages for s in seqs])
+'''
+
+
+@pytest.mark.lint
+def test_ptl701_flags_host_syncs_in_step_loops():
+    from paddle_tpu.analysis.lint import lint_source
+    findings = lint_source(_PTL701_BAD,
+                           filename="paddle_tpu/serving/scheduler.py")
+    codes = [f.code for f in findings]
+    assert codes.count("PTL701") == 4      # asarray, all(), any(), item
+    lines = sorted(f.line for f in findings if f.code == "PTL701")
+    assert lines == [5, 6, 8, 10]
+
+
+@pytest.mark.lint
+def test_ptl701_noqa_and_non_step_functions_pass():
+    from paddle_tpu.analysis.lint import lint_source
+    findings = lint_source(_PTL701_OK,
+                           filename="paddle_tpu/serving/engine.py")
+    assert not [f for f in findings if f.code == "PTL701"]
+    # outside SERVING_GLOBS the rule stays silent entirely
+    findings = lint_source(_PTL701_BAD,
+                           filename="paddle_tpu/tensor/math.py")
+    assert not [f for f in findings if f.code == "PTL701"]
+
+
+@pytest.mark.lint
+def test_serving_package_is_ptl701_clean():
+    import os
+
+    import paddle_tpu
+    from paddle_tpu.analysis.lint import lint_paths
+    pkg = os.path.join(os.path.dirname(paddle_tpu.__file__), "serving")
+    findings = [f for f in lint_paths([pkg]) if f.code == "PTL701"]
+    assert findings == []

@@ -5,8 +5,11 @@ Runs the tracing-safety lint over the package + examples + tools and
 the op-registry consistency check, printing a summary.  The lint pass
 includes the resilience exception-hygiene rule (PTL401: bare except /
 except Exception without re-raise or logging in resilience/,
-distributed/checkpoint/, and inference/).  This is the scriptable twin
-of `pytest -m lint` for environments without pytest:
+distributed/checkpoint/, and inference/) and the serving step-loop
+host-sync rule (PTL701: .item()/np.asarray/finished.all()-style reads
+in serving/scheduler + serving/engine step-loop code paths; the one
+admission-boundary read carries a reasoned noqa).  This is the
+scriptable twin of `pytest -m lint` for environments without pytest:
 
     python tools/run_analysis.py            # lint + registry + cost model
                                             # + event schema + pass verify
