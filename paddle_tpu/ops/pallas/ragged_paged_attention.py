@@ -11,9 +11,11 @@ reference ecosystem serves this with block_multihead_attention +
 separate prefill/decode kernels; the TPU-native shape is a single
 launch whose grid walks (sequence, page) with the per-sequence
 lengths and page tables riding as scalar-prefetch refs — the index
-maps pick each sequence's OWN pages out of the shared pool, so wildly
-different context lengths cost only their own pages, not the padded
-maximum.
+maps pick each sequence's OWN pages out of the shared pool, and pages
+past a sequence's length are skipped under ``pl.when``, so the dot-
+product FLOPs of wildly different context lengths cost only their own
+pages.  (The grid itself is still statically ``(B, ppseq)``: the
+skipped steps pay their block copies but no compute.)
 
 Layout:
 
@@ -136,35 +138,45 @@ def _ragged_kernel(kv_lens_ref, q_lens_ref, tables_ref, q_ref, k_ref,
 
     kv_len = kv_lens_ref[b]
     q_len = q_lens_ref[b]
-    # [rows, ps] index planes: query row i of head h sits at flat row
-    # h*Q + i; its absolute position is kv_len - q_len + i
-    qi = jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 0) \
-        % jnp.int32(q_width)
-    kvpos = jnp.int32(page_size) * p \
-        + jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 1)
-    qpos = kv_len - q_len + qi
-    mask = (kvpos <= qpos) & (kvpos < kv_len)
-    qf = jnp.swapaxes(q_ref[0], 0, 1).reshape(rows, -1) \
-        .astype(jnp.float32)                             # [nh*Q, hd]
-    for g in range(n_kv):                                # static GQA loop
-        sl = slice(g * n_rep * q_width, (g + 1) * n_rep * q_width)
-        kg = k_ref[g, 0].astype(jnp.float32)             # [ps, hd]
-        vg = v_ref[g, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(qf[sl], kg,
-                                (((1,), (1,)), ((), ()))) \
-            * jnp.float32(scale)
-        s = jnp.where(mask[sl], s, jnp.float32(-1e30))
-        m_prev = m_ref[sl]                               # [rows_g, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        # masked probabilities: a fully-masked page must contribute 0,
-        # not exp(-1e30 - (-1e30)) == 1
-        prob = jnp.where(mask[sl], jnp.exp(s - m_new), jnp.float32(0.0))
-        d_ref[sl] = d_ref[sl] * alpha \
-            + jnp.sum(prob, axis=-1, keepdims=True)
-        acc_ref[sl] = acc_ref[sl] * alpha \
-            + jax.lax.dot_general(prob, vg, (((1,), (0,)), ((), ())))
-        m_ref[sl] = m_new
+
+    # pages at or past ceil(kv_len / page_size) hold no attendable
+    # slot for this sequence (their table entries fetch page 0, fully
+    # masked) — skip their dot products entirely, so per-step compute
+    # scales with the sequence's OWN length, not the padded maximum
+    @pl.when(jnp.int32(page_size) * p < kv_len)
+    def _compute():
+        # [rows, ps] index planes: query row i of head h sits at flat
+        # row h*Q + i; its absolute position is kv_len - q_len + i
+        qi = jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 0) \
+            % jnp.int32(q_width)
+        kvpos = jnp.int32(page_size) * p \
+            + jax.lax.broadcasted_iota(jnp.int32, (rows, page_size), 1)
+        qpos = kv_len - q_len + qi
+        mask = (kvpos <= qpos) & (kvpos < kv_len)
+        qf = jnp.swapaxes(q_ref[0], 0, 1).reshape(rows, -1) \
+            .astype(jnp.float32)                         # [nh*Q, hd]
+        for g in range(n_kv):                            # static GQA loop
+            sl = slice(g * n_rep * q_width, (g + 1) * n_rep * q_width)
+            kg = k_ref[g, 0].astype(jnp.float32)         # [ps, hd]
+            vg = v_ref[g, 0].astype(jnp.float32)
+            s = jax.lax.dot_general(qf[sl], kg,
+                                    (((1,), (1,)), ((), ()))) \
+                * jnp.float32(scale)
+            s = jnp.where(mask[sl], s, jnp.float32(-1e30))
+            m_prev = m_ref[sl]                           # [rows_g, 1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            # masked probabilities: a fully-masked page must
+            # contribute 0, not exp(-1e30 - (-1e30)) == 1
+            prob = jnp.where(mask[sl], jnp.exp(s - m_new),
+                             jnp.float32(0.0))
+            d_ref[sl] = d_ref[sl] * alpha \
+                + jnp.sum(prob, axis=-1, keepdims=True)
+            acc_ref[sl] = acc_ref[sl] * alpha \
+                + jax.lax.dot_general(prob, vg,
+                                      (((1,), (0,)), ((), ())))
+            m_ref[sl] = m_new
 
     @pl.when(p == pages_per_seq - 1)
     def _finalize():

@@ -128,7 +128,8 @@ class ServingEngine:
         self.scheduler = Scheduler(
             self.pool, max_batch, max_pages_per_seq,
             prefix_cache=self.prefix_cache, max_queue=max_queue,
-            max_prefill_chunk=max_prefill_chunk)
+            max_prefill_chunk=max_prefill_chunk,
+            max_seq_len=max_pos)
         self.max_batch = int(max_batch)
         self.default_eos = None if eos_token_id is None \
             else int(eos_token_id)
@@ -304,6 +305,8 @@ class ServingEngine:
             self._c_prefill.inc(plan.fed_prefill)
             now = time.monotonic()
             for i, seq in enumerate(plan.seqs):
+                if seq.req.done:
+                    continue        # finished (stop()/error) mid-step
                 if seq.kv_len < len(seq.tokens):
                     continue        # chunked prefill still in flight
                 req = seq.req

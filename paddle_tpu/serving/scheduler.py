@@ -152,6 +152,11 @@ class Request:
         self._queue.put(int(tok))
 
     def _finish(self, error: Optional[str] = None) -> None:
+        # idempotent: stop() and an in-flight step can both try to
+        # finish the same request; only the first one wins (a second
+        # call would push a spurious None past the stream sentinel)
+        if self._done.is_set():
+            return
         self.error = error
         self.finished_at = time.monotonic()
         self._done.set()
@@ -200,12 +205,17 @@ class Scheduler:
 
     def __init__(self, pool: PagePool, max_batch: int,
                  max_pages_per_seq: int, prefix_cache=None,
-                 max_queue: int = 1024, max_prefill_chunk: int = 0):
+                 max_queue: int = 1024, max_prefill_chunk: int = 0,
+                 max_seq_len: int = 0):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.ppseq = int(max_pages_per_seq)
         self.prefix_cache = prefix_cache
         self.max_queue = int(max_queue)
+        # page capacity rounds UP to whole pages; the model's position
+        # tables do not — admission must respect the tighter of the two
+        # (out-of-range positions would silently clip in jnp.take)
+        self.max_seq_len = int(max_seq_len)
         # 0: prefill a whole remaining prompt in one step; >0 caps the
         # per-iteration chunk (bounds Q and the step's latency impact
         # on co-scheduled decodes)
@@ -217,6 +227,8 @@ class Scheduler:
     # -- queue side ------------------------------------------------------
     def submit(self, req: Request) -> None:
         cap = self.ppseq * self.pool.page_size
+        if self.max_seq_len:
+            cap = min(cap, self.max_seq_len)
         if len(req.prompt) + req.max_new_tokens > cap:
             req._finish(error=f"request needs {len(req.prompt)} + "
                               f"{req.max_new_tokens} tokens; a sequence "
@@ -279,9 +291,12 @@ class Scheduler:
         cached_len = min(len(cached_pages) * ps, len(seq.tokens) - 1)
         use_pages = cached_pages[:-(-cached_len // ps) if cached_len
                                  else 0]
-        total_pages = -(-len(seq.tokens) // ps)
-        if self.pool.available() < total_pages - len(use_pages):
-            return None
+        # NO free-list pre-check here: the pool may be held entirely by
+        # cache-only prompt pages, and only ``_grow`` reclaims those.
+        # Ref the matched pages FIRST so reclaim cannot free them out
+        # from under us, then let _grow reclaim/allocate the rest; on
+        # failure the shared refs roll back and the request stays at
+        # the head of the queue.
         for page in use_pages:
             self.pool.ref(page)
             seq.pages.append(page)
@@ -289,7 +304,7 @@ class Scheduler:
         seq.kv_len = cached_len
         seq.cached_tokens = cached_len
         if not self._grow(seq, len(seq.tokens)):
-            # raced with reclaim failure: roll back the shared refs
+            # pool short even after reclaiming cache-only pages
             self._release(seq)
             return None
         self.waiting.popleft()
@@ -416,6 +431,11 @@ class Scheduler:
 
     def commit(self, plan: StepPlan) -> None:
         """Mark the plan's tokens as committed to the pages (called
-        after the step ran)."""
+        after the step ran).  Sequences whose request finished while
+        the step was in flight (``stop()``, a failed step) have been
+        released — their pages may already be reallocated, so nothing
+        is committed for them."""
         for i, seq in enumerate(plan.seqs):
+            if seq.req.done:
+                continue
             seq.kv_len = int(plan.kv_lens[i])

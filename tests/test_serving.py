@@ -216,6 +216,59 @@ def test_request_too_long_fails_fast():
         r.wait(timeout=1)
 
 
+def test_submit_respects_position_embedding_cap():
+    pool = PagePool(num_pages=8, page_size=4)
+    # page capacity is 2*4 == 8 but the model's position tables stop
+    # at 6: admission must use the tighter bound (jnp.take would clip
+    # out-of-range positions silently, not raise)
+    sched = Scheduler(pool, max_batch=2, max_pages_per_seq=2,
+                      max_seq_len=6)
+    r = Request([1, 2, 3, 4], max_new_tokens=3)     # 7 > 6
+    sched.submit(r)
+    assert r.done
+    with pytest.raises(RuntimeError, match="at most 6"):
+        r.wait(timeout=1)
+    ok = Request([1, 2, 3, 4], max_new_tokens=2)    # exactly 6 fits
+    sched.submit(ok)
+    assert not ok.done and sched.queue_depth() == 1
+
+
+def test_admission_reclaims_cache_only_pages():
+    # REGRESSION: a pool held ENTIRELY by cache-only prompt pages
+    # (refcount 1, running batch drained) must not wedge admission —
+    # _admit_one has to reclaim through the prefix cache instead of
+    # bailing on the raw free-list count, else new requests hang until
+    # client timeout.
+    pool = PagePool(num_pages=5, page_size=4)       # 4 allocatable + sink
+    cache = PrefixCache(pool)
+    sched = Scheduler(pool, max_batch=2, max_pages_per_seq=2,
+                      prefix_cache=cache)
+    for base in (0, 100, 200, 300):                 # pin every free page
+        page = pool.alloc()
+        cache.insert([base, base + 1, base + 2, base + 3], [page])
+        pool.unref(page)                # owner done; cache ref remains
+    assert pool.available() == 0 and len(cache) == 4
+    r = Request(list(range(400, 405)), max_new_tokens=2)  # 2 fresh pages
+    sched.submit(r)
+    plan, admitted, evicted = sched.plan_step()
+    assert plan is not None
+    assert [s.req.id for s in admitted] == [r.id] and not evicted
+    assert cache.stats()["reclaimed"] == 2          # LRU pair freed
+    sched.commit(plan)
+
+
+def test_request_finish_is_idempotent():
+    # stop() and an in-flight step can both finish a request; the
+    # second call must not clobber state or push a second sentinel
+    r = Request([1], max_new_tokens=1)
+    r._emit(5)
+    r._finish()
+    r._finish(error="late step")
+    assert r.wait(timeout=1) == [5] and r.error is None
+    assert list(r.stream(timeout=0.1)) == [5]
+    assert r._queue.empty()             # exactly one None sentinel
+
+
 # ---------------------------------------------------------------------------
 # prefix cache
 # ---------------------------------------------------------------------------
