@@ -1,4 +1,5 @@
-"""PTL502 — event-schema drift checker for paddle_tpu.observability.
+"""PTL502/PTL503 — event-schema + tracing hygiene for
+paddle_tpu.observability.
 
 Downstream tools parse the JSONL event log by the documented schema
 (``observability.events.EVENT_SCHEMA`` + docs/observability_events.md).
@@ -11,6 +12,13 @@ This pass holds the three surfaces together:
    nothing produces is dead documentation);
 3. the schema doc file names every kind (so a new emitter cannot ship
    without its parse contract).
+
+PTL503 (:func:`check_tracing`) holds the tracing layer to its own
+contract: a ``tracing.start_span()`` result that is discarded or
+assigned and never ``end()``-ed (and never escapes the function — a
+Span handed to a request object closes elsewhere) leaks an open span,
+and an ``emit`` stamping ``span``/``parent`` without ``trace_id``
+writes a record no trace can claim.
 
 AST-based and stdlib-only — importable without jax, wired into
 ``tools/run_analysis.py --metrics-schema`` and ``pytest -m lint``.
@@ -132,4 +140,145 @@ def check_event_schema(repo_root: Optional[str] = None
                 f"event kind {kind!r} is not documented in "
                 f"{SCHEMA_DOC}",
                 file=SCHEMA_DOC))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PTL503 — tracing-span hygiene
+# ---------------------------------------------------------------------------
+
+# call shapes that open a span whose .end() the caller now owes:
+# tracing.start_span(...), _tracing.start_span(...), obs_tracing....;
+# bare start_span(...) counts inside the observability package only
+_SPAN_STARTER = "start_span"
+_TRACING_BASES = {"tracing", "_tracing", "obs_tracing", "_obs_tracing"}
+
+
+def _is_start_span(node: ast.Call, allow_bare: bool) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else ""
+        return f.attr == _SPAN_STARTER and base in _TRACING_BASES
+    return allow_bare and isinstance(f, ast.Name) \
+        and f.id == _SPAN_STARTER
+
+
+def _noqa_503_lines(source: str) -> set:
+    out = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        low = line.lower()
+        if "# noqa" in low and ("ptl503" in low
+                                or low.rstrip().endswith("# noqa")):
+            out.add(i)
+    return out
+
+
+def tracing_findings_source(source: str, filename: str,
+                            allow_bare: bool = False
+                            ) -> List[Finding]:
+    """PTL503 over one source blob (the fixture-testable core).
+
+    Flags (1) a ``start_span`` call whose result is discarded (bare
+    expression statement) or bound to a local name that is never used
+    again — the span can never be ended; a name that escapes (``.end``
+    receiver, returned, passed on, stored on an object) is the owner's
+    problem, not this call site's; (2) ``events.emit``/``span`` sites
+    stamping ``span``/``parent`` without ``trace_id``."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError:
+        return []
+    noqa = _noqa_503_lines(source)
+    findings: List[Finding] = []
+
+    for kind, kws, line, col in _emit_sites(tree, allow_bare):
+        named = {k for k in kws if k is not None}
+        if ("span" in named or "parent" in named) \
+                and "trace_id" not in named and line not in noqa:
+            findings.append(make_finding(
+                "PTL503",
+                f"emit of {kind!r} stamps "
+                f"{sorted(named & {'span', 'parent'})} without "
+                "'trace_id' — the record cannot be attached to any "
+                "trace", file=filename, line=line, col=col))
+
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        own = [n for n in ast.walk(fn)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and n is not fn]
+        nested = {id(x) for sub in own for x in ast.walk(sub)}
+        body_nodes = [n for n in ast.walk(fn)
+                      if id(n) not in nested and n is not fn]
+        # discarded result: a bare `start_span(...)` statement
+        for node in body_nodes:
+            if isinstance(node, ast.Expr) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_start_span(node.value, allow_bare) \
+                    and node.lineno not in noqa:
+                findings.append(make_finding(
+                    "PTL503",
+                    "start_span() result discarded — the span can "
+                    "never be ended (use the trace_span context "
+                    "manager, or keep the handle and end() it)",
+                    file=filename, line=node.lineno,
+                    col=node.col_offset))
+        # assigned-but-unused result
+        candidates: Dict[str, ast.Assign] = {}
+        for node in body_nodes:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_start_span(node.value, allow_bare) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                candidates[node.targets[0].id] = node
+        if not candidates:
+            continue
+        # usage anywhere in the function (nested closures included —
+        # a span captured by an inner callback escapes this scope)
+        used: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in candidates:
+                assign = candidates[node.id]
+                if node.lineno > assign.lineno or \
+                        (node.lineno == assign.lineno
+                         and node.col_offset > assign.col_offset):
+                    used.add(node.id)
+        for name, assign in candidates.items():
+            if name not in used and assign.lineno not in noqa:
+                findings.append(make_finding(
+                    "PTL503",
+                    f"span {name!r} from start_span() is never used "
+                    "again — it can never be ended",
+                    file=filename, line=assign.lineno,
+                    col=assign.col_offset))
+    return findings
+
+
+def check_tracing(repo_root: Optional[str] = None) -> List[Finding]:
+    """Run the PTL503 tracing-hygiene pass over the whole package."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    pkg = os.path.join(repo_root, "paddle_tpu")
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    source = fh.read()
+            except OSError:
+                continue
+            rel = os.path.relpath(path, repo_root)
+            in_obs = os.sep + "observability" + os.sep in path
+            findings.extend(tracing_findings_source(
+                source, rel, allow_bare=in_obs))
     return findings

@@ -18,8 +18,8 @@ Code space:
   PTL4xx  resilience hygiene rules (exception handling in
           resilience-critical subsystems, see lint.py)
   PTL5xx  observability hygiene rules (raw-timing bypasses in
-          instrumented subsystems, event-schema drift; see lint.py and
-          obs_check.py)
+          instrumented subsystems, event-schema drift, tracing-span
+          hygiene; see lint.py and obs_check.py)
   PTL6xx  program-pass hygiene rules (replay-equivalence verification
           of registered graph passes, in-place _OpRecord mutation; see
           pass_check.py and lint.py)
@@ -303,6 +303,22 @@ _rule(
     "invisible until a dashboard breaks.",
     "Add the kind/field to observability.events.EVENT_SCHEMA and the "
     "schema doc, or fix the call site.")
+_rule(
+    "PTL503", "trace-span-hygiene", ERROR,
+    "tracing span never closed, or an emit site stamps a partial "
+    "trace envelope",
+    "A tracing.start_span() whose result is discarded (or assigned and "
+    "never ended/escaped) leaks an open span: the trace_span record is "
+    "never written, so the request's timeline reconstructed from the "
+    "JSONL log has a hole exactly where the interesting work happened. "
+    "Likewise an events.emit stamping 'span'/'parent' without "
+    "'trace_id' produces a record no trace can claim — it is invisible "
+    "to `observability trace` and the watchdog's span baselines.",
+    "End every started span (span.end(), the trace_span context "
+    "manager, or hand the Span off to the object that owns its "
+    "lifecycle), and always stamp trace_id alongside span/parent; a "
+    "deliberate exception takes '# noqa: PTL503' with a reason "
+    "comment.")
 _rule(
     "PTL601", "unverified-pass", ERROR,
     "registered program pass fails (or lacks) replay-equivalence "

@@ -20,6 +20,13 @@ from .strategy import Strategy
 from ...device import chip_peak_flops as _chip_peak_flops
 
 
+def _tpu_backend() -> bool:
+    """Whether tune() talks to a real TPU tunnel (tests monkeypatch
+    this to exercise the tunnel-protection policy on CPU)."""
+    import jax
+    return jax.devices()[0].platform == "tpu"
+
+
 class Engine:
     def __init__(self, model: Layer, loss=None, optimizer=None,
                  metrics=None, strategy: Optional[Strategy] = None):
@@ -94,9 +101,11 @@ class Engine:
         the best k candidates of the analytic roofline pre-rank, and
         ``budget_s`` stops starting new candidates once the wall budget
         is spent (in-flight work is never interrupted — killed requests
-        wedge the TPU tunnel).  On a TPU backend, unset top_k/budget_s
-        default to 3 candidates / 600 s so a dead tunnel cannot eat the
-        round.  Parameters and optimizer state are snapshotted around
+        wedge the TPU tunnel).  On a TPU backend an unset budget_s
+        defaults to 600 s, and an unset top_k defaults to 3 ONLY for
+        the auto-enumerated search space — an explicit ``candidates``
+        list (argument or strategy config) is never silently
+        truncated.  Parameters and optimizer state are snapshotted around
         each candidate's trial step and restored, the winning mesh is
         installed, and a report lands in ``self.tuning_report``."""
         import time as _time
@@ -108,19 +117,26 @@ class Engine:
             profile = bool(getattr(self._strategy.tuning, "profile",
                                    False))
         n = len(jax.devices())
-        # tunnel-protection defaults apply ONLY on tpu (a GPU user's
-        # explicit candidate list must not be silently capped)
-        if jax.devices()[0].platform == "tpu":
-            top_k = 3 if top_k is None else top_k
-            budget_s = 600.0 if budget_s is None else budget_s
         if candidates is None:
             candidates = self._strategy.tuning.candidates
+        explicit = candidates is not None
         if candidates is None:
             candidates = []
             for mp in (d for d in range(1, n + 1) if n % d == 0):
                 rest = n // mp
                 for sh in (d for d in range(1, rest + 1) if rest % d == 0):
                     candidates.append((rest // sh, sh, mp))
+        # tunnel-protection defaults apply ONLY on tpu, and the top_k
+        # cap ONLY to the auto-enumerated search space: a user's
+        # explicit candidate list (argument or strategy config) must
+        # never be silently truncated — every named candidate is
+        # measured unless the caller caps top_k themselves.  The wall
+        # budget still applies either way (a dead tunnel must not eat
+        # the round however the list was built).
+        if _tpu_backend():
+            if top_k is None and not explicit:
+                top_k = 3
+            budget_s = 600.0 if budget_s is None else budget_s
         batch = [np.asarray(sample_inputs)]
         if sample_labels is not None:
             if isinstance(sample_labels, (list, tuple)):

@@ -93,7 +93,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 and _pair_repeating(sin_a, use_neox_rotary_style)):
             from ....flags import get_flag
 
-            def fp(x, s, c):
+            # the rotary style rides the RECORDED kwargs (not just the
+            # closure): onnx export reads it back instead of guessing
+            # the style numerically — a sin≈0 trace (position 0) is
+            # otherwise genuinely ambiguous
+            def fp(x, s, c, use_neox_rotary_style=use_neox_rotary_style):
                 b, sl, h, hd = x.shape
                 xt = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, sl, hd)
                 out = _prope.rope_bhsd(
@@ -103,10 +107,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                 return jnp.transpose(out.reshape(b, h, sl, hd),
                                      (0, 2, 1, 3))
 
-            return call_op(fp, (t, sin_a, cos_a), {},
+            return call_op(fp, (t, sin_a, cos_a),
+                           {"use_neox_rotary_style":
+                            bool(use_neox_rotary_style)},
                            op_name="fused_rope")
 
-        def f(x, s, c):
+        def f(x, s, c, use_neox_rotary_style=use_neox_rotary_style):
             # x: [B, S, H, D]
             if use_neox_rotary_style:
                 x1, x2 = jnp.split(x, 2, axis=-1)
@@ -121,7 +127,10 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             if s.ndim == 3:            # [B, S, D] → insert head axis
                 s, c = s[:, :, None, :], c[:, :, None, :]
             return x * c + rot * s
-        return call_op(f, (t, sin_a, cos_a), {}, op_name="fused_rope")
+        return call_op(f, (t, sin_a, cos_a),
+                       {"use_neox_rotary_style":
+                        bool(use_neox_rotary_style)},
+                       op_name="fused_rope")
     sin_t = sin if isinstance(sin, Tensor) else Tensor(sin)
     cos_t = cos if isinstance(cos, Tensor) else Tensor(cos)
     outs = []

@@ -70,6 +70,7 @@ import numpy as np
 from . import Config, Predictor, create_predictor
 from ..observability import metrics as _metrics
 from ..observability import events as _events
+from ..observability import tracing as _tracing
 from ..resilience.retry import with_retries
 
 __all__ = ["InferenceServer", "serve", "predict_http", "generate_http"]
@@ -154,6 +155,15 @@ class InferenceServer:
                     self._reply(200, body,
                                 "text/plain; version=0.0.4")
                     return
+                if self.path == "/debug/trace":
+                    # on-demand flight-recorder dump: the bounded ring
+                    # of recent events/spans, newest last (the same
+                    # content a crash/SIGTERM writes to
+                    # flight-<pid>.json)
+                    self._reply(200, json.dumps(
+                        _tracing.flight_snapshot(),
+                        default=str).encode())
+                    return
                 if self.path != "/health":
                     self._reply(404, b'{"error": "unknown path"}')
                     return
@@ -227,13 +237,25 @@ class InferenceServer:
                     self._reply(400, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())
                     return
-                req = outer.engine.submit(ids, **kw)
+                # W3C trace context: a client traceparent parents the
+                # request's root span; responses echo the header back
+                # with the SERVER root span id so the client can splice
+                # its own spans around ours
+                ctx = _tracing.parse_traceparent(
+                    self.headers.get(_tracing.TRACEPARENT_HEADER))
+                req = outer.engine.submit(ids, trace=ctx, **kw)
+                tp = None if req.trace is None else \
+                    _tracing.format_traceparent(req.trace.trace_id,
+                                                req.trace.span_id)
+                tp_headers = () if tp is None else \
+                    ((_tracing.TRACEPARENT_HEADER, tp),)
                 if req.done and req.error:
                     # rejected at admission (too long, queue full):
                     # still the request's shape, not our failure
                     outer._c_bad.inc()
                     self._reply(400, json.dumps(
-                        {"error": req.error}).encode())
+                        {"error": req.error}).encode(),
+                        extra_headers=tp_headers)
                     return
                 if not spec.get("stream", True):
                     try:
@@ -243,12 +265,14 @@ class InferenceServer:
                         outer._c_errors.inc()
                         self._reply(500, json.dumps(
                             {"error": f"{type(e).__name__}: "
-                                      f"{e}"}).encode())
+                                      f"{e}"}).encode(),
+                            extra_headers=tp_headers)
                         return
                     outer._c_served.inc()
                     self._reply(200, json.dumps(
                         {"tokens": toks,
-                         "request_id": req.id}).encode())
+                         "request_id": req.id}).encode(),
+                        extra_headers=tp_headers)
                     return
                 # ---- streaming: newline-delimited JSON, one line per
                 # token as each batch iteration lands; the response is
@@ -257,6 +281,8 @@ class InferenceServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("X-Request-Id", req.id)
+                if tp is not None:
+                    self.send_header(_tracing.TRACEPARENT_HEADER, tp)
                 self.end_headers()
                 try:
                     for tok in req.stream(timeout=outer.stream_timeout):
@@ -464,12 +490,17 @@ def predict_http(url: str, *inputs: np.ndarray, timeout: float = 30.0,
 def generate_http(url: str, input_ids, max_new_tokens: int = 32,
                   eos_token_id: Optional[int] = None,
                   temperature: float = 0.0, timeout: float = 120.0,
-                  retries: int = 4, retry_backoff: float = 0.1):
+                  retries: int = 4, retry_backoff: float = 0.1,
+                  traceparent: Optional[str] = None):
     """Streaming client for the engine-mode ``POST /generate`` route:
     a generator yielding token ids as the server's batch iterations
     land.  Connection establishment (incl. the 503 overload answer)
     retries with the shared backoff; once the stream starts, a
-    truncated response (no ``done`` line) raises."""
+    truncated response (no ``done`` line) raises.
+
+    A W3C ``traceparent`` header always rides the request: the one
+    given, else the ambient tracing context, else a fresh trace — so
+    the server-side span tree is client-correlatable by default."""
     import urllib.request
     body = {"input_ids": [int(t) for t in np.asarray(
         input_ids).reshape(-1)], "max_new_tokens": int(max_new_tokens),
@@ -477,10 +508,17 @@ def generate_http(url: str, input_ids, max_new_tokens: int = 32,
     if eos_token_id is not None:
         body["eos_token_id"] = int(eos_token_id)
     data = json.dumps(body).encode()
+    if traceparent is None:
+        ctx = _tracing.current()
+        traceparent = _tracing.format_traceparent(
+            ctx.trace_id, ctx.span_id) if ctx is not None else \
+            _tracing.format_traceparent(_tracing.new_trace_id(),
+                                        _tracing.new_span_id())
 
     def _connect():
-        req = urllib.request.Request(url.rstrip("/") + "/generate",
-                                     data=data, method="POST")
+        req = urllib.request.Request(
+            url.rstrip("/") + "/generate", data=data, method="POST",
+            headers={_tracing.TRACEPARENT_HEADER: traceparent})
         return urllib.request.urlopen(req, timeout=timeout)
 
     resp = with_retries(_connect, attempts=max(1, int(retries)),

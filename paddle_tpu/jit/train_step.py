@@ -278,14 +278,20 @@ class TrainStep:
             # calls skip this block entirely.
             from ..observability import events as _obs_events
             if _obs_events.enabled():
+                from ..observability import tracing as _obs_tracing
                 import time as _time
-                _t0 = _time.perf_counter()
-                new_state, loss = fn(state, lr, batch_arrays)
-                _obs_events.emit(
-                    "compile", source="train_step",
-                    dur_s=round(_time.perf_counter() - _t0, 6),
-                    key=f"acc={sorted(state['o']['acc'])} "
-                        f"batch={[tuple(a.shape) for a in batch_arrays]}")
+                # the span makes the compile a first-class trace node
+                # (watchdog key trace_span:train_step_compile); the
+                # compile event inside it inherits the span's trace ids
+                with _obs_tracing.trace_span("train_step_compile"):
+                    _t0 = _time.perf_counter()
+                    new_state, loss = fn(state, lr, batch_arrays)
+                    _obs_events.emit(
+                        "compile", source="train_step",
+                        dur_s=round(_time.perf_counter() - _t0, 6),
+                        key=f"acc={sorted(state['o']['acc'])} "
+                            f"batch="
+                            f"{[tuple(a.shape) for a in batch_arrays]}")
             else:
                 new_state, loss = fn(state, lr, batch_arrays)
         else:

@@ -186,6 +186,11 @@ def _beam_search(model, arr, max_new_tokens, num_beams, length_penalty,
             [jnp.asarray(arr)[flat_idx],
              jnp.asarray(beam_tok.reshape(-1, 1), arr.dtype)], axis=1)
         beam_scores = jnp.asarray(new_scores)
+        if it == int(max_new_tokens) - 1:
+            # the loop is over: this iteration's forward (and the cache
+            # reorder feeding it) would be discarded — finalize reads
+            # only arr/beam_scores
+            continue
         if supports_cache:
             past = _reorder_past(past, flat_idx)
             logits, past = model(Tensor(arr[:, -1:]), past=past,
@@ -390,14 +395,24 @@ def _compiled_decode(model, arr, max_new_tokens, decode_strategy,
             bool(get_flag("pallas_interpret")))
     prog = programs.get(ckey)
     if prog is None:
+        from ..observability import tracing
         prog = _build_decode_program(
             step_fn, s_prompt=s_prompt,
             max_new=int(max_new_tokens), strategy=decode_strategy,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_token_id=eos_token_id)
         programs[ckey] = prog
-    tokens, n_steps, key_out, _ = prog(params, tokens, caches,
-                                       last_logits, key)
+        # the first call with this signature pays trace + XLA compile —
+        # span it (the steady-state path below skips the block)
+        with tracing.trace_span(
+                "decode_compile",
+                attrs={"batch": b, "prompt_len": s_prompt,
+                       "max_new_tokens": int(max_new_tokens)}):
+            tokens, n_steps, key_out, _ = prog(params, tokens, caches,
+                                               last_logits, key)
+    else:
+        tokens, n_steps, key_out, _ = prog(params, tokens, caches,
+                                           last_logits, key)
     n = int(n_steps)                       # the one host sync
     if sampling:
         default_generator.set_state(key_out)
@@ -614,15 +629,23 @@ def generate(model, input_ids, max_new_tokens: int = 20,
     try:
         arr = jnp.asarray(ids._data)
         if mk_requested and mk_reason is None:
-            out, n_gen = _compiled_decode(
-                model, arr, max_new_tokens, decode_strategy,
-                temperature, top_k, top_p, eos_token_id, last_only)
-            events.emit("decode_loop", model=type(model).__name__,
-                        batch=int(arr.shape[0]),
-                        prompt_len=int(arr.shape[1]),
-                        max_new_tokens=int(max_new_tokens),
-                        generated=n_gen, strategy=decode_strategy,
-                        compiled=True)
+            from ..observability import tracing
+            # the whole compiled generation (prefill + token loop) is
+            # one step span; the decode_compile child + the decode_loop
+            # event land inside it
+            with tracing.trace_span(
+                    "decode_loop",
+                    attrs={"model": type(model).__name__,
+                           "strategy": decode_strategy}):
+                out, n_gen = _compiled_decode(
+                    model, arr, max_new_tokens, decode_strategy,
+                    temperature, top_k, top_p, eos_token_id, last_only)
+                events.emit("decode_loop", model=type(model).__name__,
+                            batch=int(arr.shape[0]),
+                            prompt_len=int(arr.shape[1]),
+                            max_new_tokens=int(max_new_tokens),
+                            generated=n_gen, strategy=decode_strategy,
+                            compiled=True)
             return Tensor(out)
         if mk_requested:
             events.emit("decode_loop", model=type(model).__name__,

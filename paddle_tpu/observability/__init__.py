@@ -10,20 +10,32 @@ Two surfaces, one flag:
 * :mod:`~paddle_tpu.observability.events` — the append-only JSONL event
   log (step / compile / checkpoint / fault / restart / tuning /
   dispatch-summary records), enabled by ``FLAGS_observability_dir``.
+* :mod:`~paddle_tpu.observability.tracing` — per-request distributed
+  traces (W3C ``traceparent`` in/out, spans riding the event envelope)
+  and the crash/SIGTERM flight recorder.
+* :mod:`~paddle_tpu.observability.watchdog` — SLO regression gate over
+  per-kind duration baselines from historical event logs.
 
-CLI: ``python -m paddle_tpu.observability {snapshot,tail,report}``.
+CLI: ``python -m paddle_tpu.observability
+{snapshot,tail,report,trace,watchdog}``.
 
 Import-time is stdlib-only: ``flags.py`` reaches this package during
 env ingestion at bootstrap.
 """
 from . import metrics  # noqa: F401
 from . import events   # noqa: F401
+from . import tracing  # noqa: F401
+from . import watchdog  # noqa: F401
 from .metrics import (counter, gauge, histogram, default_registry,  # noqa: F401
                       HistogramValue, MetricsRegistry)
 from .events import (emit, span, read_events, emit_dispatch_summary,  # noqa: F401
                      EVENT_SCHEMA)
+from .tracing import (start_span, trace_span, parse_traceparent,  # noqa: F401
+                      format_traceparent, dump_flight, flight_snapshot)
 
-__all__ = ["metrics", "events", "counter", "gauge", "histogram",
-           "default_registry", "HistogramValue", "MetricsRegistry",
-           "emit", "span", "read_events", "emit_dispatch_summary",
-           "EVENT_SCHEMA"]
+__all__ = ["metrics", "events", "tracing", "watchdog", "counter",
+           "gauge", "histogram", "default_registry", "HistogramValue",
+           "MetricsRegistry", "emit", "span", "read_events",
+           "emit_dispatch_summary", "EVENT_SCHEMA", "start_span",
+           "trace_span", "parse_traceparent", "format_traceparent",
+           "dump_flight", "flight_snapshot"]

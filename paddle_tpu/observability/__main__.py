@@ -3,13 +3,22 @@
     python -m paddle_tpu.observability snapshot [--prometheus]
     python -m paddle_tpu.observability tail [--dir D] [-n N] [--kind K]
     python -m paddle_tpu.observability report [--dir D]
+    python -m paddle_tpu.observability trace TRACE_ID [--dir D] [--json]
+    python -m paddle_tpu.observability watchdog [--dir D]
+        [--baseline B] [--tolerance T] [--min-samples N] [--warn-only]
 
 ``snapshot`` dumps the process metrics registry (mostly useful from a
 REPL/test process — a fresh CLI process has empty counters; the live
 serving surface is ``GET /metrics``).  ``tail`` and ``report`` read the
 JSONL event log under ``--dir`` (default: ``FLAGS_observability_dir``).
 ``report`` aggregates step/compile/checkpoint/dispatch/fault records
-into the operator's one-screen view of a run.
+into the operator's one-screen view of a run.  ``trace`` reconstructs
+one request's span tree (queue → admit → batch-step links → finish)
+from the log alone and pretty-prints the timeline.  ``watchdog`` is
+the SLO regression gate: per-kind duration baselines from
+``--baseline`` (or the log's own first half when omitted) checked
+against the observed log — exit 0 clean, 3 on regression, so CI and
+bench.py can gate on it.
 """
 from __future__ import annotations
 
@@ -180,6 +189,63 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    from . import tracing
+    d = _resolve_dir(args.dir)
+    if not d:
+        print("no event log: pass --dir or set FLAGS_observability_dir",
+              file=sys.stderr)
+        return 2
+    recs = read_events(d)
+    mine = tracing.trace_records(recs, args.trace_id)
+    if not mine:
+        print(f"trace {args.trace_id!r} not found in {d}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(tracing.build_trace(recs, args.trace_id),
+                         indent=2, sort_keys=True, default=str))
+    else:
+        print(tracing.render_trace(recs, args.trace_id))
+    return 0
+
+
+def cmd_watchdog(args) -> int:
+    from . import watchdog
+    d = _resolve_dir(args.dir)
+    if not d:
+        print("no event log: pass --dir or set FLAGS_observability_dir",
+              file=sys.stderr)
+        return 2
+    recs = read_events(d)
+    kw = dict(tolerance=args.tolerance, min_samples=args.min_samples,
+              min_seconds=args.min_seconds)
+    if args.baseline:
+        base_recs = read_events(args.baseline)
+        baselines = watchdog.compute_baselines(
+            base_recs, min_samples=args.min_samples)
+        findings = watchdog.check(recs, baselines, **kw)
+        mode = "baseline"
+    else:
+        findings = watchdog.self_check(recs, **kw)
+        mode = "self"
+    if args.json:
+        print(json.dumps({"mode": mode, "events": len(recs),
+                          "regressions": findings},
+                         indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f"REGRESSION {f['key']}: p50 {f['baseline_p50']}s -> "
+                  f"{f['observed_p50']}s (x{f['ratio']}, "
+                  f"{'/'.join(f['stats'])} outside the "
+                  f"{args.tolerance:+.0%} band)")
+        print(f"watchdog[{mode}]: {len(recs)} event(s), "
+              f"{len(findings)} regression(s)")
+    if findings and not args.warn_only:
+        return 3
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m paddle_tpu.observability",
                                  description=__doc__)
@@ -197,6 +263,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--dir", default=None)
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_report)
+    p = sub.add_parser("trace", help="reconstruct and pretty-print one "
+                                     "request's span tree")
+    p.add_argument("trace_id")
+    p.add_argument("--dir", default=None)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_trace)
+    p = sub.add_parser("watchdog", help="SLO regression gate over "
+                                        "per-kind duration baselines "
+                                        "(exit 3 on regression)")
+    p.add_argument("--dir", default=None,
+                   help="observed log (default FLAGS_observability_dir)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline log dir/file; omitted: the observed "
+                        "log's first half baselines its second half")
+    p.add_argument("--tolerance", type=float, default=0.5)
+    p.add_argument("--min-samples", type=int, default=3)
+    p.add_argument("--min-seconds", type=float, default=1e-4)
+    p.add_argument("--warn-only", action="store_true",
+                   help="report regressions but exit 0")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_watchdog)
     args = ap.parse_args(argv)
     return args.fn(args)
 

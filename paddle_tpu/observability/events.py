@@ -49,10 +49,13 @@ __all__ = ["configure", "enabled", "emit", "span", "EventLog",
 SCHEMA_VERSION = 1
 
 # Envelope stamped on every record by the writer (span_id/dur_s are
-# added by :class:`span` regardless of kind).
+# added by :class:`span` regardless of kind; trace_id/span/parent are
+# the distributed-tracing fields — stamped explicitly by emitters or
+# implicitly from the ambient tracing context, see tracing.py).
 ENVELOPE_FIELDS: Dict[str, str] = {
     "v": "int", "ts": "float", "pid": "int", "run": "str", "kind": "str",
     "span_id": "int", "dur_s": "float",
+    "trace_id": "str", "span": "str", "parent": "str",
 }
 
 # kind -> {field: type}.  Every field an emitter may pass; emitters may
@@ -114,6 +117,11 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
                     "max_new_tokens": "int", "generated": "int",
                     "strategy": "str", "compiled": "bool",
                     "fallback": "str"},
+    # one closed tracing span (observability.tracing): trace_id/span/
+    # parent ride the envelope; `links` names OTHER traces' contexts a
+    # shared span (e.g. one ragged batch iteration) served
+    "trace_span": {"name": "str", "status": "str", "start_ts": "float",
+                   "attrs": "object", "links": "object"},
 }
 
 _lock = threading.Lock()
@@ -128,6 +136,23 @@ _PREV_HOST_HOOK = None
 _HOST_HOOK = None
 _MONITORING_ON = False
 _SPAN_IDS = itertools.count(1)
+# distributed-tracing integration (tracing.py registers both at import):
+# the provider returns envelope fields to stamp on records emitted
+# inside an active span; sinks see every record (the flight ring)
+_CTX_PROVIDER: Optional[Callable[[], Optional[Dict[str, Any]]]] = None
+_WRITE_SINKS: List[Callable[[Dict[str, Any]], None]] = []
+
+
+def set_context_provider(fn: Optional[Callable[[], Optional[Dict[str,
+                                                                 Any]]]]
+                         ) -> None:
+    global _CTX_PROVIDER
+    _CTX_PROVIDER = fn
+
+
+def add_write_sink(fn: Callable[[Dict[str, Any]], None]) -> None:
+    if fn not in _WRITE_SINKS:
+        _WRITE_SINKS.append(fn)
 
 
 class EventLog:
@@ -177,6 +202,17 @@ class EventLog:
         for k, v in fields.items():
             if v is not None:
                 rec[k] = v
+        prov = _CTX_PROVIDER
+        if prov is not None and "trace_id" not in rec:
+            ctx = prov()
+            if ctx:
+                for k, v in ctx.items():
+                    rec.setdefault(k, v)
+        for sink in _WRITE_SINKS:       # the flight-recorder ring
+            try:
+                sink(rec)
+            except Exception:
+                pass                    # telemetry must never raise
         line = json.dumps(rec, sort_keys=True, default=str) + "\n"
         with self._lock:
             try:

@@ -396,7 +396,12 @@ def _emit_fused_rope(e: _Emit, op, ins) -> None:
     interleave for GPT style, half-swap for neox) is a CONSTANT [D, D]
     permutation-sign matrix, so it lowers to one MatMul and the graph
     stays shape-agnostic (no Reshape that would pin the batch).  The
-    style flag is baked in a closure — recovered numerically."""
+    style comes from the RECORDED op kwargs
+    (``use_neox_rotary_style``), verified against the recorded output;
+    legacy traces without the kwarg fall back to numeric recovery,
+    which RAISES when both styles reproduce the output (a sin≈0 /
+    position-0 trace is genuinely ambiguous — silently picking one
+    would bake the wrong rotation into the artifact)."""
     x = _np(op.inputs[0]).astype(np.float64)
     sin = _np(op.inputs[1]).astype(np.float64)
     cos = _np(op.inputs[2]).astype(np.float64)
@@ -426,9 +431,27 @@ def _emit_fused_rope(e: _Emit, op, ins) -> None:
         return x * bcast(cos) + (x @ rot_matrix(neox).astype(np.float64)
                                  ) * bcast(sin)
 
-    neox = next((c for c in (False, True)
-                 if np.allclose(ref(c), want, atol=1e-4)), None)
-    if neox is None:
+    matches = [c for c in (False, True)
+               if np.allclose(ref(c), want, atol=1e-4)]
+    style = (op.kwargs or {}).get("use_neox_rotary_style")
+    if style is not None:
+        neox = bool(style)
+        if neox not in matches:
+            raise NotImplementedError(
+                "onnx export: the recorded use_neox_rotary_style="
+                f"{neox} does not reproduce the recorded fused_rope "
+                "output")
+    elif len(matches) == 1:
+        neox = matches[0]
+    elif len(matches) > 1:
+        raise NotImplementedError(
+            "onnx export: the rope rotary style is ambiguous — both "
+            "interleaved and neox rotations reproduce the recorded "
+            "output (sin≈0 trace, e.g. position 0 / seq 1) and the "
+            "recorded op carries no use_neox_rotary_style kwarg; "
+            "re-trace with a current build so the style rides the op "
+            "record")
+    else:
         raise NotImplementedError(
             "onnx export: could not recover the rope rotary style from "
             "the recorded output")

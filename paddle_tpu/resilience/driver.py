@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 from ..observability import events as obs_events
+from ..observability import tracing as obs_tracing
 from .faults import STATE_FILE_ENV, maybe_fault
 
 __all__ = ["ResilientTrainLoop", "RunReport", "run_resilient",
@@ -156,8 +157,12 @@ class ResilientTrainLoop:
             self._hb.ping()
         if self.preempted:
             # synchronous final checkpoint, then a CLEAN exit: the
-            # supervisor must not relaunch a preempted worker
+            # supervisor must not relaunch a preempted worker.  The
+            # flight recorder dumps the last-N events/spans alongside —
+            # the post-mortem view of what the worker was doing when
+            # SIGTERM landed
             self.save(step)
+            obs_tracing.dump_flight("preempt")
             if self.on_preempt is not None:
                 self.on_preempt(step)
             self._teardown()
@@ -310,6 +315,7 @@ def run_resilient(script: str, script_args: Optional[Sequence[str]] = None,
                     report.events.append("preempt:forward-sigterm")
                     obs_events.emit("preempt",
                                     grace_s=float(preempt_grace_s))
+                    obs_tracing.dump_flight("preempt-supervisor")
                     try:
                         proc.send_signal(signal.SIGTERM)
                     except OSError:

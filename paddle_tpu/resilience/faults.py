@@ -252,6 +252,15 @@ class FaultInjector:
             self._execute(spec, path)
 
     def _execute(self, spec: FaultSpec, path: Optional[str]) -> None:
+        if spec.kind in ("crash", "exit"):
+            # the process never returns from these: dump the flight
+            # recorder FIRST so the post-mortem ring survives (SIGKILL
+            # gives no atexit; lazy import mirrors the emit above)
+            try:
+                from ..observability import tracing
+                tracing.dump_flight(f"fault:{spec.kind}")
+            except ImportError:
+                pass
         if spec.kind == "crash":
             os.kill(os.getpid(), signal.SIGKILL)
         elif spec.kind == "exit":

@@ -41,6 +41,7 @@ __all__ = ["ServingEngine"]
 
 from ..observability import events as _events
 from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 from .prefix_cache import PrefixCache
 from .scheduler import PagePool, Request, Scheduler
 
@@ -206,12 +207,26 @@ class ServingEngine:
     def submit(self, input_ids, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0,
-               request_id: Optional[str] = None) -> Request:
-        """Queue one generation request; returns the live handle."""
+               request_id: Optional[str] = None,
+               trace=None) -> Request:
+        """Queue one generation request; returns the live handle.
+        ``trace`` is an optional :class:`~..observability.tracing.
+        TraceContext` to parent the request's root span on (the HTTP
+        layer passes the client ``traceparent`` here); without it a
+        fresh trace roots at this request when tracing is enabled."""
         req = Request(input_ids, max_new_tokens=max_new_tokens,
                       eos_token_id=(self.default_eos if eos_token_id
                                     is None else eos_token_id),
                       temperature=temperature, request_id=request_id)
+        root = _tracing.start_span(
+            "serving_request", parent=trace,
+            attrs={"request": req.id, "engine": self.engine_id,
+                   "prompt_len": len(req.prompt),
+                   "max_new_tokens": req.max_new_tokens})
+        if root is not _tracing.NOOP_SPAN:
+            req.trace = root.context
+            req._root_span = root
+            req._queue_span = _tracing.start_span("queue", parent=root)
         with self._wake:
             if not self._accepting:
                 req._finish(error="engine is not accepting requests")
@@ -237,19 +252,39 @@ class ServingEngine:
                 plan, admitted, evicted = self.scheduler.plan_step()
                 now = time.monotonic()
                 for seq in admitted:
+                    req = seq.req
+                    qs, req._queue_span = req._queue_span, None
+                    if qs is not None:
+                        # queue-wait over: prefix-cache hit + resume
+                        # facts land on the closing span
+                        qs.end(cached_tokens=seq.cached_tokens,
+                               resumed=req.evictions > 0)
+                    tr = req.trace
                     _events.emit(
-                        "serving_admit", request=seq.req.id,
-                        prompt_len=len(seq.req.prompt),
+                        "serving_admit", request=req.id,
+                        prompt_len=len(req.prompt),
                         cached_tokens=seq.cached_tokens,
-                        queue_s=round(now - seq.req.submitted_at, 6),
-                        resumed=seq.req.evictions > 0)
+                        queue_s=round(now - req.submitted_at, 6),
+                        resumed=req.evictions > 0,
+                        trace_id=tr.trace_id if tr else None,
+                        span=tr.span_id if tr else None)
                 for seq in evicted:
                     self._c_evict.inc()
+                    req = seq.req
+                    tr = req.trace
                     _events.emit(
-                        "evict", request=seq.req.id,
+                        "evict", request=req.id,
                         kv_len=len(seq.tokens),
                         n_generated=seq.n_generated,
-                        reason="page_exhaustion")
+                        reason="page_exhaustion",
+                        trace_id=tr.trace_id if tr else None,
+                        span=tr.span_id if tr else None)
+                    if tr is not None and req._queue_span is None:
+                        # requeued: a fresh queue-wait span opens under
+                        # the same root until re-admission
+                        req._queue_span = _tracing.start_span(
+                            "queue", parent=tr,
+                            attrs={"resumed": True})
                 self._g_queue.set(self.scheduler.queue_depth())
                 self._g_occ.set(len(self.scheduler.running))
             if plan is None:
@@ -271,6 +306,19 @@ class ServingEngine:
                             seq, error=f"{type(e).__name__}: {e}")
 
     def _run_step(self, plan):
+        # one SHARED step span for the whole ragged iteration, linked
+        # from every member request's trace — each request's timeline
+        # pulls its batch steps in through the links without owning
+        # them.  The span is the ambient context for the block, so the
+        # batch_step event below inherits its trace_id/span.
+        links = [{"trace_id": s.req.trace.trace_id,
+                  "span": s.req.trace.span_id}
+                 for s in plan.seqs if s.req.trace is not None]
+        with _tracing.trace_span("batch_step", links=links or None,
+                                 attrs={"engine": self.engine_id}):
+            self._run_step_traced(plan)
+
+    def _run_step_traced(self, plan):
         from ..core.dispatch import _emit_op_event
         qw = _bucket(plan.tok.shape[1])
         prog = self._program(qw)

@@ -118,6 +118,13 @@ class Request:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.evictions = 0
+        # distributed-tracing handles (observability.tracing), set by
+        # the engine at submit: the root request span ties every
+        # annotation together; the queue span is open whenever the
+        # request waits for admission (incl. after an eviction)
+        self.trace = None              # TraceContext of the root span
+        self._root_span = None
+        self._queue_span = None
 
     # -- consumer side ---------------------------------------------------
     def stream(self, timeout: Optional[float] = 60.0):
@@ -159,6 +166,15 @@ class Request:
             return
         self.error = error
         self.finished_at = time.monotonic()
+        # close the trace: a queue span still open here means the
+        # request died waiting (rejected / engine stopped)
+        qs, self._queue_span = self._queue_span, None
+        if qs is not None:
+            qs.end(status="error" if error else "cancelled")
+        rs, self._root_span = self._root_span, None
+        if rs is not None:
+            rs.end(status="error" if error else "ok", error=error,
+                   n_tokens=len(self.tokens), evictions=self.evictions)
         self._done.set()
         self._queue.put(None)
 

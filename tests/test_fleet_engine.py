@@ -157,6 +157,60 @@ def test_pipeline_layer_segmentation():
     assert y.shape == [2, 8]
 
 
+def test_engine_tune_tpu_topk_never_truncates_explicit_candidates(
+        monkeypatch):
+    """The TPU tunnel-protection top_k=3 default applies ONLY to the
+    auto-enumerated search space: a user's explicit candidates list
+    must be measured in full (silent truncation would drop the true
+    winner without a trace)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel import engine as eng_mod
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.strategy import Strategy
+
+    _fresh()
+    monkeypatch.setattr(eng_mod, "_tpu_backend", lambda: True)
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    eng = Engine(model, loss=lambda out, y: ((out - y) ** 2).mean(),
+                 optimizer=o, strategy=Strategy())
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 16).astype(np.float32)
+    y = rs.randn(8, 8).astype(np.float32)
+    cands = [(8, 1, 1), (4, 2, 1), (2, 2, 2), (1, 1, 8)]
+    got = eng.tune(x, y, candidates=cands)
+    assert got["dp"] * got["sharding"] * got["mp"] == 8
+    # every explicit candidate was attempted — none dropped by the
+    # roofline pre-rank cap
+    skipped = [e for e in eng.tuning_report
+               if e.get("skipped", "").startswith("below top_k")]
+    assert skipped == [], eng.tuning_report
+    attempted = [e for e in eng.tuning_report
+                 if "step_s" in e or "error" in e]
+    assert len(attempted) == len(cands), eng.tuning_report
+    # the auto-enumerated space (no explicit list) still gets the cap:
+    # 8 virtual devices enumerate >3 factorizations, only 3 measured
+    _fresh()
+    eng2 = Engine(model, loss=lambda out, y: ((out - y) ** 2).mean(),
+                  optimizer=o, strategy=Strategy())
+    eng2.tune(x, y, budget_s=600.0)
+    auto_skipped = [e for e in eng2.tuning_report
+                    if e.get("skipped", "").startswith("below top_k")]
+    auto_attempted = [e for e in eng2.tuning_report
+                      if "step_s" in e or "error" in e]
+    assert len(auto_attempted) == 3, eng2.tuning_report
+    assert auto_skipped, eng2.tuning_report
+    # an explicit top_k still caps an explicit list (the user asked)
+    _fresh()
+    eng3 = Engine(model, loss=lambda out, y: ((out - y) ** 2).mean(),
+                  optimizer=o, strategy=Strategy())
+    eng3.tune(x, y, candidates=cands, top_k=2)
+    assert len([e for e in eng3.tuning_report
+                if e.get("skipped", "").startswith("below top_k")]) \
+        == 2, eng3.tuning_report
+
+
 def test_engine_tuner_selects_a_mesh():
     """Engine.tune (ref: auto_parallel tuner): search (dp, sharding, mp)
     factorizations, score with the XLA cost model, install the winner —

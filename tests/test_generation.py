@@ -199,6 +199,57 @@ def test_beam_search_matches_transformers():
         Tensor(ids), max_new_tokens=8, decode_strategy="beam_search",
         num_beams=3, eos_token_id=17).numpy())
     np.testing.assert_array_equal(got2[:, :want2.shape[1]], want2)
+    # eos-case parity must not hide appended garbage past the finished
+    # length: either the widths match exactly, or every trailing
+    # column is pad (pad_token_id defaults to eos here)
+    assert got2.shape[1] == want2.shape[1] or \
+        (got2[:, want2.shape[1]:] == 17).all(), got2
+
+
+def _count_beam_ops(model, ids, max_new, **kw):
+    from paddle_tpu.core.dispatch import observe_op_stream
+    n = {"ops": 0}
+    with observe_op_stream(lambda ev: n.__setitem__("ops",
+                                                    n["ops"] + 1)):
+        model.generate(Tensor(ids), max_new_tokens=max_new,
+                       decode_strategy="beam_search", num_beams=2, **kw)
+    return n["ops"]
+
+
+@pytest.mark.parametrize("use_cache", [True, False],
+                         ids=["cached", "recompute"])
+def test_beam_search_skips_discarded_final_forward(use_cache):
+    """The last loop iteration's model forward is never consumed
+    (finalize reads only arr/beam_scores) — it must not dispatch.
+    Proven via the op-stream hook: the marginal op cost of one more
+    beam token equals one decode step, and a 1-token beam search
+    dispatches exactly the prefill (plus selection, which is pure jnp
+    and never enters the op stream)."""
+    import inspect
+    m = _tiny_llama(11)
+    m.eval()
+    ids = np.array([[3, 9, 17, 25]], np.int64)
+    ops1 = _count_beam_ops(m, ids, 1, use_cache=use_cache)
+    ops2 = _count_beam_ops(m, ids, 2, use_cache=use_cache)
+    ops3 = _count_beam_ops(m, ids, 3, use_cache=use_cache)
+    # each extra token costs exactly one (reorder+)forward...
+    assert ops3 - ops2 == ops2 - ops1 > 0
+    # ...and max_new_tokens=1 pays ONLY the prefill: replicate the
+    # beam path's own prefill call and compare dispatch counts
+    from paddle_tpu.core.dispatch import observe_op_stream
+    arr = np.repeat(ids, 2, axis=0)
+    params = inspect.signature(m.forward).parameters
+    supports_cache = use_cache and "use_cache" in params
+    n = {"ops": 0}
+    with observe_op_stream(lambda ev: n.__setitem__("ops",
+                                                    n["ops"] + 1)):
+        if supports_cache:
+            kw = {"last_logits_only": True} \
+                if "last_logits_only" in params else {}
+            m(Tensor(arr), use_cache=True, **kw)
+        else:
+            m(Tensor(arr))
+    assert ops1 == n["ops"]
 
 
 def test_beam_search_rejects_paged_cache():

@@ -582,3 +582,105 @@ def test_export_llama_dynamic_batch(tmp_path):
     got = got[0] if isinstance(got, (tuple, list)) else got
     want = np.asarray(m(paddle.to_tensor(ids)).numpy())
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class _RopePos0(nn.Layer):
+    """Applies rope to a seq-1 input with the POSITION-0 table row
+    (sin=0, cos=1): both rotary styles produce numerically identical
+    output, so the recorded trace alone cannot disambiguate them."""
+
+    def __init__(self, neox):
+        super().__init__()
+        self.neox = bool(neox)
+
+    def forward(self, x):                    # x: [B, 1, H, D]
+        from paddle_tpu.incubate.nn.functional import \
+            fused_rotary_position_embedding
+        d = x.shape[-1]
+        sin = paddle.to_tensor(np.zeros((1, d), np.float32))
+        cos = paddle.to_tensor(np.ones((1, d), np.float32))
+        q, _, _ = fused_rotary_position_embedding(
+            x, sin=sin, cos=cos, use_neox_rotary_style=self.neox)
+        return q
+
+
+def _rope_rot_matrix(neox, d):
+    m = np.zeros((d, d), np.float32)
+    if neox:
+        for j in range(d // 2):
+            m[j + d // 2, j] = -1.0
+            m[j, j + d // 2] = 1.0
+    else:
+        for j in range(0, d, 2):
+            m[j + 1, j] = -1.0
+            m[j, j + 1] = 1.0
+    return m
+
+
+@pytest.mark.parametrize("neox", [False, True],
+                         ids=["interleaved", "neox"])
+def test_export_rope_style_rides_op_kwargs(tmp_path, neox):
+    """A position-0 / seq-1 trace is numerically style-ambiguous
+    (sin≈0): the exporter must take the style from the RECORDED op
+    kwargs and bake the matching rotation matrix — before the kwarg
+    was threaded through, neox traces silently exported the
+    interleaved rotation."""
+    m = _RopePos0(neox)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 1, 2, 8).astype(np.float32))
+    p = export(m, str(tmp_path / f"rope_{neox}"), input_spec=[x])
+    _, _, nodes, inits, _, _ = _decode_model(p)
+    want_m = _rope_rot_matrix(neox, 8).tobytes()
+    other_m = _rope_rot_matrix(not neox, 8).tobytes()
+    raw = [_fields(i, 9)[0] for i in inits]
+    assert any(v == want_m for v in raw)
+    assert not any(v == other_m for v in raw)
+    got = run_model(p, x.numpy())
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(got, m(x).numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_export_rope_legacy_ambiguous_trace_raises():
+    """A legacy trace without the use_neox_rotary_style kwarg AND a
+    sin≈0 recording is genuinely ambiguous — export must refuse
+    loudly instead of silently picking interleaved."""
+    from paddle_tpu.onnx import _Emit, _emit_fused_rope
+    from paddle_tpu.static.capture import Program, capture_ops
+    m = _RopePos0(True)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, 1, 2, 8).astype(np.float32))
+    prog = Program()
+    with capture_ops(prog):
+        m(x)
+    [op] = [o for o in prog.ops if o.name == "fused_rope"]
+    op.kwargs = {}                     # simulate the pre-kwarg trace
+    with pytest.raises(NotImplementedError, match="ambiguous"):
+        _emit_fused_rope(_Emit(), op, ["x", "sin", "cos"])
+    # a NON-ambiguous legacy trace (position>0: sin != 0) still
+    # recovers the style numerically
+    class _Pos1(_RopePos0):
+        def forward(self, t):
+            from paddle_tpu.incubate.nn.functional import \
+                fused_rotary_position_embedding
+            d = t.shape[-1]
+            rs = np.random.RandomState(2)
+            sin = paddle.to_tensor(
+                rs.uniform(0.2, 0.9, (1, d // 2)).repeat(2)
+                .astype(np.float32).reshape(1, d))
+            cos = paddle.to_tensor(
+                np.sqrt(1.0 - sin.numpy() ** 2).astype(np.float32))
+            q, _, _ = fused_rotary_position_embedding(
+                t, sin=sin, cos=cos, use_neox_rotary_style=self.neox)
+            return q
+
+    m2 = _Pos1(False)
+    prog2 = Program()
+    with capture_ops(prog2):
+        m2(x)
+    [op2] = [o for o in prog2.ops if o.name == "fused_rope"]
+    op2.kwargs = {}
+    e = _Emit()
+    for t in op2.inputs:
+        e.name_of(t)
+    _emit_fused_rope(e, op2, [e.name_of(t) for t in op2.inputs])
+    assert any(b"MatMul" in n for n in e.nodes)

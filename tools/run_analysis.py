@@ -28,9 +28,11 @@ scriptable twin of `pytest -m lint` for environments without pytest:
 The cost-model pass (PTL301) runs paddle_tpu.tuning.cost_model
 .sanity_check(); the metrics-schema pass (PTL502) validates every
 events.emit()/span() call site against observability.events
-.EVENT_SCHEMA and docs/observability_events.md.  Both are stdlib-only
-(no backend init), so they stay on by default; ``--metrics-schema``
-remains accepted as an explicit opt-in spelling.
+.EVENT_SCHEMA and docs/observability_events.md, and its PTL503 twin
+flags unclosed tracing spans and emit sites stamping span/parent
+without trace_id.  All are stdlib-only (no backend init), so they stay
+on by default; ``--metrics-schema`` remains accepted as an explicit
+opt-in spelling.
 """
 import argparse
 import json
@@ -85,8 +87,12 @@ def main(argv=None) -> int:
                                            "cost_model.py"))
             for msg in sanity_check())
     if not args.no_metrics_schema:
-        from paddle_tpu.analysis.obs_check import check_event_schema
+        from paddle_tpu.analysis.obs_check import (check_event_schema,
+                                                   check_tracing)
         findings.extend(check_event_schema(_REPO))
+        # PTL503 rides the same stdlib-only pass: unclosed tracing
+        # spans + partial trace envelopes on emit sites
+        findings.extend(check_tracing(_REPO))
     if not args.no_pass_verify:
         from paddle_tpu.analysis.pass_check import \
             verify_registered_passes
