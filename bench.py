@@ -585,19 +585,42 @@ def run_bench():
             from paddle_tpu.observability import read_events
             from paddle_tpu.observability import watchdog as _watchdog
             recs = read_events(obs_dir)
-            # queue wait and whole-request latency are load-shaped in
-            # this stage (8 streams submitted at once: later requests
-            # legitimately wait longer) — gate on WORK durations only
-            flagged = _watchdog.self_check(
-                recs, exclude={"trace_span:queue",
-                               "trace_span:serving_request"})
-            # warn-only on CPU smoke: the tiny-model numbers are noise-
-            # dominated; on TPU a flagged key marks the run for triage
+            # load-shaped keys (queue wait, whole-request latency) are
+            # excluded by watchdog.DEFAULT_EXCLUDE — gate on WORK
+            # durations only.  Warn-only on CPU smoke: the tiny-model
+            # numbers are noise-dominated; on TPU a flagged key marks
+            # the run for triage
+            flagged = _watchdog.self_check(recs)
             out["watchdog"] = {
                 "events": len(recs),
                 "regressions": flagged,
                 "status": ("fail" if flagged and on_tpu
                            else "warn" if flagged else "ok")}
+            # learned-perf-model divergence verdict: fit a model on
+            # the stage's own telemetry, then check the same log
+            # against its predictions — proves the fit → predict →
+            # watchdog loop end to end on every bench run (a healthy
+            # run agrees with a model trained on itself)
+            try:
+                from paddle_tpu.tuning.learned import fit_from_telemetry
+                model, fit_summary = fit_from_telemetry(
+                    None, [obs_dir], min_samples=8)
+                if model.heads:
+                    mfind = _watchdog.model_check(recs, model,
+                                                  emit_events=False)
+                    out["watchdog"]["model"] = {
+                        "heads": sorted(model.heads),
+                        "fit": {k: v for k, v in fit_summary.items()
+                                if k in model.heads},
+                        "regressions": mfind,
+                        "status": ("fail" if mfind and on_tpu
+                                   else "warn" if mfind else "ok")}
+                else:
+                    out["watchdog"]["model"] = {
+                        "skipped": "not enough telemetry",
+                        "fit": fit_summary}
+            except Exception as e:  # noqa: BLE001
+                out["watchdog"]["model"] = {"error": str(e)[-200:]}
             shutil.rmtree(obs_dir, ignore_errors=True)
         except Exception as e:  # noqa: BLE001
             out["watchdog"] = {"error": str(e)[-200:]}

@@ -382,6 +382,19 @@ _rule(
     "silently excludes the true winner from measurement everywhere.",
     "Run paddle_tpu.tuning.cost_model.sanity_check() locally; fix the "
     "violated term or the Coefficients default it exposes.")
+_rule(
+    "PTL302", "perf-model-sanity", ERROR,
+    "learned performance model fails its fixture-corpus gate",
+    "The learned model (paddle_tpu.tuning.learned) replaces MEASURED "
+    "timing runs for never-seen shapes (flash blocks, Engine plans), "
+    "gates serving admission, and arbitrates perf regressions — a "
+    "model that cannot beat the unfitted analytic baseline on the "
+    "held-out fixture corpus, predicts non-finite seconds, or drifts "
+    "through a JSON round trip would silently mistune every consumer "
+    "at once.",
+    "Run paddle_tpu.tuning.learned.sanity_check() locally; fix the "
+    "featurization/regression regression it exposes (or the fixture "
+    "if the analytic prior legitimately changed).")
 
 
 def get_rule(code: str) -> Rule:

@@ -53,6 +53,10 @@ class Engine:
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         self._ensure_step()
 
+    def _param_bytes(self) -> int:
+        return max(1, sum(int(np.prod(p.shape)) * 4
+                          for p in self._model.parameters()))
+
     def _rank_candidates(self, candidates, batch_tokens):
         """Analytic roofline pre-rank (ref: auto_parallel/static/tuner/
         rule-based stage), delegated to the shared cost model
@@ -62,9 +66,53 @@ class Engine:
         batch-size aware, for ORDERING only — measurement decides the
         winner."""
         from ...tuning.cost_model import rank_plans
-        p_bytes = max(1, sum(int(np.prod(p.shape)) * 4
-                             for p in self._model.parameters()))
-        return rank_plans(candidates, batch_tokens, p_bytes)
+        return rank_plans(candidates, batch_tokens, self._param_bytes())
+
+    def _tune_from_perf_model(self, tcache, plan_key, candidates,
+                              sample_inputs):
+        """Zero-trial plan selection from the telemetry-trained perf
+        model (``tuning.learned``): on a plan-cache miss, a trained
+        ``plan`` head predicts every candidate's step seconds and the
+        winner installs directly — no trial steps, no compiles beyond
+        the lazy one the chosen mesh pays anyway.  Returns the tune()
+        result dict, or None to fall through to measurement (flag off,
+        no model file, no plan head)."""
+        from ...flags import get_flag as _get_flag
+        if not _get_flag("learned_perf_model"):
+            return None
+        from ...tuning import learned as _learned
+        model = _learned.load_model(tcache.directory)
+        if model is None or not model.has("plan"):
+            return None
+        batch_tokens = int(np.asarray(sample_inputs).size)
+        p_bytes = self._param_bytes()
+        scored = []
+        for c in candidates:
+            pred = model.plan_seconds(c, batch_tokens, p_bytes)
+            if pred is None:
+                return None
+            scored.append((pred, tuple(int(x) for x in c)))
+        scored.sort()
+        from ..mesh import build_mesh, set_mesh
+        dp, sh, mp = scored[0][1]
+        mesh = build_mesh({"dp": dp, "pp": 1, "sharding": sh,
+                           "sep": 1, "cp": 1, "ep": 1, "mp": mp})
+        set_mesh(mesh)
+        from . import api as _api
+        _api._auto_mesh = None
+        self._train_step = None
+        report = [{"dp": d_, "sharding": s_, "mp": m_,
+                   "predicted_s": round(p, 6), "source": "learned"}
+                  for p, (d_, s_, m_) in scored]
+        from ...tuning.cost_model import plan_layout
+        tcache.store("engine_plan", plan_key, {
+            "best": {"dp": dp, "sharding": sh, "mp": mp},
+            "layout": plan_layout(dp, sh, mp), "report": report,
+            "source": "learned", "model_version": model.version,
+            "batch_tokens": batch_tokens, "param_bytes": p_bytes})
+        self.tuning_report = report
+        return {"dp": dp, "sharding": sh, "mp": mp, "report": report,
+                "predicted": True}
 
     def _plan_signature(self, candidates, batch, n_devices, backend):
         """Persistent-cache key for a tune() search: model parameter
@@ -171,6 +219,10 @@ class Engine:
                 self.tuning_report = report
                 return {"dp": dp, "sharding": sh, "mp": mp,
                         "report": report, "cached": True}
+            predicted = self._tune_from_perf_model(
+                tcache, plan_key, candidates, sample_inputs)
+            if predicted is not None:
+                return predicted
 
         ranked = self._rank_candidates(
             candidates, int(np.asarray(sample_inputs).size))
@@ -280,11 +332,16 @@ class Engine:
         if tcache is not None and plan_key is not None:
             from ...tuning.cost_model import plan_layout
             # the canonical-PartitionSpec layout table makes the entry
-            # consumable without re-deriving GSPMD placements
+            # consumable without re-deriving GSPMD placements; the
+            # workload scale (batch_tokens/param_bytes) makes every
+            # measured report row a training sample for the learned
+            # perf model's plan head (tuning.learned)
             tcache.store("engine_plan", plan_key, {
                 "best": {"dp": dp, "sharding": sh, "mp": mp},
                 "layout": plan_layout(dp, sh, mp),
-                "report": report})
+                "report": report,
+                "batch_tokens": int(np.asarray(sample_inputs).size),
+                "param_bytes": self._param_bytes()})
         return {"dp": dp, "sharding": sh, "mp": mp, "report": report}
 
     def _step_fn(self):

@@ -291,6 +291,21 @@ define_flag("program_passes", "",
 define_flag("pallas_autotune_topk", 4,
             "measured autotune times only the cost model's top-K block "
             "candidates (0: time every valid candidate)")
+define_flag("learned_perf_model", True,
+            "consult the telemetry-trained performance model "
+            "(perf_model.json under FLAGS_tuning_cache_dir; "
+            "`python -m paddle_tpu.tuning fit --from-events`) for "
+            "flash blocks and Engine plans on shapes never measured — "
+            "zero timing runs on a cold cache.  False forces "
+            "measurement; no model file falls back to measurement "
+            "either way")
+define_flag("serving_predicted_admission", 0.0,
+            "per-iteration batch-step cost budget (seconds) for "
+            "serving admission: >0 admits new prefills only while the "
+            "learned perf model's predicted step cost stays under the "
+            "budget (predicted_cost_s rides serving_admit events); "
+            "0 or no trained batch_step head: raw page/token caps "
+            "only")
 define_flag("cudnn_deterministic", False, "map to XLA deterministic ops where possible")
 define_flag("embedding_deterministic", 0, "deterministic embedding lookup")
 define_flag("log_level", 0, "framework VLOG level")

@@ -5,20 +5,26 @@
     python -m paddle_tpu.observability report [--dir D]
     python -m paddle_tpu.observability trace TRACE_ID [--dir D] [--json]
     python -m paddle_tpu.observability watchdog [--dir D]
-        [--baseline B] [--tolerance T] [--min-samples N] [--warn-only]
+        [--baseline B] [--perf-model [DIR]] [--tolerance T]
+        [--min-samples N] [--warn-only]
 
 ``snapshot`` dumps the process metrics registry (mostly useful from a
 REPL/test process — a fresh CLI process has empty counters; the live
 serving surface is ``GET /metrics``).  ``tail`` and ``report`` read the
 JSONL event log under ``--dir`` (default: ``FLAGS_observability_dir``).
 ``report`` aggregates step/compile/checkpoint/dispatch/fault records
-into the operator's one-screen view of a run.  ``trace`` reconstructs
-one request's span tree (queue → admit → batch-step links → finish)
-from the log alone and pretty-prints the timeline.  ``watchdog`` is
-the SLO regression gate: per-kind duration baselines from
-``--baseline`` (or the log's own first half when omitted) checked
-against the observed log — exit 0 clean, 3 on regression, so CI and
-bench.py can gate on it.
+into the operator's one-screen view of a run, including per-kind
+duration p50/p90/p99 columns (bucket-interpolated quantiles via the
+shared ``HistogramValue``).  ``trace`` reconstructs one request's span
+tree (queue → admit → batch-step links → finish) from the log alone
+and pretty-prints the timeline.  ``watchdog`` is the SLO regression
+gate: per-kind duration baselines from ``--baseline`` (or the log's
+own first half when omitted) checked against the observed log — or,
+with ``--perf-model [DIR]``, observed durations checked against the
+learned performance model's predictions (``tuning.learned``; flags
+divergence on shapes no baseline log ever saw and emits
+``perf_regression`` events) — exit 0 clean, 3 on regression, so CI
+and bench.py can gate on it.
 """
 from __future__ import annotations
 
@@ -104,6 +110,20 @@ def aggregate(recs: List[Dict[str, Any]]) -> Dict[str, Any]:
     for r in tuning:
         ev = r.get("event", "?")
         tuning_by_event[ev] = tuning_by_event.get(ev, 0) + 1
+    # per-kind duration quantiles through the shared bucket-
+    # interpolated HistogramValue (the same estimator /metrics
+    # exports), instead of mean-only rows
+    from . import watchdog as _watchdog
+    durations: Dict[str, Dict[str, Any]] = {}
+    for key, samples in sorted(
+            _watchdog.collect_durations(recs).items()):
+        h = HistogramValue(TIME_BUCKETS)
+        for s in samples:
+            h.observe(s)
+        durations[key] = {"count": h.count, "avg": round(h.avg, 6),
+                          "p50": round(h.quantile(0.5), 6),
+                          "p90": round(h.quantile(0.9), 6),
+                          "p99": round(h.quantile(0.99), 6)}
     return {
         "events": len(recs),
         "runs": len({r.get("run") for r in recs}),
@@ -141,6 +161,7 @@ def aggregate(recs: List[Dict[str, Any]]) -> Dict[str, Any]:
             "total": sum(ops.values()),
             "top_ops": sorted(ops.items(), key=lambda kv: -kv[1])[:10],
         },
+        "durations": durations,
     }
 
 
@@ -186,6 +207,12 @@ def cmd_report(args) -> int:
     ]
     print(_fmt_table([[str(a), str(b), str(c)] for a, b, c in rows],
                      ["metric", "value", "detail"]))
+    if agg["durations"]:
+        print("\nper-kind durations (s):")
+        drows = [[key, d["count"], d["p50"], d["p90"], d["p99"]]
+                 for key, d in sorted(agg["durations"].items())]
+        print(_fmt_table([[str(c) for c in r] for r in drows],
+                         ["kind", "count", "p50", "p90", "p99"]))
     return 0
 
 
@@ -220,7 +247,19 @@ def cmd_watchdog(args) -> int:
     recs = read_events(d)
     kw = dict(tolerance=args.tolerance, min_samples=args.min_samples,
               min_seconds=args.min_seconds)
-    if args.baseline:
+    if args.perf_model is not None:
+        from ..tuning import learned
+        model = learned.load_model(args.perf_model or None)
+        if model is None or not model.heads:
+            print("no trained perf model: run `python -m "
+                  "paddle_tpu.tuning fit --from-events <obs-dir>` "
+                  "first (looked in "
+                  f"{args.perf_model or 'FLAGS_tuning_cache_dir'!r})",
+                  file=sys.stderr)
+            return 2
+        findings = watchdog.model_check(recs, model, **kw)
+        mode = "model"
+    elif args.baseline:
         base_recs = read_events(args.baseline)
         baselines = watchdog.compute_baselines(
             base_recs, min_samples=args.min_samples)
@@ -235,7 +274,8 @@ def cmd_watchdog(args) -> int:
                          indent=2, sort_keys=True))
     else:
         for f in findings:
-            print(f"REGRESSION {f['key']}: p50 {f['baseline_p50']}s -> "
+            ref = f.get("baseline_p50", f.get("predicted_p50"))
+            print(f"REGRESSION {f['key']}: p50 {ref}s -> "
                   f"{f['observed_p50']}s (x{f['ratio']}, "
                   f"{'/'.join(f['stats'])} outside the "
                   f"{args.tolerance:+.0%} band)")
@@ -282,6 +322,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--min-seconds", type=float, default=1e-4)
     p.add_argument("--warn-only", action="store_true",
                    help="report regressions but exit 0")
+    p.add_argument("--perf-model", nargs="?", const="", default=None,
+                   metavar="DIR",
+                   help="compare observed durations against the "
+                        "learned perf model's predictions instead of "
+                        "a historical baseline (DIR holds "
+                        "perf_model.json; omit the value to use "
+                        "FLAGS_tuning_cache_dir)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_watchdog)
     args = ap.parse_args(argv)

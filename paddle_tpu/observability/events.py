@@ -100,14 +100,30 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
     # inference server lifecycle (per-request traffic lives in metrics)
     "serving": {"action": "str", "url": "str"},
     # continuous-batching engine (paddle_tpu.serving): a request joined
-    # the running batch (possibly resuming after eviction)
+    # the running batch (possibly resuming after eviction);
+    # predicted_cost_s is the learned perf model's batch-step estimate
+    # when predicted-cost admission is active
     "serving_admit": {"request": "str", "prompt_len": "int",
                       "cached_tokens": "int", "queue_s": "float",
-                      "resumed": "bool"},
-    # one ragged batch iteration (mixed prefill+decode, one launch)
+                      "resumed": "bool", "predicted_cost_s": "float"},
+    # one ragged batch iteration (mixed prefill+decode, one launch);
+    # step_s + page_occupancy make each record a (features, seconds)
+    # training sample for the learned perf model
     "batch_step": {"batch": "int", "prefill_seqs": "int",
                    "decode_seqs": "int", "q_width": "int",
-                   "tokens": "int", "queue_depth": "int"},
+                   "tokens": "int", "queue_depth": "int",
+                   "step_s": "float", "page_occupancy": "float",
+                   "cold_start": "bool"},
+    # learned performance model lifecycle (tuning.learned): a versioned
+    # model file was fitted/saved from accumulated telemetry
+    "perf_model": {"action": "str", "version": "int", "heads": "object",
+                   "samples": "object", "path": "str"},
+    # observed durations diverged from the learned model's prediction
+    # (observability.watchdog.model_check — the divergence gate)
+    "perf_regression": {"key": "str", "observed_p50": "float",
+                        "predicted_p50": "float", "ratio": "float",
+                        "n": "int", "tolerance": "float",
+                        "model_version": "int"},
     # a running sequence was preempted for pages and requeued
     "evict": {"request": "str", "kv_len": "int", "n_generated": "int",
               "reason": "str"},
@@ -141,6 +157,36 @@ _SPAN_IDS = itertools.count(1)
 # inside an active span; sinks see every record (the flight ring)
 _CTX_PROVIDER: Optional[Callable[[], Optional[Dict[str, Any]]]] = None
 _WRITE_SINKS: List[Callable[[Dict[str, Any]], None]] = []
+_SELF_METRICS: Optional[Dict[str, Any]] = None
+
+
+def _log_metrics() -> Optional[Dict[str, Any]]:
+    """Self-health counters for the event log itself (records/bytes/
+    rotations/dropped writes), registered lazily on the shared metrics
+    registry so ``GET /metrics`` can see when the log is degrading.
+    None during package bootstrap (metrics not importable yet)."""
+    global _SELF_METRICS
+    if _SELF_METRICS is None:
+        try:
+            from . import metrics
+        except ImportError:
+            return None
+        _SELF_METRICS = {
+            "records": metrics.counter(
+                "paddle_observability_log_records_total",
+                "event records appended to the JSONL log"),
+            "bytes": metrics.counter(
+                "paddle_observability_log_bytes_total",
+                "bytes appended to the JSONL log"),
+            "rotations": metrics.counter(
+                "paddle_observability_log_rotations_total",
+                "size-based rotations of events.jsonl"),
+            "dropped": metrics.counter(
+                "paddle_observability_log_dropped_writes_total",
+                "event records lost to write errors (disk full, "
+                "permissions)"),
+        }
+    return _SELF_METRICS
 
 
 def set_context_provider(fn: Optional[Callable[[], Optional[Dict[str,
@@ -179,6 +225,9 @@ class EventLog:
                 return
         except OSError:
             return
+        mets = _log_metrics()
+        if mets is not None:
+            mets["rotations"].inc()
         # shift events-(k) -> events-(k+1), dropping the oldest
         for k in range(self.keep_rotated - 1, 0, -1):
             src, dst = self._rotated_name(k), self._rotated_name(k + 1)
@@ -214,16 +263,22 @@ class EventLog:
             except Exception:
                 pass                    # telemetry must never raise
         line = json.dumps(rec, sort_keys=True, default=str) + "\n"
+        mets = _log_metrics()
         with self._lock:
             try:
                 os.makedirs(self.directory, exist_ok=True)
                 self._maybe_rotate_locked()
                 with open(self.path, "a", encoding="utf-8") as fh:
                     fh.write(line)
+                if mets is not None:
+                    mets["records"].inc()
+                    mets["bytes"].inc(len(line))
             except OSError:
                 # telemetry must never take the training run down; the
-                # drop is visible in the counter below
+                # drop is visible in the counters (instance + registry)
                 self.dropped_writes += 1
+                if mets is not None:
+                    mets["dropped"].inc()
 
     def files_oldest_first(self) -> List[str]:
         out = [self._rotated_name(k)

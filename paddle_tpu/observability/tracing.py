@@ -260,6 +260,23 @@ class trace_span:
 
 _FLIGHT_LOCK = threading.Lock()
 _FLIGHT: deque = deque(maxlen=512)
+_FLIGHT_DROPS = None
+
+
+def _flight_drop_counter():
+    """Ring evictions on the shared registry (event-log self-health:
+    a post-mortem older than the ring's reach is silently gone, so
+    ``GET /metrics`` should show how fast history is being lost)."""
+    global _FLIGHT_DROPS
+    if _FLIGHT_DROPS is None:
+        try:
+            from . import metrics
+        except ImportError:
+            return None
+        _FLIGHT_DROPS = metrics.counter(
+            "paddle_observability_flight_ring_dropped_total",
+            "flight-recorder ring records evicted before any dump")
+    return _FLIGHT_DROPS
 
 
 def set_flight_capacity(n: int) -> None:
@@ -270,7 +287,12 @@ def set_flight_capacity(n: int) -> None:
 
 
 def _record_flight(rec: Dict[str, Any]) -> None:
-    _FLIGHT.append(rec)                 # deque append is GIL-atomic
+    ring = _FLIGHT
+    if len(ring) == ring.maxlen:
+        drops = _flight_drop_counter()
+        if drops is not None:
+            drops.inc()
+    ring.append(rec)                    # deque append is GIL-atomic
 
 
 def flight_snapshot() -> Dict[str, Any]:

@@ -13,10 +13,12 @@ Duration sources, keyed per kind:
 * ``trace_span`` records key as ``trace_span:<name>`` over ``dur_s``
   (``batch_step``, ``decode_loop``, ``train_step_compile``, ...);
 * ``step`` records key as ``step`` over ``step_time_s``;
+* ``batch_step`` records key as ``batch_step`` over ``step_s`` (the
+  measured ragged-iteration seconds the learned perf model trains on);
 * every other kind keys as its ``kind`` over ``dur_s`` when present
   (``compile``, ``ckpt_save``, ...).
 
-Two gates:
+Three gates:
 
 * :func:`check` — observed log vs a baseline log: a key regresses when
   its observed p50 exceeds ``baseline_p50 * (1 + tolerance)`` (p90
@@ -27,16 +29,22 @@ Two gates:
   half of each key's samples is the baseline for the second half,
   catching mid-run degradation (bench.py runs this warn-only on the
   CPU smoke).
+* :func:`model_check` — observed durations against the **learned
+  performance model's predictions** (``tuning.learned``): a key whose
+  median observed/predicted ratio leaves the tolerance band emits a
+  ``perf_regression`` event and flags the run — the divergence signal
+  a historical baseline can't give on a shape it never saw.
 
 CLI: ``python -m paddle_tpu.observability watchdog`` — exit 0 clean,
-3 on regression — usable as a CI gate and by bench.py.
+3 on regression — usable as a CI gate and by bench.py
+(``--perf-model`` switches to the model-divergence mode).
 """
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
 __all__ = ["duration_key", "collect_durations", "summarize",
-           "compute_baselines", "check", "self_check",
+           "compute_baselines", "check", "self_check", "model_check",
            "DEFAULT_TOLERANCE", "DEFAULT_MIN_SAMPLES",
            "DEFAULT_MIN_SECONDS"]
 
@@ -44,13 +52,16 @@ DEFAULT_TOLERANCE = 0.5
 DEFAULT_MIN_SAMPLES = 3
 DEFAULT_MIN_SECONDS = 1e-4
 
-# keys that measure BACK-PRESSURE, not work: queue wait scales with
-# offered load, so gating on it turns every load test into a
-# "regression".  Pass exclude=() to check them anyway.
-DEFAULT_EXCLUDE = frozenset({"trace_span:queue"})
+# keys that measure BACK-PRESSURE, not work: queue wait and
+# whole-request wall time scale with offered load (later arrivals in a
+# burst legitimately wait longer), so gating on them turns every load
+# test into a "regression".  Promoted here from bench.py's former
+# call-site list; pass exclude=() to check them anyway.
+DEFAULT_EXCLUDE = frozenset({"trace_span:queue",
+                             "trace_span:serving_request"})
 
 # kinds whose duration lives outside the envelope's dur_s
-_DURATION_FIELDS = {"step": "step_time_s"}
+_DURATION_FIELDS = {"step": "step_time_s", "batch_step": "step_s"}
 
 
 def duration_key(rec: Dict[str, Any]) -> Optional[str]:
@@ -144,6 +155,67 @@ def check(records: List[Dict[str, Any]],
                 if base["p50"] else None,
                 "baseline_count": base["count"],
                 "observed_count": obs["count"]})
+    return findings
+
+
+def model_check(records: List[Dict[str, Any]], model,
+                tolerance: float = DEFAULT_TOLERANCE,
+                min_samples: int = DEFAULT_MIN_SAMPLES,
+                min_seconds: float = DEFAULT_MIN_SECONDS,
+                emit_events: bool = True) -> List[Dict[str, Any]]:
+    """Observed durations vs the learned perf model's predictions.
+
+    For every family the model has a head for (``batch_step`` records
+    over ``step_s`` with their batch-composition features, ``step``
+    records over ``step_time_s`` with their run-context features), each
+    record is predicted INDIVIDUALLY and the key regresses when the
+    median observed/predicted ratio exceeds ``1 + tolerance`` — so a
+    run over shapes no baseline log ever saw still gets a verdict.
+    Each finding also lands as a ``perf_regression`` event (when the
+    event log is enabled and ``emit_events``), which is how a serving
+    process self-reports divergence into its own telemetry."""
+    from ..analysis import perf_features
+    findings: List[Dict[str, Any]] = []
+    band = 1.0 + float(tolerance)
+    for family, pairs in sorted(
+            perf_features.event_samples(records).items()):
+        if not hasattr(model, "has") or not model.has(family):
+            continue
+        if len(pairs) < int(min_samples):
+            continue
+        obs, preds, ratios = [], [], []
+        for feats, secs in pairs:
+            p = model.predict(family, feats)
+            if p is None or p <= 0:
+                continue
+            obs.append(secs)
+            preds.append(p)
+            ratios.append(secs / p)
+        if len(ratios) < int(min_samples):
+            continue
+        obs_p50 = _percentile(sorted(obs), 0.5)
+        pred_p50 = _percentile(sorted(preds), 0.5)
+        ratio = _percentile(sorted(ratios), 0.5)
+        if obs_p50 < min_seconds and pred_p50 < min_seconds:
+            continue
+        if ratio > band:
+            finding = {
+                "key": family, "stats": ["p50"],
+                "observed_p50": round(obs_p50, 6),
+                "predicted_p50": round(pred_p50, 6),
+                "ratio": round(ratio, 3),
+                "observed_count": len(obs),
+                "model_version": int(getattr(model, "version", 0))}
+            findings.append(finding)
+            if emit_events:
+                from . import events
+                events.emit(
+                    "perf_regression", key=family,
+                    observed_p50=finding["observed_p50"],
+                    predicted_p50=finding["predicted_p50"],
+                    ratio=finding["ratio"], n=len(obs),
+                    tolerance=float(tolerance),
+                    model_version=finding["model_version"])
     return findings
 
 

@@ -15,8 +15,12 @@ TPU-native: one table, two modes —
   ever timed, and the winner is remembered in the persistent tuning
   cache (``FLAGS_tuning_cache_dir``, paddle_tpu.tuning.cache) so later
   PROCESSES skip timing entirely.  The process-lifetime ``_cache`` dict
-  is a read-through layer over that disk store.  Only reachable on TPU
-  — interpret mode always uses the heuristic (timing the interpreter is
+  is a read-through layer over that disk store.  On a disk miss the
+  telemetry-trained perf model (``tuning.learned``, when
+  ``FLAGS_learned_perf_model`` and a trained ``perf_model.json``
+  exist) predicts the blocks with zero timing runs; only when neither
+  resolves does measurement happen.  Only reachable on TPU — interpret
+  mode always uses the heuristic (timing the interpreter is
   meaningless).
 """
 from __future__ import annotations
@@ -97,7 +101,9 @@ def _disk_key(sq, sk, d, dtype, causal, bh_bucket) -> dict:
 
 
 def _measured_blocks(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
-    """Read-through to the persistent store; measure only on disk miss."""
+    """Read-through to the persistent store; on disk miss consult the
+    learned perf model (zero timing runs for never-measured shapes);
+    measure only when neither resolves."""
     from ...tuning.cache import get_cache
     cache = get_cache()
     key: Optional[dict] = None
@@ -106,6 +112,10 @@ def _measured_blocks(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
         hit = cache.lookup("flash_blocks", key)
         if hit is not None:
             return (int(hit["block_q"]), int(hit["block_k"]))
+        learned = _learned_blocks(sq, sk, d, dtype, causal, bh,
+                                  cache, key)
+        if learned is not None:
+            return learned
     blocks, timings = _measure(sq, sk, d, dtype, causal, bh)
     # persist only a real measurement: an all-candidates-failed run
     # (dead backend, Mosaic regression) must re-measure next process,
@@ -116,6 +126,35 @@ def _measured_blocks(sq, sk, d, dtype, causal, bh) -> Tuple[int, int]:
             "block_q": int(blocks[0]), "block_k": int(blocks[1]),
             "source": "measured", "timings_ms": timings})
     return blocks
+
+
+def _learned_blocks(sq, sk, d, dtype, causal, bh, cache, key
+                    ) -> Optional[Tuple[int, int]]:
+    """Predict (block_q, block_k) from the telemetry-trained perf model
+    (``tuning.learned``, FLAGS_learned_perf_model): a cold process on a
+    shape nobody ever measured picks blocks with ZERO timing runs.  The
+    pick persists under ``source: learned`` so later processes take the
+    disk path; its entry carries no ``timings_ms`` table, so ``fit``
+    never mistakes a prediction for a measurement.  Returns None (fall
+    through to ``_measure``) when the flag is off, no trained model
+    file exists, or the model lacks a flash head."""
+    if not get_flag("learned_perf_model"):
+        return None
+    from ...tuning import learned
+    model = learned.load_model(cache.directory)
+    if model is None or not model.has("flash"):
+        return None
+    valid = [c for c in _CANDIDATES if _valid(c[0], c[1], sq, sk)]
+    if not valid:
+        return None
+    bq, bk = model.rank_flash_candidates(valid, sq, sk, d, dtype,
+                                         causal, bh)[0]
+    pred = model.flash_seconds(sq, sk, d, dtype, causal, bq, bk, bh)
+    cache.store("flash_blocks", key, {
+        "block_q": int(bq), "block_k": int(bk), "source": "learned",
+        "predicted_ms": round(pred * 1e3, 4) if pred else None,
+        "model_version": model.version})
+    return (int(bq), int(bk))
 
 
 def _measure(sq, sk, d, dtype, causal, bh):

@@ -102,9 +102,11 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None,
                  max_queue: int = 1024, max_prefill_chunk: int = 0,
                  prefix_caching: bool = True, seed: int = 0,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", perf_model="auto",
+                 max_step_cost_s: Optional[float] = None):
         import jax
         import jax.numpy as jnp
+        from ..flags import get_flag
         if hasattr(model, "eval"):
             model.eval()
         self.model = model
@@ -126,11 +128,28 @@ class ServingEngine:
         self.pool = PagePool(num_pages, ps)
         self.prefix_cache = PrefixCache(self.pool) if prefix_caching \
             else None
+        # predicted-cost admission (FLAGS_serving_predicted_admission,
+        # seconds): the scheduler admits prefills against the learned
+        # model's predicted batch-step cost instead of raw caps alone.
+        # perf_model="auto" loads the trained model from
+        # FLAGS_tuning_cache_dir; pass a model object to inject one, or
+        # None to disable regardless of the flag.
+        if max_step_cost_s is None:
+            max_step_cost_s = float(
+                get_flag("serving_predicted_admission") or 0.0)
+        if perf_model == "auto":
+            perf_model = None
+            if max_step_cost_s > 0:
+                from ..tuning import learned as _learned
+                perf_model = _learned.load_model()
+        if perf_model is not None and not perf_model.has("batch_step"):
+            perf_model = None
         self.scheduler = Scheduler(
             self.pool, max_batch, max_pages_per_seq,
             prefix_cache=self.prefix_cache, max_queue=max_queue,
             max_prefill_chunk=max_prefill_chunk,
-            max_seq_len=max_pos)
+            max_seq_len=max_pos, perf_model=perf_model,
+            max_step_cost_s=max_step_cost_s)
         self.max_batch = int(max_batch)
         self.default_eos = None if eos_token_id is None \
             else int(eos_token_id)
@@ -266,6 +285,10 @@ class ServingEngine:
                         cached_tokens=seq.cached_tokens,
                         queue_s=round(now - req.submitted_at, 6),
                         resumed=req.evictions > 0,
+                        predicted_cost_s=(
+                            round(seq.predicted_cost_s, 6)
+                            if seq.predicted_cost_s is not None
+                            else None),
                         trace_id=tr.trace_id if tr else None,
                         span=tr.span_id if tr else None)
                 for seq in evicted:
@@ -321,14 +344,16 @@ class ServingEngine:
     def _run_step_traced(self, plan):
         from ..core.dispatch import _emit_op_event
         qw = _bucket(plan.tok.shape[1])
+        n_progs = len(self._programs)
         prog = self._program(qw)
+        cold_start = len(self._programs) > n_progs
         pad = qw - plan.tok.shape[1]
         tok = np.pad(plan.tok, ((0, 0), (0, pad)))
         pos = np.pad(plan.pos, ((0, 0), (0, pad)))
         page_ids = np.pad(plan.page_ids, ((0, 0), (0, pad)),
                           constant_values=self.pool.sink)
         slots = np.pad(plan.slots, ((0, 0), (0, pad)))
-        with self._h_step.time():
+        with self._h_step.time() as step_timer:
             nxt, self._pools, self._key = prog(
                 self._params, tok, pos, self._pools, page_ids, slots,
                 plan.kv_lens, plan.q_lens, plan.tables, plan.temps,
@@ -376,12 +401,23 @@ class ServingEngine:
                         not seq.cache_inserted:
                     self._cache_prompt(seq)
             self._g_occ.set(len(self.scheduler.running))
+            # step_s + page_occupancy make each record a ready-made
+            # (features, seconds) sample for the learned perf model
+            # (analysis.perf_features / tuning.learned); cold_start
+            # marks the program-cache-miss steps whose step_s is
+            # trace+compile, not steady-state work — the featurizer
+            # and the divergence watchdog skip them
             _events.emit("batch_step", batch=len(plan.seqs),
                          prefill_seqs=plan.n_prefill,
                          decode_seqs=plan.n_decode,
                          q_width=int(qw),
                          tokens=plan.fed_prefill + plan.fed_decode,
-                         queue_depth=self.scheduler.queue_depth())
+                         queue_depth=self.scheduler.queue_depth(),
+                         step_s=round(step_timer.seconds, 6),
+                         cold_start=cold_start or None,
+                         page_occupancy=round(
+                             1.0 - self.pool.available()
+                             / max(self.pool.num_pages - 1, 1), 4))
 
     def _cache_prompt(self, seq):
         """Share the finished prompt's full pages through the prefix
@@ -431,6 +467,8 @@ class ServingEngine:
                "queue_depth": self.scheduler.queue_depth(),
                "running": len(self.scheduler.running),
                "evictions": self.scheduler.evictions,
+               "deferred_admissions":
+                   self.scheduler.deferred_admissions,
                "free_pages": self.pool.available(),
                "programs": len(self._programs)}
         if self.prefix_cache is not None:

@@ -185,7 +185,7 @@ class _Sequence:
     committed to pages, and the owned/shared page list."""
 
     __slots__ = ("req", "tokens", "kv_len", "pages", "shared",
-                 "cached_tokens", "cache_inserted")
+                 "cached_tokens", "cache_inserted", "predicted_cost_s")
 
     def __init__(self, req: Request):
         self.req = req
@@ -195,6 +195,9 @@ class _Sequence:
         self.shared: set = set()       # page ids held via prefix cache
         self.cached_tokens = 0
         self.cache_inserted = False
+        # learned-model step-cost estimate at admission (None: raw
+        # page/token caps decided alone); rides serving_admit events
+        self.predicted_cost_s: Optional[float] = None
 
     @property
     def n_generated(self) -> int:
@@ -222,7 +225,8 @@ class Scheduler:
     def __init__(self, pool: PagePool, max_batch: int,
                  max_pages_per_seq: int, prefix_cache=None,
                  max_queue: int = 1024, max_prefill_chunk: int = 0,
-                 max_seq_len: int = 0):
+                 max_seq_len: int = 0, perf_model=None,
+                 max_step_cost_s: float = 0.0):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.ppseq = int(max_pages_per_seq)
@@ -236,6 +240,14 @@ class Scheduler:
         # per-iteration chunk (bounds Q and the step's latency impact
         # on co-scheduled decodes)
         self.max_prefill_chunk = int(max_prefill_chunk)
+        # predicted-cost admission (tuning.learned): with a trained
+        # batch_step head and a budget, new prefills are admitted only
+        # while the PREDICTED next-step cost stays under the budget —
+        # the cap follows what a prefill actually costs co-scheduled
+        # decodes, not a raw page/token count
+        self.perf_model = perf_model
+        self.max_step_cost_s = float(max_step_cost_s or 0.0)
+        self.deferred_admissions = 0
         self.waiting: deque = deque()
         self.running: List[_Sequence] = []
         self.evictions = 0
@@ -289,11 +301,56 @@ class Scheduler:
         seq.shared = set()
         seq.kv_len = 0
 
+    # -- predicted-cost admission ----------------------------------------
+    def _chunk_len(self, seq: _Sequence) -> int:
+        n = max(len(seq.req.prompt) + len(seq.req.tokens) - seq.kv_len,
+                0)
+        if self.max_prefill_chunk:
+            n = min(n, self.max_prefill_chunk)
+        return n
+
+    def _predicted_admit_cost(self, seq: _Sequence) -> Optional[float]:
+        """The learned model's batch-step seconds for the NEXT
+        iteration with ``seq`` admitted on top of the running batch
+        (the same feature vector ``batch_step`` events log).  None when
+        the model can't answer — admission then falls back to the raw
+        caps; a model error must never wedge the queue."""
+        chunk = self._chunk_len(seq)
+        chunks = [self._chunk_len(s) for s in self.running]
+        decode = sum(1 for s in self.running
+                     if s.kv_len >= len(s.req.prompt))
+        feats = {
+            "batch": float(len(self.running) + 1),
+            "prefill_seqs": float(len(self.running) - decode + 1),
+            "decode_seqs": float(decode),
+            "q_width": float(max(chunks + [chunk, 1])),
+            "tokens": float(sum(chunks) + chunk),
+            "queue_depth": float(len(self.waiting)),
+            "page_occupancy": round(
+                1.0 - self.pool.available()
+                / max(self.pool.num_pages - 1, 1), 4),
+        }
+        try:
+            return self.perf_model.predict("batch_step", feats)
+        except Exception:
+            return None
+
     # -- admission / eviction --------------------------------------------
     def _admit_one(self) -> Optional[_Sequence]:
         if not self.waiting or len(self.running) >= self.max_batch:
             return None
         seq = self.waiting[0]
+        if self.perf_model is not None and self.max_step_cost_s > 0:
+            pred = self._predicted_admit_cost(seq)
+            seq.predicted_cost_s = pred
+            if pred is not None and pred > self.max_step_cost_s \
+                    and self.running:
+                # admitting this prefill would blow the step budget —
+                # defer until the running batch shrinks.  An empty
+                # batch always admits (the budget shapes latency, it
+                # must never starve the queue)
+                self.deferred_admissions += 1
+                return None
         # refresh: an evicted requeued sequence re-enters with its
         # generated-so-far tokens included
         seq.tokens = list(seq.req.prompt) + list(seq.req.tokens)

@@ -9,11 +9,18 @@ mesh tuner"):
 * :mod:`cache` — versioned JSONL store under ``FLAGS_tuning_cache_dir``
   with atomic-rename writes, corruption fallback, and hit/miss
   counters; the same flag wires JAX's persistent compilation cache.
-* CLI — ``python -m paddle_tpu.tuning {dump,stats,prune,warm,fit}``.
+* :mod:`learned` — the telemetry-fed learned performance model
+  (arXiv 2008.01040): per-family ridge heads trained on the cache's
+  measured timings + the observability event log, persisted as a
+  versioned ``perf_model.json`` next to the cache files.
+* CLI — ``python -m paddle_tpu.tuning {dump,stats,prune,warm,fit}``
+  (``fit --from-events <obs-dir>`` trains the learned model).
 
 Consumers: ``ops/pallas/autotune.flash_blocks`` and
 ``distributed.auto_parallel.Engine.tune`` read through their in-memory
-caches to this store, so a warm process pays zero timing runs.
+caches to this store, so a warm process pays zero timing runs — and,
+with a trained model present, a COLD process on a never-measured shape
+predicts its blocks/plan with zero timing runs too.
 """
 from .cache import (SCHEMA_VERSION, TuningCache, cache_stats,  # noqa: F401
                     canonical_key, get_cache)
@@ -21,10 +28,13 @@ from .cost_model import (Coefficients, CostModel,  # noqa: F401
                          default_model, features_from_jaxpr, flash_cost,
                          flash_features, plan_cost, plan_layout,
                          rank_flash_candidates, rank_plans, sanity_check)
+from .learned import (LearnedPerfModel, fit_from_telemetry,  # noqa: F401
+                      load_model, save_model)
 
 __all__ = [
     "SCHEMA_VERSION", "TuningCache", "cache_stats", "canonical_key",
     "get_cache", "Coefficients", "CostModel", "default_model",
     "features_from_jaxpr", "flash_cost", "flash_features", "plan_cost",
     "plan_layout", "rank_flash_candidates", "rank_plans", "sanity_check",
+    "LearnedPerfModel", "fit_from_telemetry", "load_model", "save_model",
 ]

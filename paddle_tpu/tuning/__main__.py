@@ -9,12 +9,21 @@
                                        --flash SQ,SK,D[,DTYPE,CAUSAL,BH]
                                        [--standard]
     python -m paddle_tpu.tuning fit    [--dir DIR] [--json]
+                                       [--from-events OBS_DIR ...]
+                                       [--min-samples N]
 
 ``warm`` writes cost-model (analytic) block picks so a cold process
 resolves ``flash_blocks`` from disk without ever timing; ``fit``
 least-squares the model's alpha multipliers from the measured timing
 tables accumulated in ``flash_blocks`` entries and persists them under
-the ``coefficients`` kind.  ``--dir`` overrides FLAGS_tuning_cache_dir.
+the ``coefficients`` kind.  With ``--from-events <obs-dir>``
+(repeatable) it ALSO trains the learned performance model
+(``tuning.learned``) on the cache's measured timings plus the JSONL
+event logs under each dir (``batch_step`` durations, ``step``
+telemetry with dispatch/graph-pass context) and persists it as the
+versioned ``perf_model.json`` the autotuner, Engine.tune, the serving
+scheduler, and the divergence watchdog consult.  ``--dir`` overrides
+FLAGS_tuning_cache_dir.
 """
 from __future__ import annotations
 
@@ -135,29 +144,38 @@ def cmd_warm(args) -> int:
 
 
 def cmd_fit(args) -> int:
+    from . import learned
     cache = _open_cache(args)
-    samples = []
-    for rec in cache.entries("flash_blocks"):
-        key, timings = rec["key"], rec["value"].get("timings_ms")
-        if not timings:
-            continue
-        for blocks, ms in timings.items():
-            if not isinstance(ms, (int, float)):
-                continue                  # "error: ..." rows
-            bq, bk = (int(p) for p in blocks.split("x"))
-            samples.append((cost_model.flash_features(
-                key["sq"], key["sk"], key["d"], key["dtype"],
-                key["causal"], bq, bk, key.get("bh_bucket", 8)),
-                ms / 1e3))
-    if len(samples) < 3:
-        sys.stderr.write("fit: need >= 3 measured timings in the cache "
-                         "(run with FLAGS_pallas_autotune=1 first)\n")
+    # flash_feature_dict supersets cost_model.flash_features, so the
+    # same samples feed both the alpha refit and the learned head
+    samples = learned.flash_samples_from_cache(cache)
+    out = {}
+    if len(samples) >= 3:
+        model = cost_model.CostModel()
+        coeffs = model.fit(samples)
+        cache.store(cost_model.COEFFS_KIND, cost_model.COEFFS_KEY,
+                    {"coeffs": coeffs.to_dict(),
+                     "n_samples": len(samples)})
+        out["n_samples"] = len(samples)
+        out["coeffs"] = coeffs.to_dict()
+    if args.from_events:
+        perf_model, summary = learned.fit_from_telemetry(
+            cache, args.from_events, min_samples=args.min_samples)
+        out["perf_model"] = summary
+        if perf_model.heads:
+            path = learned.save_model(perf_model, cache.directory)
+            out["perf_model_path"] = path
+            out["perf_model_version"] = perf_model.version
+    if not out.get("coeffs") and not out.get("perf_model_version"):
+        sys.stderr.write(
+            "fit: nothing trainable — need >= 3 measured timings in "
+            "the cache (run with FLAGS_pallas_autotune=1 first) "
+            "and/or --from-events dirs with enough batch_step/step "
+            "telemetry\n")
+        if out:
+            print(json.dumps(out, indent=2 if args.json else None,
+                             sort_keys=True))
         return 1
-    model = cost_model.CostModel()
-    coeffs = model.fit(samples)
-    cache.store(cost_model.COEFFS_KIND, cost_model.COEFFS_KEY,
-                {"coeffs": coeffs.to_dict(), "n_samples": len(samples)})
-    out = {"n_samples": len(samples), "coeffs": coeffs.to_dict()}
     print(json.dumps(out, indent=2 if args.json else None,
                      sort_keys=True))
     return 0
@@ -184,8 +202,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--backend", default="")
     p.add_argument("--device-kind", default="")
     p = sub.add_parser("fit", help="refine cost-model coefficients from "
-                                   "measured timings in the cache")
+                                   "measured timings in the cache; with "
+                                   "--from-events also train + persist "
+                                   "the learned perf model")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--from-events", action="append", default=[],
+                   metavar="OBS_DIR",
+                   help="observability event-log dir (repeatable); "
+                        "trains the learned perf model on these logs "
+                        "plus the cache's measured timings")
+    p.add_argument("--min-samples", type=int, default=8,
+                   help="per-family sample floor below which a learned "
+                        "head is skipped (default 8)")
     args = ap.parse_args(argv)
     return {"stats": cmd_stats, "dump": cmd_dump, "prune": cmd_prune,
             "warm": cmd_warm, "fit": cmd_fit}[args.cmd](args)

@@ -20,6 +20,9 @@ scriptable twin of `pytest -m lint` for environments without pytest:
                                                  # gate (PTL601)
     python tools/run_analysis.py --no-cost-model # skip the tuning
                                                  # cost-model sanity pass
+    python tools/run_analysis.py --no-perf-model # skip the learned
+                                                 # perf-model fixture
+                                                 # gate (PTL302)
     python tools/run_analysis.py --no-metrics-schema  # skip the
                                                  # observability event-
                                                  # schema pass (PTL502)
@@ -55,6 +58,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cost-model", action="store_true",
                     help="skip the tuning cost-model sanity pass "
                          "(PTL301)")
+    ap.add_argument("--no-perf-model", action="store_true",
+                    help="skip the learned perf-model fixture gate "
+                         "(PTL302)")
     ap.add_argument("--metrics-schema", action="store_true",
                     help="run the observability event-schema pass "
                          "(PTL502); on by default — this flag is the "
@@ -86,6 +92,15 @@ def main(argv=None) -> int:
                          file=os.path.join("paddle_tpu", "tuning",
                                            "cost_model.py"))
             for msg in sanity_check())
+    if not args.no_perf_model:
+        from paddle_tpu.analysis.rules import make_finding
+        from paddle_tpu.tuning.learned import \
+            sanity_check as perf_model_sanity
+        findings.extend(
+            make_finding("PTL302", msg,
+                         file=os.path.join("paddle_tpu", "tuning",
+                                           "learned.py"))
+            for msg in perf_model_sanity())
     if not args.no_metrics_schema:
         from paddle_tpu.analysis.obs_check import (check_event_schema,
                                                    check_tracing)
@@ -109,6 +124,7 @@ def main(argv=None) -> int:
               f"{len(errors)} error(s) over {len(targets)} target(s)"
               + ("" if args.no_registry else " + registry")
               + ("" if args.no_cost_model else " + cost-model")
+              + ("" if args.no_perf_model else " + perf-model")
               + ("" if args.no_metrics_schema else " + event-schema")
               + ("" if args.no_pass_verify else " + pass-verify"))
     return 1 if errors else 0
