@@ -22,18 +22,31 @@ severity levels, per-line ``# noqa: PTLxxx`` suppression, JSON output):
   program corpus, plus a jaxpr hazard re-scan of every optimized
   replay; the companion AST rule PTL602 (lint.py) bans in-place
   ``_OpRecord`` mutation inside pass code.
+* **shardcheck** (PTL8xx) — static SPMD/collective consistency over
+  the distributed layer: PartitionSpec arity vs the mesh (PTL801),
+  rank-divergent collective order (PTL802), donation aliasing
+  (PTL803), DistributedStrategy knob→handler coverage (PTL804).
+  Stdlib-only; rides ``lint_source`` behind path predicates.  Its
+  runtime twin is the ``FLAGS_collective_sanitizer`` fingerprint
+  cross-check in ``distributed/communication/sanitizer.py``.
 
-Import cost mirrors the passes: ``rules``/``lint`` import no jax; the
-other passes import the framework lazily inside their entry points.
+Import cost mirrors the passes: ``rules``/``lint``/``shardcheck``
+import no jax; the other passes import the framework lazily inside
+their entry points.
 """
 from .rules import (ERROR, INFO, RULES, WARNING, Finding, Rule,
                     has_errors, make_finding, max_severity)
 from .lint import is_surface_path, lint_file, lint_paths, lint_source
+from .shardcheck import (STRATEGY_KNOB_HANDLERS, is_shard_path,
+                         is_strategy_path, shard_findings_source,
+                         strategy_findings_source)
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "RULES", "Rule", "Finding",
     "make_finding", "max_severity", "has_errors",
     "lint_source", "lint_file", "lint_paths", "is_surface_path",
+    "is_shard_path", "is_strategy_path", "shard_findings_source",
+    "strategy_findings_source", "STRATEGY_KNOB_HANDLERS",
     "check_registry", "analyze", "inspect_static_fn", "stream_report",
     "check_jaxpr", "verify_registered_passes", "main",
 ]

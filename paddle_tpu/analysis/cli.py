@@ -9,6 +9,12 @@ the package + jax, so it is opt-in for speed).
     python -m paddle_tpu.analysis paddle_tpu/ --json     # machine output
     python -m paddle_tpu.analysis --registry             # registry pass
     python -m paddle_tpu.analysis examples/ --select PTL001,PTL006
+    python -m paddle_tpu.analysis paddle_tpu/ --ignore PTL501,PTL701
+
+``--select`` keeps only the named codes; ``--ignore`` drops the named
+codes; when both name the same code, ignore wins.  Exit-code semantics
+are unchanged by either filter: 1 iff an error-severity finding
+survives filtering, else 0 (2 for nothing-to-do).
 """
 from __future__ import annotations
 
@@ -62,6 +68,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="emit the machine-readable JSON schema")
     ap.add_argument("--select", metavar="CODES",
                     help="comma-separated PTL codes to keep")
+    ap.add_argument("--ignore", metavar="CODES",
+                    help="comma-separated PTL codes to drop (applied "
+                         "after --select; ignore wins on overlap)")
     ap.add_argument("--registry", action="store_true",
                     help="also run the op-registry consistency check "
                          "(imports paddle_tpu + jax)")
@@ -80,17 +89,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     select = _parse_select(args.select)
+    ignore = _parse_select(args.ignore)
     findings: List[Finding] = []
 
     if args.paths:
         from .lint import lint_paths
-        findings.extend(lint_paths(args.paths, select=select))
+        findings.extend(lint_paths(args.paths, select=select,
+                                   ignore=ignore))
 
     if args.registry:
         from .registry_check import check_registry
         reg = check_registry(deep_sample=args.deep_registry)
         if select is not None:
             reg = [f for f in reg if f.code in select]
+        if ignore is not None:
+            reg = [f for f in reg if f.code not in ignore]
         findings.extend(reg)
 
     if not args.paths and not args.registry:

@@ -31,6 +31,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .rules import ERROR, WARNING, Finding, make_finding
+from .shardcheck import (is_shard_path, is_strategy_path,
+                         shard_findings_source, strategy_findings_source)
 
 # files whose functions run under user traces (relative-path globs,
 # matched with '/' separators against the path tail)
@@ -135,7 +137,10 @@ _IMPURE_HOST_CALLS = {
     ("random", "gauss"),
 }
 
-_NOQA_RE = re.compile(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", re.IGNORECASE)
+_NOQA_RE = re.compile(r"#\s*noqa\b(?P<colon>\s*:\s*(?P<raw>[^#]*))?",
+                      re.IGNORECASE)
+# one rule code: 1-4 letters + 1-4 digits (PTL801, E402, BLE001, ...)
+_NOQA_CODE_RE = re.compile(r"[A-Za-z]{1,4}\d{1,4}$")
 _TRACED_MARK_RE = re.compile(r"#\s*ptl:\s*traced", re.IGNORECASE)
 
 
@@ -807,18 +812,33 @@ def is_kernel_path(path: str) -> bool:
 
 
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
-    """line -> None (bare noqa: suppress all) | set of codes."""
+    """line -> None (bare noqa: suppress all) | set of codes.
+
+    ``# noqa: PTL801,PTL803 reason text`` takes any number of
+    comma/space-separated codes; token collection stops at the first
+    non-code token so trailing prose never dilutes the set.  A colon
+    followed by no valid code suppresses nothing (typo-safe), while a
+    bare ``# noqa`` suppresses everything on the line.
+    """
     out: Dict[int, Optional[Set[str]]] = {}
     for i, line in enumerate(source.splitlines(), start=1):
         m = _NOQA_RE.search(line)
         if not m:
             continue
-        codes = m.group(1)
-        if codes is None:
-            out[i] = None
-        else:
-            out[i] = {c.strip().upper() for c in codes.split(",")
-                      if c.strip()}
+        if m.group("colon") is None:
+            out[i] = None                  # bare noqa
+            continue
+        raw = m.group("raw").strip()
+        if not raw:
+            out[i] = None                  # '# noqa:' == bare noqa
+            continue
+        codes: Set[str] = set()
+        for tok in re.split(r"[,\s]+", raw):
+            if _NOQA_CODE_RE.fullmatch(tok):
+                codes.add(tok.upper())
+            else:
+                break                      # reason text starts here
+        out[i] = codes
     return out
 
 
@@ -831,8 +851,11 @@ def is_surface_path(path: str) -> bool:
 
 def lint_source(source: str, filename: str = "<string>",
                 surface: Optional[bool] = None,
-                select: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint one source blob.  ``surface=None`` infers from the path."""
+                select: Optional[Set[str]] = None,
+                ignore: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source blob.  ``surface=None`` infers from the path;
+    ``select`` keeps only the named codes, ``ignore`` drops them
+    (ignore wins when a code appears in both)."""
     if surface is None:
         surface = is_surface_path(filename)
     try:
@@ -865,6 +888,11 @@ def lint_source(source: str, filename: str = "<string>",
         serving = _ServingStepHygiene(filename)
         serving.visit(tree)
         findings.extend(serving.findings)
+    if is_shard_path(filename):
+        findings.extend(shard_findings_source(source, filename, tree=tree))
+    if is_strategy_path(filename):
+        findings.extend(
+            strategy_findings_source(source, filename, tree=tree))
     noqa = _collect_noqa(source)
     out = []
     for f in findings:
@@ -875,16 +903,20 @@ def lint_source(source: str, filename: str = "<string>",
             continue
         if select is not None and f.code not in select:
             continue
+        if ignore is not None and f.code in ignore:
+            continue
         out.append(f)
     out.sort(key=lambda f: (f.file, f.line, f.col, f.code))
     return out
 
 
 def lint_file(path: str, select: Optional[Set[str]] = None,
-              surface: Optional[bool] = None) -> List[Finding]:
+              surface: Optional[bool] = None,
+              ignore: Optional[Set[str]] = None) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
         src = fh.read()
-    return lint_source(src, filename=path, surface=surface, select=select)
+    return lint_source(src, filename=path, surface=surface, select=select,
+                       ignore=ignore)
 
 
 def iter_python_files(paths: Sequence[str]) -> List[str]:
@@ -904,8 +936,10 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def lint_paths(paths: Sequence[str], select: Optional[Set[str]] = None,
-               surface: Optional[bool] = None) -> List[Finding]:
+               surface: Optional[bool] = None,
+               ignore: Optional[Set[str]] = None) -> List[Finding]:
     findings: List[Finding] = []
     for f in iter_python_files(paths):
-        findings.extend(lint_file(f, select=select, surface=surface))
+        findings.extend(lint_file(f, select=select, surface=surface,
+                                  ignore=ignore))
     return findings

@@ -25,6 +25,11 @@ Code space:
           pass_check.py and lint.py)
   PTL7xx  serving hygiene rules (host syncs in continuous-batching
           step-loop code paths; see lint.py)
+  PTL8xx  SPMD/collective consistency rules (PartitionSpec arity,
+          rank-divergent collective order, donation aliasing,
+          DistributedStrategy knob coverage; see shardcheck.py — the
+          runtime twin is the FLAGS_collective_sanitizer fingerprint
+          cross-check in distributed/communication/sanitizer.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -395,6 +400,67 @@ _rule(
     "Run paddle_tpu.tuning.learned.sanity_check() locally; fix the "
     "featurization/regression regression it exposes (or the fixture "
     "if the analytic prior legitimately changed).")
+
+
+# ---------------------------------------------------------------------------
+# PTL8xx — SPMD/collective consistency (shardcheck)
+# ---------------------------------------------------------------------------
+
+_rule(
+    "PTL801", "partition-spec-mesh-mismatch", ERROR,
+    "PartitionSpec names an unknown mesh axis, shards one axis onto "
+    "two dims, or names more axes than the mesh has",
+    "GSPMD resolves PartitionSpec entries against the mesh at lowering "
+    "time: an axis name outside the mesh vocabulary raises only when "
+    "the layout is first used (often on hardware), the same axis on "
+    "two dims is always invalid, and a spec naming more distinct axes "
+    "than the mesh has rank cannot be satisfied by any device "
+    "assignment — all three are layout bugs visible statically.",
+    "Use the mesh's declared axis names (HYBRID_AXES / the fleet "
+    "topology names), one mesh axis per sharded dim; a deliberately "
+    "dynamic spec takes '# noqa: PTL801' with a reason comment.")
+_rule(
+    "PTL802", "rank-divergent-collective", ERROR,
+    "collective call under rank-dependent (or data-dependent) control "
+    "flow — call order can diverge across ranks",
+    "Collectives are rendezvous points: every rank must issue the same "
+    "collectives in the same order.  A collective inside an "
+    "'if rank == 0:' branch, a loop whose trip count depends on the "
+    "rank, or a branch on a device value means some ranks enter the "
+    "collective while others never do — the classic SPMD deadlock, "
+    "which on TPU surfaces as a silent stage timeout.",
+    "Hoist the collective out of the divergent region (every rank "
+    "calls it; mask the payload instead), or make the control flow "
+    "uniform; a provably-uniform branch takes '# noqa: PTL802' with a "
+    "reason comment.")
+_rule(
+    "PTL803", "donation-aliasing", ERROR,
+    "buffer donated to a jitted step is read after the call (or passed "
+    "twice into one donated call)",
+    "donate_argnums hands the argument's buffer to XLA for reuse; the "
+    "old array is invalidated the moment the call dispatches.  Reading "
+    "the donated name afterwards returns poisoned memory (or raises), "
+    "and passing the same array into two positions of a donated call "
+    "aliases one buffer to two parameters — both corrupt silently "
+    "under async dispatch.",
+    "Rebind the name to the call's result (state = step(state, ...)), "
+    "or drop the donation; an intentional read of a to-be-donated "
+    "buffer takes '# noqa: PTL803' with a reason comment.")
+_rule(
+    "PTL804", "strategy-knob-unmapped", ERROR,
+    "DistributedStrategy knob has no registered pass / layout mapping "
+    "(or the mapping table drifted from the strategy surface)",
+    "Every boolean knob on fleet.DistributedStrategy is a user-facing "
+    "promise: setting it must either change the lowered program "
+    "(a registered distributed pass, a mesh-axis layout) or be a "
+    "documented accepted-for-parity no-op.  A knob outside the "
+    "shardcheck handler table is a promise nothing implements; a "
+    "table entry without a knob is dead documentation; a 'pass:' "
+    "mapping naming an unregistered pass is a wiring bug.",
+    "Map the knob in analysis.shardcheck.STRATEGY_KNOB_HANDLERS "
+    "(pass:<registered name>, layout:<mesh wiring>, flag:<FLAGS "
+    "mirror>, or parity:<why it is accepted-and-ignored>), and keep "
+    "the named pass registered in distributed/passes.")
 
 
 def get_rule(code: str) -> Rule:

@@ -1,0 +1,667 @@
+"""PTL8xx — static SPMD/collective consistency (``shardcheck``).
+
+The distributed layer is where bugs stop being observable on a dev box:
+a mismatched ``PartitionSpec`` raises at lowering time on the real
+mesh, a rank-divergent collective order deadlocks an 8-stage job until
+the stage timeout, a donated carry read after dispatch returns poisoned
+memory only under async dispatch pressure.  This pass moves all of
+those to lint time, over the same AST machinery as the PTL0xx linter:
+
+* **PTL801** — ``PartitionSpec``/``P`` literals checked against the
+  mesh axis vocabulary: unknown axis names, the same axis sharding two
+  dims, and specs naming more distinct axes than the mesh has rank
+  (when the file declares its mesh via ``build_mesh({...})`` /
+  ``Mesh(devs, (...))`` literals, that declared rank wins).
+* **PTL802** — collective calls under rank-dependent control flow
+  (``if rank == 0:``, ``for i in range(get_rank()):``, ``while`` on a
+  rank-derived value) or data-dependent branches (a host read like
+  ``.item()``/``.all()`` in the test): the call-order-divergence
+  deadlock.  Uniform dispatch branches (``if g.in_spmd_scope():``) do
+  not trigger.
+* **PTL803** — donation aliasing: a name bound to
+  ``jax.jit(f, donate_argnums=...)`` (directly or via a ``**kw`` dict
+  literal) whose donated argument is read after the donating call, or
+  passed into two positions of one donated call.  Rebinding the name
+  to the call's result (``state = step(state, ...)``) is the sanctioned
+  pattern and does not trigger.
+* **PTL804** — every boolean knob on ``fleet.DistributedStrategy``
+  must map through :data:`STRATEGY_KNOB_HANDLERS` to a registered
+  distributed pass (``pass:<name>``, textually verified against
+  ``register_pass("<name>")`` in ``distributed/passes``), a mesh/layout
+  wiring (``layout:``), a FLAGS mirror (``flag:``), or a documented
+  accepted-for-parity no-op (``parity:``); drift in either direction
+  is a finding.
+
+Scope: ``SHARD_GLOBS`` (distributed/communication, fleet/meta_parallel,
+distributed/sharding.py + shard_utils.py + parallel.py, auto_parallel)
+for PTL801–803; ``STRATEGY_GLOBS`` for PTL804.  Wired into
+``lint_source`` so the CLI, ``tools/run_analysis.py``, ``--changed-only``
+and ``pytest -m lint`` all pick it up; ``# noqa: PTL80x`` suppression
+rides the shared lint machinery.  Stdlib-only (no jax import).
+
+The runtime twin — the ``FLAGS_collective_sanitizer`` fingerprint
+cross-check — lives in ``distributed/communication/sanitizer.py``; this
+module is the half that runs before any device exists.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import Finding, make_finding
+
+__all__ = [
+    "SHARD_GLOBS", "STRATEGY_GLOBS", "CANONICAL_AXES",
+    "STRATEGY_KNOB_HANDLERS", "is_shard_path", "is_strategy_path",
+    "shard_findings_source", "strategy_findings_source",
+]
+
+# files the SPMD consistency rules (PTL801-803) scan — the distributed
+# layer's layout/collective/donation surface (fnmatch '*' crosses '/')
+SHARD_GLOBS = (
+    "*/distributed/communication/*.py",
+    "*/distributed/fleet/meta_parallel/*.py",
+    "*/distributed/sharding.py",
+    "*/distributed/shard_utils.py",
+    "*/distributed/parallel.py",
+    "*/distributed/mesh.py",
+    "*/distributed/auto_parallel/*.py",
+)
+
+# the DistributedStrategy surface PTL804 audits
+STRATEGY_GLOBS = ("*/fleet/base/distributed_strategy.py",)
+
+
+def is_shard_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in SHARD_GLOBS)
+
+
+def is_strategy_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in STRATEGY_GLOBS)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# PTL801 — PartitionSpec vs mesh
+# ---------------------------------------------------------------------------
+
+# the axis vocabulary both naming worlds use: mesh.HYBRID_AXES (+ the
+# optional cp/ep degrees) and the fleet topology's parallel-dimension
+# names.  File-local declarations (build_mesh/Mesh literals, axis_name=
+# kwargs) extend this per file.
+CANONICAL_AXES: Set[str] = {
+    "dp", "pp", "sharding", "sep", "cp", "ep", "mp",
+    "data", "pipe", "model", "context", "expert",
+}
+# the hybrid mesh never exceeds this many simultaneous axes
+_MAX_MESH_RANK = 7
+
+_SPEC_LEAVES = {"PartitionSpec", "P"}
+
+
+def _declared_axes(tree: ast.AST) -> Set[str]:
+    """Axis names the file declares itself: ``build_mesh({...})`` dict
+    keys, ``Mesh(devs, (names...))`` literals, ``axis_name=``/
+    ``axis_names=`` constant kwargs."""
+    out: Set[str] = set()
+
+    def add_const_strs(node: ast.AST):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                add_const_strs(e)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = (_dotted(node.func) or "").split(".")[-1]
+        if leaf == "build_mesh" and node.args and \
+                isinstance(node.args[0], ast.Dict):
+            for k in node.args[0].keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.add(k.value)
+        elif leaf == "Mesh" and len(node.args) >= 2:
+            add_const_strs(node.args[1])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                add_const_strs(kw.value)
+    return out
+
+
+def _spec_entry_axes(entry: ast.AST) -> Optional[List[str]]:
+    """Constant axis tokens of one PartitionSpec entry; [] for None
+    (replicated dim); None when the entry is not statically known."""
+    if isinstance(entry, ast.Constant):
+        if entry.value is None:
+            return []
+        if isinstance(entry.value, str):
+            return [entry.value]
+        return None
+    if isinstance(entry, (ast.Tuple, ast.List)):
+        toks: List[str] = []
+        for e in entry.elts:
+            sub = _spec_entry_axes(e)
+            if sub is None:
+                return None
+            toks.extend(sub)
+        return toks
+    return None
+
+
+def _check_partition_specs(tree: ast.AST, filename: str,
+                           findings: List[Finding]) -> None:
+    declared = _declared_axes(tree)
+    vocab = CANONICAL_AXES | declared
+    mesh_rank = len(declared) if declared else _MAX_MESH_RANK
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = (_dotted(node.func) or "").split(".")[-1]
+        if leaf not in _SPEC_LEAVES:
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            continue                     # P(*spec): dynamic, not checkable
+        all_known = True
+        seen: Dict[str, int] = {}
+        for entry in node.args:
+            toks = _spec_entry_axes(entry)
+            if toks is None:
+                all_known = False
+                continue
+            for tok in toks:
+                seen[tok] = seen.get(tok, 0) + 1
+                if tok not in vocab:
+                    findings.append(make_finding(
+                        "PTL801",
+                        f"PartitionSpec names unknown mesh axis "
+                        f"{tok!r} (known axes: "
+                        f"{', '.join(sorted(vocab))})",
+                        file=filename, line=node.lineno,
+                        col=node.col_offset))
+        for tok, n in sorted(seen.items()):
+            if n > 1:
+                findings.append(make_finding(
+                    "PTL801",
+                    f"PartitionSpec shards mesh axis {tok!r} onto "
+                    f"{n} dims — an axis can partition at most one "
+                    "dim of one array",
+                    file=filename, line=node.lineno,
+                    col=node.col_offset))
+        if all_known and len(seen) > mesh_rank:
+            findings.append(make_finding(
+                "PTL801",
+                f"PartitionSpec names {len(seen)} distinct mesh axes "
+                f"but the mesh has at most {mesh_rank} — no device "
+                "assignment can satisfy this layout",
+                file=filename, line=node.lineno, col=node.col_offset))
+
+
+# ---------------------------------------------------------------------------
+# PTL802 — rank-divergent collective order
+# ---------------------------------------------------------------------------
+
+# collective leaves that are unambiguous wherever they appear
+_COLLECTIVE_LEAVES = {
+    "all_reduce", "all_gather", "all_gather_object", "reduce_scatter",
+    "alltoall", "alltoall_single", "all_to_all", "batch_isend_irecv",
+    "barrier", "isend", "irecv", "psum", "pmax", "pmin", "pmean",
+    "ppermute", "psum_scatter",
+}
+# generic leaves that only count with a comm-shaped base (dist.reduce
+# is a collective; parser.reduce is not)
+_COLLECTIVE_GENERIC = {"reduce", "scatter", "gather", "broadcast",
+                       "send", "recv"}
+_COLLECTIVE_BASES = {"dist", "distributed", "collective",
+                     "collective_ops", "comm", "lax", "stream"}
+
+# name parts that mark an expression as rank-dependent (split on '_');
+# plural/world forms are uniform across ranks and excluded
+_RANK_TOKENS = {"rank"}
+_RANK_CALL_LEAVES = {"get_rank", "axis_index", "worker_index",
+                     "get_group_rank", "local_rank", "process_index"}
+# host reads that make a branch data-dependent
+_DATA_READ_LEAVES = {"item", "all", "any", "numpy", "tolist"}
+
+
+def _is_collective_call(node: ast.Call) -> Optional[str]:
+    dotted = _dotted(node.func)
+    if dotted is None:
+        return None
+    parts = dotted.split(".")
+    leaf = parts[-1]
+    if leaf in _COLLECTIVE_LEAVES:
+        return leaf
+    if leaf in _COLLECTIVE_GENERIC and len(parts) >= 2 and \
+            any(p in _COLLECTIVE_BASES for p in parts[:-1]):
+        return leaf
+    return None
+
+
+def _divergence_reason(expr: ast.AST) -> Optional[str]:
+    """Why evaluating ``expr`` can differ across ranks, or None."""
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            dotted = _dotted(node) or getattr(node, "attr", "") or ""
+            for part in dotted.split("."):
+                sub = set(part.lower().split("_"))
+                if sub & _RANK_TOKENS:
+                    return f"rank-dependent value {dotted!r}"
+        if isinstance(node, ast.Call):
+            # node.func.attr directly: _dotted() cannot resolve chained
+            # call bases like x.mean().item, but the leaf is what matters
+            if isinstance(node.func, ast.Attribute):
+                leaf = node.func.attr
+            else:
+                leaf = (_dotted(node.func) or "").split(".")[-1]
+            if leaf in _RANK_CALL_LEAVES:
+                return f"rank-dependent call {leaf}()"
+            if leaf in _DATA_READ_LEAVES and not node.args and \
+                    isinstance(node.func, ast.Attribute):
+                return f"data-dependent host read .{leaf}()"
+    return None
+
+
+class _CollectiveOrder(ast.NodeVisitor):
+    """Flags collective calls inside control flow whose path can differ
+    across ranks (the call-order-divergence deadlock)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+        self._divergent: List[Tuple[int, str]] = []   # (line, reason)
+
+    def _visit_guarded(self, node, reason: Optional[str],
+                       bodies: Sequence[Sequence[ast.stmt]]):
+        if reason is not None:
+            self._divergent.append((node.lineno, reason))
+        for body in bodies:
+            for child in body:
+                self.visit(child)
+        if reason is not None:
+            self._divergent.pop()
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        self._visit_guarded(node, _divergence_reason(node.test),
+                            (node.body, node.orelse))
+
+    def visit_While(self, node):
+        self.visit(node.test)
+        self._visit_guarded(node, _divergence_reason(node.test),
+                            (node.body, node.orelse))
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self._visit_guarded(node, _divergence_reason(node.iter),
+                            (node.body, node.orelse))
+
+    def visit_Call(self, node):
+        leaf = _is_collective_call(node)
+        if leaf is not None and self._divergent:
+            line, reason = self._divergent[-1]
+            self.findings.append(make_finding(
+                "PTL802",
+                f"collective {leaf}() under {reason} (line {line}): "
+                "call order can diverge across ranks — ranks that skip "
+                "this path never enter the collective and the rest "
+                "deadlock; hoist the collective out and mask the "
+                "payload instead",
+                file=self.filename, line=node.lineno,
+                col=node.col_offset))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# PTL803 — donation aliasing
+# ---------------------------------------------------------------------------
+
+_JIT_LEAVES = {"jit", "pjit"}
+
+
+def _donated_positions(call: ast.Call,
+                       kw_dicts: Dict[str, Tuple[int, ...]]
+                       ) -> Optional[Tuple[int, ...]]:
+    """Donated positions of a ``jax.jit(...)`` call, resolving
+    ``donate_argnums=`` literals and ``**kw`` dict-literal bindings."""
+    leaf = (_dotted(call.func) or "").split(".")[-1]
+    if leaf not in _JIT_LEAVES:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _int_tuple(kw.value)
+        if kw.arg is None:               # jax.jit(step, **kw)
+            name = kw.value.id if isinstance(kw.value, ast.Name) else None
+            if name in kw_dicts:
+                return kw_dicts[name]
+    return None
+
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+class _DonationAliasing:
+    """Per-function donation tracking.  A name bound to a donated jit
+    is a *donating callable*; at each of its call sites the donated
+    positional args' buffers die — a later Load of that name (without
+    an intervening rebind) or the same name at two positions of the
+    call is a hazard."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.findings: List[Finding] = []
+
+    def run(self, tree: ast.AST) -> None:
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            self._check_function(fn)
+        # module level counts as one scope too (scripts/examples)
+        self._check_function(ast.Module(body=[
+            s for s in getattr(tree, "body", [])
+            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef))], type_ignores=[]))
+
+    def _check_function(self, fn) -> None:
+        # nested defs get their own _check_function pass; exclude their
+        # bodies from this scope
+        nested = {id(x)
+                  for sub in ast.walk(fn)
+                  if isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and sub is not fn
+                  for x in ast.walk(sub)}
+        own = [n for n in ast.walk(fn) if id(n) not in nested]
+
+        kw_dicts: Dict[str, Tuple[int, ...]] = {}
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for node in own:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            tgt = node.targets[0].id
+            if isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and \
+                            k.value == "donate_argnums":
+                        pos = _int_tuple(v)
+                        if pos:
+                            kw_dicts[tgt] = pos
+            elif isinstance(node.value, ast.Call):
+                pos = _donated_positions(node.value, kw_dicts)
+                if pos:
+                    donating[tgt] = pos
+
+        if not donating:
+            return
+
+        # name -> line numbers of Stores/Loads in this scope
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[int]] = {}
+        for node in own:
+            if isinstance(node, ast.Name):
+                (stores if isinstance(node.ctx, ast.Store)
+                 else loads).setdefault(node.id, []).append(node.lineno)
+
+        for node in own:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donating):
+                continue
+            pos = donating[node.func.id]
+            names_at = [(i, a.id) for i, a in enumerate(node.args)
+                        if isinstance(a, ast.Name)]
+            donated_here = [(i, n) for i, n in names_at if i in pos]
+            for i, name in donated_here:
+                others = [j for j, n in names_at if n == name and j != i]
+                if others:
+                    self.findings.append(make_finding(
+                        "PTL803",
+                        f"{name!r} is passed at donated position {i} "
+                        f"AND position {others[0]} of the same "
+                        f"{node.func.id}() call — one buffer aliased "
+                        "to two parameters of a donating dispatch",
+                        file=self.filename, line=node.lineno,
+                        col=node.col_offset))
+                # a read after the donating call, with no rebind of the
+                # name in between, touches the dead buffer.  The rebind
+                # on the call's own line (state = step(state, ...)) is
+                # the sanctioned pattern and counts as the horizon.
+                later_stores = [ln for ln in stores.get(name, [])
+                                if ln >= node.lineno]
+                horizon = min(later_stores) if later_stores else None
+                for ln in sorted(loads.get(name, [])):
+                    if ln <= node.lineno:
+                        continue
+                    if horizon is not None and ln > horizon:
+                        break
+                    self.findings.append(make_finding(
+                        "PTL803",
+                        f"{name!r} was donated to {node.func.id}() on "
+                        f"line {node.lineno} and is read again here — "
+                        "the buffer is invalidated at dispatch; rebind "
+                        f"the result ({name} = {node.func.id}(...)) or "
+                        "drop the donation",
+                        file=self.filename, line=ln, col=0))
+                    break                # one finding per donation site
+
+
+# ---------------------------------------------------------------------------
+# PTL804 — DistributedStrategy knob coverage
+# ---------------------------------------------------------------------------
+
+# knob -> handler.  Prefixes:
+#   pass:<name>   lowered by a registered distributed pass (textually
+#                 verified against register_pass(...) in
+#                 distributed/passes; a trailing match covers the
+#                 pipeline_scheduler_<mode> f-string family)
+#   layout:<how>  lowered through mesh axes / wrapper layers
+#   flag:<name>   mirrors a FLAGS_* knob (flags.py owns the behavior)
+#   parity:<why>  accepted-and-ignored for API parity (XLA owns it)
+STRATEGY_KNOB_HANDLERS: Dict[str, str] = {
+    "auto": "parity: legacy auto-graph toggle; the jit cache owns "
+            "graph optimization",
+    "a_sync": "parity: parameter-server async training is out of "
+              "scope (a_sync_configs accepted)",
+    "sync_nccl_allreduce": "flag: sync_nccl_allreduce (flags.py); XLA "
+                           "owns stream synchronization",
+    "find_unused_parameters": "layout: fleet.distributed_optimizer "
+                              "masks parameters without grads",
+    "fuse_all_reduce_ops": "pass: fuse_all_reduce",
+    "without_graph_optimization": "parity: XLA always optimizes; no "
+                                  "build-strategy graph pass to skip",
+    "amp": "pass: auto_parallel_amp",
+    "recompute": "pass: auto_parallel_recompute",
+    "pipeline": "pass: pipeline_scheduler_",
+    "tensor_parallel": "layout: mp mesh axis via fleet topology + "
+                       "meta_parallel mp_layers",
+    "sharding": "pass: auto_parallel_sharding",
+    "gradient_merge": "pass: auto_parallel_gradient_merge_pass",
+    "lamb": "parity: optimizer family is chosen by the user-passed "
+            "optimizer object, not a meta-optimizer rewrite",
+    "lars": "parity: same as lamb — optimizer choice is explicit",
+    "dgc": "parity: deep gradient compression targets commodity "
+           "ethernet; ICI bandwidth makes it a pessimization",
+    "localsgd": "parity: local-SGD staleness control is subsumed by "
+                "the synchronous GSPMD step",
+    "adaptive_localsgd": "parity: see localsgd",
+    "heter_ccl_mode": "parity: heterogeneous collectives need mixed "
+                      "device pools; TPU pods are homogeneous",
+    "is_fl_ps_mode": "parity: federated parameter-server mode is out "
+                     "of scope",
+    "qat": "layout: quantization flows live in paddle.quantization "
+           "(the strategy bit gates them like the reference's "
+           "meta-optimizer)",
+    "asp": "layout: 2:4 sparsity masks live in incubate.asp; the "
+           "strategy bit gates mask application",
+    "fp16_allreduce": "parity: collective dtype follows the array "
+                      "dtype inside the compiled program",
+    "use_hierarchical_allreduce": "parity: XLA emits hierarchical "
+                                  "collectives on ICI/DCN itself",
+    "calc_comm_same_stream": "parity: XLA owns stream assignment",
+    "fuse_grad_merge": "parity: grad-merge buffers are fused by XLA "
+                       "buffer assignment",
+    "sync_batch_norm": "layout: nn.SyncBatchNorm reduces over the dp "
+                       "axis inside the program",
+    "cudnn_exhaustive_search": "parity: cudnn autotune is meaningless "
+                               "on TPU",
+    "cudnn_batchnorm_spatial_persistent": "parity: cudnn knob, "
+                                          "meaningless on TPU",
+    "semi_auto": "layout: auto_parallel.Engine consumes it to enable "
+                 "plan search over the mesh",
+}
+
+_STRATEGY_CLASS = "DistributedStrategy"
+_REGISTER_PASS_RE = re.compile(
+    r"register_pass\(\s*f?[\"']([A-Za-z0-9_]+)")
+
+
+def _strategy_bool_knobs(tree: ast.AST) -> Dict[str, int]:
+    """knob -> line for every ``self.<knob> = <bool literal>`` in
+    ``DistributedStrategy.__init__``."""
+    out: Dict[str, int] = {}
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == _STRATEGY_CLASS):
+            continue
+        for fn in cls.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "__init__"):
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, bool):
+                    out[tgt.attr] = node.lineno
+    return out
+
+
+def _registered_pass_names(strategy_path: str) -> Optional[Set[str]]:
+    """Names passed to ``register_pass(...)`` in distributed/passes,
+    located relative to the real strategy file; None when the tree is
+    not on disk (fixture blobs) — the pass-name sub-check then skips."""
+    # .../distributed/fleet/base/distributed_strategy.py -> .../distributed
+    d = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(strategy_path))))
+    passes_dir = os.path.join(d, "passes")
+    if not os.path.isdir(passes_dir):
+        return None
+    names: Set[str] = set()
+    for fname in sorted(os.listdir(passes_dir)):
+        if not fname.endswith(".py"):
+            continue
+        try:
+            with open(os.path.join(passes_dir, fname), "r",
+                      encoding="utf-8") as fh:
+                names.update(_REGISTER_PASS_RE.findall(fh.read()))
+        except OSError:
+            continue
+    return names
+
+
+def strategy_findings_source(source: str, filename: str,
+                             tree: Optional[ast.AST] = None
+                             ) -> List[Finding]:
+    """PTL804 over one strategy-file blob (fixture-testable core)."""
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=filename)
+        except SyntaxError:
+            return []
+    findings: List[Finding] = []
+    knobs = _strategy_bool_knobs(tree)
+    if not knobs:
+        return findings
+    for knob, line in sorted(knobs.items()):
+        if knob not in STRATEGY_KNOB_HANDLERS:
+            findings.append(make_finding(
+                "PTL804",
+                f"DistributedStrategy knob {knob!r} has no handler "
+                "mapping — setting it changes nothing; map it in "
+                "analysis.shardcheck.STRATEGY_KNOB_HANDLERS "
+                "(pass:/layout:/flag:/parity:) or remove the knob",
+                file=filename, line=line))
+    # reverse drift only against a real strategy surface (a fixture
+    # declaring two knobs should not owe the whole table)
+    if len(set(knobs) & set(STRATEGY_KNOB_HANDLERS)) >= \
+            len(STRATEGY_KNOB_HANDLERS) // 2:
+        for knob in sorted(set(STRATEGY_KNOB_HANDLERS) - set(knobs)):
+            findings.append(make_finding(
+                "PTL804",
+                f"handler table maps knob {knob!r} but "
+                "DistributedStrategy no longer defines it — stale "
+                "entry in STRATEGY_KNOB_HANDLERS",
+                file=filename, line=0))
+    # pass:<name> entries must point at registered distributed passes
+    registered = _registered_pass_names(filename)
+    if registered is not None:
+        for knob, handler in sorted(STRATEGY_KNOB_HANDLERS.items()):
+            if knob not in knobs or not handler.startswith("pass:"):
+                continue
+            name = handler.split(":", 1)[1].strip().split()[0]
+            if not any(r == name or r.startswith(name)
+                       for r in registered):
+                findings.append(make_finding(
+                    "PTL804",
+                    f"knob {knob!r} maps to pass {name!r} but no "
+                    "register_pass call in distributed/passes "
+                    "registers it",
+                    file=filename, line=knobs[knob]))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points (lint.py calls these behind the glob predicates)
+# ---------------------------------------------------------------------------
+
+def shard_findings_source(source: str, filename: str,
+                          tree: Optional[ast.AST] = None
+                          ) -> List[Finding]:
+    """PTL801-803 over one source blob (fixture-testable core)."""
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=filename)
+        except SyntaxError:
+            return []
+    findings: List[Finding] = []
+    _check_partition_specs(tree, filename, findings)
+    order = _CollectiveOrder(filename)
+    order.visit(tree)
+    findings.extend(order.findings)
+    donation = _DonationAliasing(filename)
+    donation.run(tree)
+    findings.extend(donation.findings)
+    return findings

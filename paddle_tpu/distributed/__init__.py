@@ -19,7 +19,9 @@ from .communication import (Group, ReduceOp, get_group, new_group,
                             broadcast_object_list, reduce, scatter, gather,
                             scatter_object_list, reduce_scatter, alltoall,
                             alltoall_single, send, recv, isend, irecv,
-                            P2POp, batch_isend_irecv, barrier, wait, stream)
+                            P2POp, batch_isend_irecv, barrier, wait, stream,
+                            CollectiveMismatchError, get_sanitizer,
+                            reset_sanitizer)
 
 
 def get_backend() -> str:

@@ -4,4 +4,6 @@ from .collective_ops import (all_reduce, all_gather, all_gather_object,
                              scatter, gather, scatter_object_list, reduce_scatter,
                              alltoall, alltoall_single, send, recv, isend,
                              irecv, P2POp, batch_isend_irecv, barrier, wait)
+from .sanitizer import (CollectiveMismatchError, CollectiveSanitizer,
+                        Fingerprint, get_sanitizer, reset_sanitizer)
 from . import stream

@@ -44,6 +44,22 @@ class _Work:
         return True
 
 
+def _collective_entry(op_name: str, g, tensor=None, reduce_op=None):
+    """Host-side collective entry, shared by every collective below:
+    the resilience fault point (a scheduled crash/stall here models a
+    rank dying inside NCCL/ICI; truncate/corrupt queue payload damage)
+    plus the FLAGS_collective_sanitizer fingerprint cross-check, which
+    raises CollectiveMismatchError BEFORE dispatch when ranks disagree
+    on order/shape/dtype/reduce-op — instead of hanging on hardware.
+    Delegating wrappers (reduce→all_reduce, gather→all_gather,
+    isend/irecv→send/recv) are not hooked: one entry, one fingerprint.
+    """
+    from ...resilience.faults import maybe_fault
+    maybe_fault("collective", op=op_name)
+    from .sanitizer import observe_collective
+    observe_collective(op_name, g, tensor=tensor, reduce_op=reduce_op)
+
+
 def _reduce_fn(op, axis):
     if op == ReduceOp.SUM:
         return lambda x: jax.lax.psum(x, axis)
@@ -70,12 +86,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op: bool = True,
     """In-place across-rank reduction (ref: distributed/communication/
     all_reduce.py).  Eager single-controller: the array is already a global
     value so the reduction is an identity."""
-    # resilience fault point: host-side entry of the collective layer
-    # (a scheduled crash/stall here models a rank dying inside NCCL/ICI)
-    from ...resilience.faults import maybe_fault
-    maybe_fault("collective", op="all_reduce")
     g = _resolve_group(group)
     t = _as_tensor(tensor)
+    _collective_entry("all_reduce", g, tensor=t, reduce_op=op)
     if g.in_spmd_scope():
         # grad kernel matches the reference's c_allreduce_sum_grad:
         # identity (per-rank loss calculus), NOT jax's psum-transpose
@@ -118,6 +131,7 @@ def all_gather(tensor_list: Optional[List], tensor=None, group=None,
         tensor_list, tensor = None, tensor_list
     g = _resolve_group(group)
     t = _as_tensor(tensor)
+    _collective_entry("all_gather", g, tensor=t)
     if g.in_spmd_scope():
         cat = _all_gather_value(t, g)
     elif g.nranks == 1:
@@ -165,6 +179,7 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True,
     masked psum (lowered by XLA to a real broadcast on ICI)."""
     g = _resolve_group(group)
     t = _as_tensor(tensor)
+    _collective_entry("broadcast", g, tensor=t)
     if g.in_spmd_scope():
         axis = g.axis_name
         sg = g.get_group_rank(src) if src in g.ranks else src
@@ -187,6 +202,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
     """ref: communication/scatter.py — src's tensor_list scattered one
     chunk per rank."""
     g = _resolve_group(group)
+    _collective_entry("scatter", g, tensor=_as_tensor(tensor))
     if g.in_spmd_scope():
         axis = g.axis_name
         if tensor_list is not None:
@@ -232,6 +248,13 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op: bool = True, use_calc_stream: bool = False):
     """ref: communication/reduce_scatter.py."""
     g = _resolve_group(group)
+    # list form: ``tensor`` is the scattered OUTPUT — only the
+    # functional input form carries the pre-scatter payload shape
+    _collective_entry("reduce_scatter", g,
+                      tensor=None if (tensor_list is not None
+                                      or tensor is None)
+                      else _as_tensor(tensor),
+                      reduce_op=op)
     if g.in_spmd_scope():
         axis = g.axis_name
         if tensor_list is not None:
@@ -279,6 +302,9 @@ def alltoall(out_tensor_list, in_tensor_list=None, group=None,
     g = _resolve_group(group)
     if in_tensor_list is None:
         in_tensor_list, out_tensor_list = out_tensor_list, None
+    _collective_entry("alltoall", g,
+                      tensor=_as_tensor(in_tensor_list[0])
+                      if in_tensor_list else None)
     if g.in_spmd_scope():
         stacked = call_op(lambda *xs: jnp.stack(xs, axis=0),
                           tuple(_as_tensor(x) for x in in_tensor_list),
@@ -310,6 +336,7 @@ def alltoall_single(out_tensor, in_tensor=None,
     if in_tensor is None:
         in_tensor, out_tensor = out_tensor, None
     t = _as_tensor(in_tensor)
+    _collective_entry("alltoall_single", g, tensor=t)
     if g.in_spmd_scope():
         def fn(x):
             n = jax.lax.axis_size(g.axis_name)
@@ -350,6 +377,7 @@ def send(tensor, dst: int = 0, group=None, sync_op: bool = True,
     pipeline p2p helper (ref: pp_utils/p2p_communication.py).  Outside SPMD
     scope this is a no-op record."""
     g = _resolve_group(group)
+    _collective_entry("send", g, tensor=_as_tensor(tensor))
     if len(_p2p_pending) >= _P2P_PENDING_MAX:
         # unmatched sends must not pin tensors forever
         _p2p_pending.pop(0)
@@ -361,6 +389,7 @@ def recv(tensor, src: int = 0, group=None, sync_op: bool = True,
          use_calc_stream: bool = False):
     g = _resolve_group(group)
     t = _as_tensor(tensor)
+    _collective_entry("recv", g, tensor=t)
     for i, (kind, st, dst, sg) in enumerate(_p2p_pending):
         if kind == "send" and sg is g:
             _p2p_pending.pop(i)
@@ -392,6 +421,10 @@ class P2POp:
 
 def batch_isend_irecv(p2p_op_list: Sequence[P2POp]):
     """Pairs sends with recvs into ppermutes (SPMD scope)."""
+    if p2p_op_list:
+        first = p2p_op_list[0]
+        _collective_entry("batch_isend_irecv", first.group,
+                          tensor=first.tensor)
     sends = [p for p in p2p_op_list if p.op in (isend, send)]
     recvs = [p for p in p2p_op_list if p.op in (irecv, recv)]
     works = []
@@ -425,6 +458,7 @@ def irecv(tensor, src: int = 0, group=None):
 def barrier(group=None):
     """ref: communication/barrier.py."""
     g = _resolve_group(group)
+    _collective_entry("barrier", g)
     if g.in_spmd_scope():
         call_op(lambda x: jax.lax.psum(x, g.axis_name),
                 (Tensor(jnp.ones(())),), op_name="barrier")

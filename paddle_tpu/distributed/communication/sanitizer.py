@@ -1,0 +1,247 @@
+"""Runtime collective sanitizer (``FLAGS_collective_sanitizer``).
+
+The failure mode this exists for: two ranks disagree about the next
+collective — different op, different shape, different dtype, different
+reduce op — and the job does not crash, it **hangs**: every rank sits
+in its own collective waiting for peers that are in a different one,
+until the stage timeout kills the pod many minutes later with no
+diagnostic.  The static twin (``analysis.shardcheck`` PTL802) catches
+the control-flow shapes that cause this at lint time; this module
+catches everything else at run time, while the information still
+exists.
+
+Mechanism: when the flag is on, every collective entry point in
+``collective_ops`` records an order/shape/dtype/reduce-op
+:class:`Fingerprint` per rank of the group (the 8-device virtual mesh
+fans out one fingerprint per rank from the single controller — the
+same per-rank view a multi-process launcher would record locally).
+The sanitizer cross-checks each row of the per-rank streams as soon as
+every rank has recorded it, **before** the collective executes: on
+disagreement it emits a ``collective_mismatch`` event (so the watchdog
+and flight recorder see the would-be hang even if the raise is
+swallowed) and raises :class:`CollectiveMismatchError` carrying both
+ranks' full fingerprint streams — the exact trace a human needs to see
+where the orders diverged.
+
+Chaos integration: ``FLAGS_fault_schedule`` entries like
+``collective@2=truncate`` / ``collective@2=corrupt`` queue payload
+damage in ``resilience.faults``; the sanitizer consumes it and applies
+it to the last rank's fingerprint (truncate halves the leading dim,
+corrupt flips the dtype), so an injected torn/bit-rotten collective
+payload surfaces as a raised mismatch diagnostic, not a hang
+(tests/test_resilience.py proves the path).
+
+Stdlib-only: imported from the collective entry points which must not
+grow import weight; jax never appears here.  The flag is read lazily
+per entry (no on_change hook) so flag bootstrap never imports
+observability.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Fingerprint", "CollectiveMismatchError", "CollectiveSanitizer",
+           "get_sanitizer", "reset_sanitizer", "observe_collective"]
+
+
+@dataclass(frozen=True)
+class Fingerprint:
+    """One rank's view of one collective call, in program order."""
+    seq: int                       # 0-based call index within the group
+    op: str                        # all_reduce / broadcast / ...
+    shape: Tuple[int, ...]
+    dtype: str
+    reduce_op: str                 # "" for ops without a reduction
+    group: str
+    nranks: int
+
+    def render(self) -> str:
+        red = f", reduce={self.reduce_op}" if self.reduce_op else ""
+        return (f"#{self.seq} {self.op}(shape={list(self.shape)}, "
+                f"dtype={self.dtype}{red}) @{self.group}/{self.nranks}")
+
+    def agrees_with(self, other: "Fingerprint") -> bool:
+        return (self.seq == other.seq and self.op == other.op
+                and self.shape == other.shape
+                and self.dtype == other.dtype
+                and self.reduce_op == other.reduce_op)
+
+
+class CollectiveMismatchError(RuntimeError):
+    """Two ranks disagree on a collective fingerprint — the diagnostic
+    raised *instead of* the hang the disagreement would cause on real
+    hardware.  Carries both ranks' full streams for post-mortems."""
+
+    def __init__(self, group: str, rank_a: int, rank_b: int,
+                 stream_a: List[Fingerprint], stream_b: List[Fingerprint],
+                 seq: int):
+        self.group = group
+        self.rank_a = rank_a
+        self.rank_b = rank_b
+        self.stream_a = list(stream_a)
+        self.stream_b = list(stream_b)
+        self.seq = seq
+        a = "\n    ".join(fp.render() for fp in self.stream_a) or "(empty)"
+        b = "\n    ".join(fp.render() for fp in self.stream_b) or "(empty)"
+        super().__init__(
+            f"collective mismatch in group {group!r} at call #{seq}: "
+            f"rank {rank_a} and rank {rank_b} disagree — on hardware "
+            "this hangs until the stage timeout.  "
+            f"rank {rank_a} stream:\n    {a}\n"
+            f"rank {rank_b} stream:\n    {b}")
+
+
+class CollectiveSanitizer:
+    """Per-process fingerprint recorder + cross-rank agreement check."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # group name -> rank -> fingerprint stream (program order)
+        self._streams: Dict[str, Dict[int, List[Fingerprint]]] = {}
+        # group name -> next unchecked row index
+        self._checked: Dict[str, int] = {}
+        self._seq: Dict[str, int] = {}
+
+    # -- recording -------------------------------------------------------
+    def record(self, group: str, nranks: int, rank: int,
+               fp: Fingerprint) -> None:
+        """Record one rank's fingerprint; cross-check every row all
+        ranks have reached.  Raises :class:`CollectiveMismatchError`
+        on the first disagreement."""
+        with self._lock:
+            ranks = self._streams.setdefault(group, {})
+            ranks.setdefault(rank, []).append(fp)
+            row = self._checked.get(group, 0)
+            while len(ranks) == nranks and \
+                    all(len(s) > row for s in ranks.values()):
+                base_rank = min(ranks)
+                base = ranks[base_rank][row]
+                for r in sorted(ranks):
+                    if not ranks[r][row].agrees_with(base):
+                        self._report(group, base_rank, r, row)
+                row += 1
+                self._checked[group] = row
+
+    def observe(self, op: str, group: str, nranks: int,
+                shape: Tuple[int, ...], dtype: str,
+                reduce_op: str = "", spmd: bool = False) -> None:
+        """Single-controller entry: fan one call out into per-rank
+        fingerprints (each rank of the virtual mesh sees the same
+        program, so their views agree unless something — e.g. injected
+        chaos damage — made one rank's payload diverge)."""
+        if spmd and op in ("reduce_scatter", "alltoall_single") and \
+                shape and nranks and shape[0] % nranks:
+            raise ValueError(
+                f"{op} payload dim 0 ({shape[0]}) is not divisible by "
+                f"the group size ({nranks}) in group {group!r} — every "
+                "rank would compute a different chunk shape")
+        damage = _take_damage()
+        with self._lock:
+            seq = self._seq.get(group, 0)
+            self._seq[group] = seq + 1
+        victim = nranks - 1
+        for rank in range(nranks):
+            r_shape, r_dtype = shape, dtype
+            if damage is not None and rank == victim:
+                if damage == "truncate" and r_shape:
+                    r_shape = (max(r_shape[0] // 2, 0),) + tuple(r_shape[1:])
+                elif damage == "corrupt":
+                    r_dtype = f"corrupt<{dtype}>"
+            self.record(group, nranks, rank,
+                        Fingerprint(seq, op, tuple(r_shape), r_dtype,
+                                    reduce_op, group, nranks))
+
+    # -- mismatch --------------------------------------------------------
+    def _report(self, group: str, rank_a: int, rank_b: int,
+                row: int) -> None:
+        ranks = self._streams[group]
+        fp_a, fp_b = ranks[rank_a][row], ranks[rank_b][row]
+        # telemetry BEFORE the raise: the watchdog and flight recorder
+        # must see the would-be hang even if the raise is swallowed
+        # (lazy import — this module loads from collective entry points)
+        try:
+            from ...observability import events
+            events.emit("collective_mismatch", op=fp_a.op, group=group,
+                        seq=row, rank_a=rank_a, rank_b=rank_b,
+                        fingerprint_a=fp_a.render(),
+                        fingerprint_b=fp_b.render(),
+                        nranks=fp_a.nranks)
+        except ImportError:
+            pass
+        raise CollectiveMismatchError(
+            group, rank_a, rank_b, ranks[rank_a], ranks[rank_b], row)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._streams.clear()
+            self._checked.clear()
+            self._seq.clear()
+
+
+def _take_damage() -> Optional[str]:
+    """Consume one queued collective@N truncate/corrupt chaos entry."""
+    try:
+        from ...resilience.faults import take_collective_damage
+    except ImportError:
+        return None
+    return take_collective_damage()
+
+
+# ---------------------------------------------------------------------------
+# flag-gated singleton
+# ---------------------------------------------------------------------------
+
+_SANITIZER: Optional[CollectiveSanitizer] = None
+
+
+def get_sanitizer() -> Optional[CollectiveSanitizer]:
+    """The process sanitizer iff ``FLAGS_collective_sanitizer`` is on.
+
+    The flag is read on every call (not an on_change hook) so the
+    sanitizer can be toggled mid-run and flag bootstrap stays free of
+    observability imports."""
+    global _SANITIZER
+    from ...flags import get_flag
+    if not get_flag("collective_sanitizer"):
+        return None
+    if _SANITIZER is None:
+        _SANITIZER = CollectiveSanitizer()
+    return _SANITIZER
+
+
+def reset_sanitizer() -> None:
+    """Drop all recorded streams (tests, and between chaos runs)."""
+    global _SANITIZER
+    _SANITIZER = None
+
+
+# ReduceOp constants (group.py) → stream-readable names
+_REDUCE_NAMES = {0: "SUM", 1: "MAX", 2: "MIN", 3: "PROD", 4: "AVG"}
+
+
+def observe_collective(op: str, group, tensor=None,
+                       reduce_op=None) -> None:
+    """The hook ``collective_ops`` entry points call (after group
+    resolution): a no-op unless the flag is on."""
+    san = get_sanitizer()
+    if san is None:
+        return
+    shape: Tuple[int, ...] = ()
+    dtype = ""
+    if tensor is not None:
+        raw = getattr(tensor, "shape", None)
+        if raw is not None:
+            try:
+                shape = tuple(int(d) for d in raw)
+            except TypeError:
+                shape = ()
+        dtype = str(getattr(tensor, "dtype", "") or "")
+    san.observe(op,
+                group=str(getattr(group, "name", None) or "default"),
+                nranks=int(getattr(group, "nranks", 1) or 1),
+                shape=shape, dtype=dtype,
+                reduce_op="" if reduce_op is None
+                else _REDUCE_NAMES.get(reduce_op, str(reduce_op)),
+                spmd=bool(getattr(group, "in_spmd_scope", lambda: False)()))

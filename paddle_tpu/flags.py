@@ -263,6 +263,17 @@ define_flag("fault_schedule", "",
             "exit, stall, exc, truncate, corrupt.  Empty: disabled. "
             "See paddle_tpu.resilience.faults",
             on_change=_apply_fault_schedule)
+# read lazily by distributed.communication.sanitizer.get_sanitizer()
+# on each collective entry — deliberately no on_change hook (the
+# sanitizer imports observability for mismatch events, which must not
+# load during flag bootstrap)
+define_flag("collective_sanitizer", False,
+            "cross-check order/shape/dtype/reduce-op fingerprints of "
+            "every collective across the mesh before executing; on "
+            "mismatch raise CollectiveMismatchError with both ranks' "
+            "fingerprint streams (instead of the silent hang) and "
+            "emit a collective_mismatch event. "
+            "See paddle_tpu.distributed.communication.sanitizer")
 def _apply_observability_dir(path: str):
     """One flag, every telemetry stream (paddle_tpu.observability):
     the JSONL event log (step/compile/checkpoint/fault/restart/tuning/

@@ -138,6 +138,14 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
     # shared span (e.g. one ragged batch iteration) served
     "trace_span": {"name": "str", "status": "str", "start_ts": "float",
                    "attrs": "object", "links": "object"},
+    # the collective sanitizer (distributed.communication.sanitizer)
+    # caught two ranks disagreeing on a collective fingerprint —
+    # emitted BEFORE the raise so the watchdog and flight recorder see
+    # the would-be hang even if the raise is swallowed upstream
+    "collective_mismatch": {"op": "str", "group": "str", "seq": "int",
+                            "rank_a": "int", "rank_b": "int",
+                            "fingerprint_a": "str",
+                            "fingerprint_b": "str", "nranks": "int"},
 }
 
 _lock = threading.Lock()

@@ -33,10 +33,15 @@ which the fault fires.  Kinds:
   sane heartbeat timeout so the supervisor's liveness watch must fire
 * ``exc[:TypeName]`` — raise a transient exception (a builtin exception
   name, default :class:`InjectedFault`)
-* ``truncate`` / ``corrupt`` — damage the largest data file under the
-  fault point's ``path`` (checkpoint points only): ``truncate`` halves
-  it, ``corrupt`` flips bytes in the middle — the torn-file and
-  bit-rot cases the ``_COMMIT`` digests exist to catch
+* ``truncate`` / ``corrupt`` — at ``ckpt_write``: damage the largest
+  data file under the fault point's ``path`` (``truncate`` halves it,
+  ``corrupt`` flips bytes in the middle — the torn-file and bit-rot
+  cases the ``_COMMIT`` digests exist to catch).  At ``collective``:
+  queue payload damage for the collective sanitizer's per-rank
+  fingerprints (``truncate`` halves one rank's leading dim, ``corrupt``
+  flips one rank's dtype) — the cross-rank divergence the
+  ``FLAGS_collective_sanitizer`` cross-check must surface as a raised
+  ``collective_mismatch`` instead of a hang
 
 Cross-relaunch semantics: occurrence counters are per-process (each
 relaunch counts from 1 again), but when ``PADDLE_FAULT_STATE_FILE`` is
@@ -62,7 +67,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["FaultSpec", "FaultInjector", "InjectedFault", "POINTS",
            "KINDS", "parse_schedule", "install_schedule", "get_injector",
-           "maybe_fault"]
+           "maybe_fault", "queue_collective_damage",
+           "take_collective_damage"]
 
 POINTS = ("step", "ckpt_write", "collective", "compile")
 KINDS = ("crash", "exit", "stall", "exc", "truncate", "corrupt")
@@ -119,9 +125,11 @@ def parse_schedule(text: str) -> List[FaultSpec]:
                              f"(known: {', '.join(KINDS)})")
         if occ < 1:
             raise ValueError(f"occurrence must be >= 1 in {item!r}")
-        if kind in ("truncate", "corrupt") and point != "ckpt_write":
+        if kind in ("truncate", "corrupt") and \
+                point not in ("ckpt_write", "collective"):
             raise ValueError(
-                f"{kind!r} only applies to the ckpt_write point ({item!r})")
+                f"{kind!r} only applies to the ckpt_write and "
+                f"collective points ({item!r})")
         specs.append(FaultSpec(point, occ, kind, m["arg"]))
     return specs
 
@@ -281,8 +289,34 @@ class FaultInjector:
             raise exc_type(
                 f"injected fault: {spec.point}@{spec.occurrence}")
         elif spec.kind in ("truncate", "corrupt"):
-            if path is not None:
+            if spec.point == "collective":
+                # no file to damage: queue payload damage for the
+                # collective sanitizer to apply to one rank's
+                # fingerprint (how a real torn/bit-rotten collective
+                # payload manifests: shapes/dtypes stop agreeing)
+                queue_collective_damage(spec.kind)
+            elif path is not None:
                 damage_checkpoint(path, spec.kind)
+
+
+# ---------------------------------------------------------------------------
+# collective payload damage (truncate/corrupt at the collective point)
+# ---------------------------------------------------------------------------
+
+# pending damage kinds queued by _execute for the collective sanitizer;
+# bounded so an unconsumed queue (sanitizer off) cannot grow
+_COLLECTIVE_DAMAGE: List[str] = []
+_COLLECTIVE_DAMAGE_CAP = 8
+
+
+def queue_collective_damage(kind: str) -> None:
+    if len(_COLLECTIVE_DAMAGE) < _COLLECTIVE_DAMAGE_CAP:
+        _COLLECTIVE_DAMAGE.append(kind)
+
+
+def take_collective_damage() -> Optional[str]:
+    """Pop the oldest queued collective damage kind, or None."""
+    return _COLLECTIVE_DAMAGE.pop(0) if _COLLECTIVE_DAMAGE else None
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +332,7 @@ def install_schedule(text: Optional[str]) -> Optional[FaultInjector]:
     hook, so env ingestion at import wires workers automatically."""
     global _INSTALLED
     specs = parse_schedule(text) if text else []
+    _COLLECTIVE_DAMAGE.clear()       # stale damage must not leak across
     _INSTALLED = FaultInjector(specs) if specs else None
     return _INSTALLED
 

@@ -235,6 +235,30 @@ def test_noqa_suppression():
     assert len(fs) == 1 and fs[0].line == 5
 
 
+def test_noqa_multi_code_suppression():
+    # one comment, several codes, optional free-form rationale after
+    shard = "paddle_tpu/distributed/sharding.py"
+    src = ('def f(state, batch):\n'
+           '    step = jax.jit(body, donate_argnums=(0,))\n'
+           '    s = P("dp", "zp"); out = step(state, batch)\n'
+           '    return state  # consumed above\n')
+    # line 3 carries PTL801 (bogus axis); the stale read fires at line 4
+    base = lint_source(src, shard)
+    assert {f.code for f in base} == {"PTL801", "PTL803"}
+    both = src.replace('batch)\n', 'batch)  # noqa: PTL801,PTL803\n')
+    # PTL803 anchors at the *read* line, not the donating call line
+    fs = lint_source(both, shard)
+    assert {f.code for f in fs} == {"PTL803"}
+    at_read = src.replace('# consumed above',
+                          '# noqa: PTL803, PTL001 stale-read is deliberate')
+    fs = lint_source(at_read, shard)
+    assert {f.code for f in fs} == {"PTL801"}
+    # rationale words after the codes never widen the suppression
+    wrong = src.replace('# consumed above', '# noqa: PTL801 see docs')
+    assert {f.code for f in lint_source(wrong, shard)} == \
+        {"PTL801", "PTL803"}
+
+
 def test_surface_metadata_not_tensorish():
     # .shape / dtype predicates / `is None` must not trip the rules
     src = (
@@ -280,6 +304,63 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
     assert out["findings"][0]["code"] == "PTL001"
     # --select filters down to nothing -> exit 0
     assert cli_main([str(bad), "--select", "PTL006"]) == 0
+
+
+def test_cli_ignore_filter(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("@to_static\ndef f(x):\n    return x.numpy()\n")
+    # dropping the only error-severity code -> exit 0
+    assert cli_main([str(bad), "--ignore", "PTL001"]) == 0
+    capsys.readouterr()
+    # ignoring an unrelated code leaves the error in place
+    assert cli_main([str(bad), "--ignore", "PTL006"]) == 1
+    capsys.readouterr()
+    # ignore wins over select on overlap
+    assert cli_main([str(bad), "--select", "PTL001",
+                     "--ignore", "PTL001"]) == 0
+    capsys.readouterr()
+    # unknown codes are an argparse-level error, same as --select
+    with pytest.raises(SystemExit):
+        cli_main([str(bad), "--ignore", "PTL999"])
+    capsys.readouterr()
+
+
+def test_run_analysis_changed_only(tmp_path, monkeypatch):
+    import subprocess
+    import sys
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import run_analysis
+    finally:
+        sys.path.pop(0)
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*argv):
+        subprocess.run(["git", *argv], cwd=repo, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (repo / "tracked.py").write_text("x = 1\n")
+    (repo / "untouched.py").write_text("y = 2\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # a tracked modification and a fresh untracked file are both in
+    # scope; the untouched file and non-.py churn are not
+    (repo / "tracked.py").write_text("x = 3\n")
+    (repo / "new.py").write_text("z = 4\n")
+    (repo / "notes.txt").write_text("not python\n")
+    changed = run_analysis._changed_files(str(repo))
+    names = sorted(os.path.basename(p) for p in changed)
+    assert names == ["new.py", "tracked.py"]
+    # clean tree + no untracked files -> nothing to lint, exit 0
+    git("add", "-A")
+    git("commit", "-qm", "all in")
+    assert run_analysis._changed_files(str(repo)) == []
+    monkeypatch.chdir(repo)
+    assert run_analysis.main(["--changed-only"]) == 0
 
 
 def test_rule_table_complete():

@@ -75,6 +75,72 @@ def test_schedule_parse_and_validation():
         rf.parse_schedule("step=crash")             # malformed
 
 
+def test_schedule_collective_damage_kinds_parse():
+    # truncate/corrupt are payload-damage kinds: valid at ckpt_write
+    # AND collective, nowhere else (step@1=truncate rejected above)
+    specs = rf.parse_schedule("collective@1=truncate;collective@2=corrupt")
+    assert [(s.point, s.kind) for s in specs] == [
+        ("collective", "truncate"), ("collective", "corrupt")]
+    with pytest.raises(ValueError):
+        rf.parse_schedule("compile@1=corrupt")
+
+
+def test_collective_damage_queue():
+    rf.queue_collective_damage("corrupt")
+    rf.queue_collective_damage("truncate")
+    assert rf.take_collective_damage() == "corrupt"
+    assert rf.take_collective_damage() == "truncate"
+    assert rf.take_collective_damage() is None
+    # install_schedule clears leftovers between runs
+    rf.queue_collective_damage("corrupt")
+    rf.install_schedule(None)
+    assert rf.take_collective_damage() is None
+
+
+def test_chaos_collective_corrupt_raises_not_hangs(tmp_path):
+    """The hang-to-diagnostic contract: an injected collective payload
+    corruption surfaces as CollectiveMismatchError with both ranks'
+    fingerprint streams AND a collective_mismatch event — never as the
+    silent divergence that hangs real hardware."""
+    from paddle_tpu.observability.events import read_events
+    paddle.set_flags({"FLAGS_collective_sanitizer": True,
+                      "FLAGS_observability_dir": str(tmp_path)})
+    dist.reset_sanitizer()
+    rf.install_schedule("collective@2=corrupt")
+    try:
+        t = paddle.to_tensor(np.ones((8, 4), np.float32))
+        dist.all_reduce(t)                       # occurrence 1: clean
+        with pytest.raises(dist.CollectiveMismatchError) as e:
+            dist.all_reduce(t)                   # occurrence 2: corrupt
+        msg = str(e.value)
+        assert "corrupt<paddle.float32>" in msg
+        assert "rank 0" in msg and "rank 7" in msg
+    finally:
+        rf.install_schedule(None)
+        paddle.set_flags({"FLAGS_collective_sanitizer": False,
+                          "FLAGS_observability_dir": ""})
+        dist.reset_sanitizer()
+    recs = read_events(str(tmp_path), kinds=["collective_mismatch"])
+    assert len(recs) == 1 and recs[0]["op"] == "all_reduce"
+    assert recs[0]["nranks"] == 8
+
+
+def test_chaos_collective_truncate_raises(tmp_path):
+    paddle.set_flags({"FLAGS_collective_sanitizer": True})
+    dist.reset_sanitizer()
+    rf.install_schedule("collective@1=truncate")
+    try:
+        t = paddle.to_tensor(np.ones((8, 4), np.float32))
+        with pytest.raises(dist.CollectiveMismatchError) as e:
+            dist.all_reduce(t)
+        # the victim rank's fingerprint shows the halved leading dim
+        assert "[4, 4]" in str(e.value) and "[8, 4]" in str(e.value)
+    finally:
+        rf.install_schedule(None)
+        paddle.set_flags({"FLAGS_collective_sanitizer": False})
+        dist.reset_sanitizer()
+
+
 def test_fault_determinism_same_schedule_same_firing():
     """Same schedule + same call sequence → identical fired_log."""
     logs = []

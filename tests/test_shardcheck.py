@@ -1,0 +1,361 @@
+"""PTL8xx SPMD/collective consistency: the static shardcheck pass and
+the runtime collective sanitizer.
+
+Oracles:
+* each PTL801-804 rule fires on a planted-defect fixture (every defect
+  shape the rule claims to catch) and stays silent on the sanctioned
+  patterns (uniform dispatch branches, rebound donated carries,
+  starred/dynamic specs);
+* the rules ride ``lint_source`` — path predicates scope them to the
+  distributed layer, noqa/select/ignore filtering applies;
+* the sanitizer passes agreeing collectives, and raises
+  ``CollectiveMismatchError`` (carrying BOTH ranks' fingerprint
+  streams) on order/shape/dtype/reduce-op divergence across the
+  8-device virtual mesh — instead of modeling the hang;
+* mismatches emit a ``collective_mismatch`` event for the watchdog and
+  flight recorder; the flag gates everything (off → zero overhead,
+  no recording).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.analysis import lint_source
+from paddle_tpu.analysis.shardcheck import (
+    STRATEGY_KNOB_HANDLERS, is_shard_path, is_strategy_path)
+from paddle_tpu.distributed.communication.sanitizer import (
+    CollectiveMismatchError, CollectiveSanitizer, Fingerprint,
+    get_sanitizer, reset_sanitizer)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# any path the SHARD_GLOBS match — fixtures lint as distributed code
+_SHARD_FILE = "paddle_tpu/distributed/communication/fixture.py"
+_STRATEGY_FILE = "paddle_tpu/distributed/fleet/base/distributed_strategy.py"
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# scoping
+# ---------------------------------------------------------------------------
+
+def test_path_predicates():
+    assert is_shard_path(_SHARD_FILE)
+    assert is_shard_path("x/distributed/fleet/meta_parallel/pp_spmd.py")
+    assert is_shard_path("x/distributed/sharding.py")
+    assert is_shard_path("x/distributed/auto_parallel/engine.py")
+    assert not is_shard_path("paddle_tpu/nn/functional/common.py")
+    assert is_strategy_path(_STRATEGY_FILE)
+    assert not is_strategy_path(_SHARD_FILE)
+    # PTL8xx findings only appear under shard paths
+    src = 'spec = P("dp", "bogus_axis")\n'
+    assert _codes(lint_source(src, _SHARD_FILE)) == ["PTL801"]
+    assert _codes(lint_source(src, "paddle_tpu/tensor/creation.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# PTL801 — PartitionSpec vs mesh
+# ---------------------------------------------------------------------------
+
+def test_ptl801_unknown_axis_fires():
+    fs = lint_source('s = PartitionSpec("dp", "zp")\n', _SHARD_FILE)
+    assert _codes(fs) == ["PTL801"]
+    assert "unknown mesh axis 'zp'" in fs[0].message
+
+
+def test_ptl801_duplicate_axis_fires():
+    fs = lint_source('s = P("mp", None, "mp")\n', _SHARD_FILE)
+    assert _codes(fs) == ["PTL801"]
+    assert "onto 2 dims" in fs[0].message
+
+
+def test_ptl801_arity_vs_declared_mesh():
+    # the file declares a 2-axis mesh -> a 3-axis spec cannot lower
+    src = ('mesh = build_mesh({"dp": 2, "mp": 4})\n'
+           's = P("dp", "mp", "pp")\n')
+    fs = lint_source(src, _SHARD_FILE)
+    assert _codes(fs) == ["PTL801"]
+    assert "3 distinct mesh axes" in fs[0].message
+    # without a declaration the hybrid-mesh maximum (7) applies
+    ok = lint_source('s = P("dp", "mp", "pp")\n', _SHARD_FILE)
+    assert ok == []
+
+
+def test_ptl801_sanctioned_patterns_stay_clean():
+    src = (
+        's1 = P("dp", None, "mp")\n'          # canonical axes
+        's2 = P(*spec)\n'                      # dynamic: not checkable
+        's3 = P(("dp", "sharding"), None)\n'   # multi-axis dim
+        's4 = P(axis_var)\n'                   # non-constant entry
+        'm = Mesh(devs, ("x", "y"))\n'
+        's5 = P("x", "y")\n')                  # file-declared axes
+    assert lint_source(src, _SHARD_FILE) == []
+
+
+# ---------------------------------------------------------------------------
+# PTL802 — rank-divergent collective order
+# ---------------------------------------------------------------------------
+
+def test_ptl802_rank_branch_fires():
+    src = ("def f(x, g):\n"
+           "    if dist.get_rank() == 0:\n"
+           "        dist.all_reduce(x, group=g)\n")
+    fs = lint_source(src, _SHARD_FILE)
+    assert _codes(fs) == ["PTL802"]
+    assert "rank-dependent call get_rank()" in fs[0].message
+
+
+def test_ptl802_rank_loop_and_data_branch_fire():
+    src = ("def f(x, g, rank):\n"
+           "    for i in range(rank):\n"
+           "        dist.broadcast(x, src=i)\n"
+           "    while x.mean().item() > 0:\n"
+           "        dist.barrier()\n")
+    fs = lint_source(src, _SHARD_FILE)
+    assert _codes(fs) == ["PTL802", "PTL802"]
+    assert "rank-dependent value 'rank'" in fs[0].message
+    assert "data-dependent host read .item()" in fs[1].message
+
+
+def test_ptl802_uniform_patterns_stay_clean():
+    src = ("def f(x, g, world_size):\n"
+           "    if g.in_spmd_scope():\n"          # uniform dispatch
+           "        dist.all_reduce(x)\n"
+           "    for i in range(world_size):\n"    # uniform trip count
+           "        dist.broadcast(x, src=i)\n"
+           "    if g.nranks > 1:\n"               # plural: uniform
+           "        dist.barrier()\n"
+           "    if rank_fn():\n"
+           "        y = parser.reduce(x)\n")      # not a collective base
+    assert lint_source(src, _SHARD_FILE) == []
+
+
+# ---------------------------------------------------------------------------
+# PTL803 — donation aliasing
+# ---------------------------------------------------------------------------
+
+def test_ptl803_stale_read_fires():
+    src = ("def train(state, batch):\n"
+           "    step = jax.jit(body, donate_argnums=(0,))\n"
+           "    new_state = step(state, batch)\n"
+           "    return state.loss\n")             # donated buffer read
+    fs = lint_source(src, _SHARD_FILE)
+    assert _codes(fs) == ["PTL803"]
+    assert "donated to step()" in fs[0].message
+
+
+def test_ptl803_two_consumer_alias_fires():
+    src = ("def train(state):\n"
+           "    step = jax.jit(body, donate_argnums=(0,))\n"
+           "    out = step(state, state)\n")      # one buffer, two params
+    fs = lint_source(src, _SHARD_FILE)
+    assert _codes(fs) == ["PTL803"]
+    assert "donated position 0" in fs[0].message
+
+
+def test_ptl803_kwargs_dict_form_tracked():
+    src = ("def train(state, batch):\n"
+           '    kw = {"donate_argnums": (0,)}\n'
+           "    step = jax.jit(body, **kw)\n"
+           "    out = step(state, batch)\n"
+           "    return state\n")
+    assert _codes(lint_source(src, _SHARD_FILE)) == ["PTL803"]
+
+
+def test_ptl803_rebind_is_sanctioned():
+    src = ("def train(state, batch):\n"
+           "    step = jax.jit(body, donate_argnums=(0,))\n"
+           "    for _ in range(3):\n"
+           "        state = step(state, batch)\n"  # rebind: sanctioned
+           "    return state.loss\n"
+           "def plain(state, batch):\n"
+           "    step = jax.jit(body)\n"            # no donation at all
+           "    out = step(state, batch)\n"
+           "    return state.loss\n")
+    assert lint_source(src, _SHARD_FILE) == []
+
+
+# ---------------------------------------------------------------------------
+# PTL804 — DistributedStrategy knob coverage
+# ---------------------------------------------------------------------------
+
+def test_ptl804_unmapped_knob_fires():
+    src = ("class DistributedStrategy:\n"
+           "    def __init__(self):\n"
+           "        self.amp = False\n"
+           "        self.totally_new_knob = False\n")
+    fs = lint_source(src, _STRATEGY_FILE)
+    assert _codes(fs) == ["PTL804"]
+    assert "totally_new_knob" in fs[0].message
+
+
+def test_ptl804_real_strategy_surface_is_covered():
+    """The REAL strategy file must map every boolean knob — and the
+    handler table must not have drifted the other way either."""
+    path = os.path.join(_REPO, *_STRATEGY_FILE.split("/"))
+    with open(path, "r", encoding="utf-8") as fh:
+        fs = lint_source(fh.read(), path)
+    assert [f for f in fs if f.code == "PTL804"] == [], \
+        "\n".join(f.render() for f in fs)
+    # every handler entry uses the documented grammar
+    for knob, handler in STRATEGY_KNOB_HANDLERS.items():
+        assert handler.split(":")[0] in ("pass", "layout", "flag",
+                                         "parity"), (knob, handler)
+
+
+def test_ptl804_unregistered_pass_name_fires(tmp_path):
+    """A pass: mapping pointing at a pass no register_pass call
+    registers is drift — proven against a real on-disk layout."""
+    base = tmp_path / "distributed" / "fleet" / "base"
+    base.mkdir(parents=True)
+    passes = tmp_path / "distributed" / "passes"
+    passes.mkdir()
+    (passes / "p.py").write_text('@register_pass("auto_parallel_amp")\n'
+                                 "class A: pass\n")
+    strat = base / "distributed_strategy.py"
+    strat.write_text("class DistributedStrategy:\n"
+                     "    def __init__(self):\n"
+                     "        self.amp = False\n"       # registered: ok
+                     "        self.sharding = False\n")  # not registered
+    fs = lint_source(strat.read_text(), str(strat))
+    assert _codes(fs) == ["PTL804"]
+    assert "auto_parallel_sharding" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sanitizer_on():
+    paddle.set_flags({"FLAGS_collective_sanitizer": True})
+    reset_sanitizer()
+    yield get_sanitizer()
+    paddle.set_flags({"FLAGS_collective_sanitizer": False})
+    reset_sanitizer()
+
+
+def test_flag_gates_sanitizer():
+    paddle.set_flags({"FLAGS_collective_sanitizer": False})
+    reset_sanitizer()
+    assert get_sanitizer() is None
+    # collectives run unrecorded with the flag off
+    t = paddle.to_tensor(np.ones((8, 2), np.float32))
+    dist.all_reduce(t)
+    assert get_sanitizer() is None
+
+
+def test_clean_collectives_pass(sanitizer_on):
+    t = paddle.to_tensor(np.ones((8, 2), np.float32))
+    dist.all_reduce(t)
+    dist.broadcast(t, src=0)
+    dist.all_gather(None, t)
+    san = get_sanitizer()
+    assert san is sanitizer_on
+    # every rank recorded the same three calls, all rows checked
+    streams = san._streams["default"]
+    assert len(streams) == 8          # conftest pins 8 virtual devices
+    assert all(len(s) == 3 for s in streams.values())
+    assert san._checked["default"] == 3
+
+
+def test_order_divergence_raises_with_both_streams(sanitizer_on):
+    san = sanitizer_on
+    n = 8
+    with pytest.raises(CollectiveMismatchError) as e:
+        for r in range(n - 1):
+            san.record("g", n, r, Fingerprint(0, "all_reduce", (4,),
+                                              "float32", "SUM", "g", n))
+        san.record("g", n, n - 1, Fingerprint(0, "all_gather", (4,),
+                                              "float32", "", "g", n))
+    err = e.value
+    assert err.rank_a == 0 and err.rank_b == n - 1
+    assert "all_reduce" in str(err) and "all_gather" in str(err)
+    assert err.stream_a and err.stream_b      # both streams attached
+
+
+def test_shape_dtype_reduceop_divergence_each_raise():
+    base = Fingerprint(0, "all_reduce", (4, 2), "float32", "SUM", "g", 2)
+    for bad in (Fingerprint(0, "all_reduce", (2, 2), "float32", "SUM",
+                            "g", 2),
+                Fingerprint(0, "all_reduce", (4, 2), "bfloat16", "SUM",
+                            "g", 2),
+                Fingerprint(0, "all_reduce", (4, 2), "float32", "MAX",
+                            "g", 2)):
+        san = CollectiveSanitizer()
+        san.record("g", 2, 0, base)
+        with pytest.raises(CollectiveMismatchError):
+            san.record("g", 2, 1, bad)
+        assert not base.agrees_with(bad)
+
+
+def test_divisibility_precheck():
+    san = CollectiveSanitizer()
+    with pytest.raises(ValueError, match="not divisible"):
+        san.observe("reduce_scatter", "g", nranks=8, shape=(9, 2),
+                    dtype="float32", reduce_op="SUM", spmd=True)
+    # eager (non-spmd) global arrays are exempt
+    san.observe("reduce_scatter", "g", nranks=8, shape=(9, 2),
+                dtype="float32", reduce_op="SUM", spmd=False)
+
+
+def test_mismatch_emits_event(tmp_path, sanitizer_on):
+    from paddle_tpu.observability.events import read_events
+    paddle.set_flags({"FLAGS_observability_dir": str(tmp_path)})
+    try:
+        san = sanitizer_on
+        san.record("g", 2, 0, Fingerprint(0, "all_reduce", (4,),
+                                          "float32", "SUM", "g", 2))
+        with pytest.raises(CollectiveMismatchError):
+            san.record("g", 2, 1, Fingerprint(0, "barrier", (),
+                                              "", "", "g", 2))
+    finally:
+        paddle.set_flags({"FLAGS_observability_dir": ""})
+    recs = read_events(str(tmp_path), kinds=["collective_mismatch"])
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["op"] == "all_reduce" and rec["rank_b"] == 1
+    assert "all_reduce" in rec["fingerprint_a"]
+    assert "barrier" in rec["fingerprint_b"]
+
+
+def test_spmd_collectives_fingerprint_under_shard_map(sanitizer_on):
+    """The compiled multi-chip path records fingerprints too — the
+    entry hook runs host-side at trace time, before dispatch."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.communication.group import (
+        axis_group, _reset_groups)
+    from paddle_tpu.distributed.mesh import build_mesh, reset_mesh, set_mesh
+    reset_mesh()
+    _reset_groups()
+    try:
+        mesh = build_mesh({"dp": 2, "mp": 4})
+        set_mesh(mesh)
+        g = axis_group("mp", mesh)
+
+        def per_rank(x):
+            t = paddle.Tensor(x)
+            dist.all_reduce(t, group=g)
+            return t.value
+
+        if hasattr(jax, "shard_map"):
+            smap, kw = jax.shard_map, {"check_vma": False}
+        else:
+            from jax.experimental.shard_map import shard_map as smap
+            kw = {"check_rep": False}
+        xs = np.arange(16, dtype=np.float32).reshape(8, 2)
+        out = jax.jit(smap(
+            per_rank, mesh=mesh, in_specs=P("mp", None),
+            out_specs=P("mp", None), **kw))(xs)
+        assert np.isfinite(np.asarray(out)).all()
+        san = get_sanitizer()
+        assert san is not None and san._streams  # recorded under trace
+    finally:
+        reset_mesh()
+        _reset_groups()

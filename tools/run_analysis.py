@@ -27,6 +27,19 @@ scriptable twin of `pytest -m lint` for environments without pytest:
                                                  # observability event-
                                                  # schema pass (PTL502)
     python tools/run_analysis.py --json     # machine-readable output
+    python tools/run_analysis.py --changed-only  # lint only files in
+                                                 # the git diff (plus
+                                                 # untracked .py); the
+                                                 # import-heavy whole-
+                                                 # repo passes are
+                                                 # skipped.  CI keeps
+                                                 # full runs.
+    python tools/run_analysis.py --changed-only --diff-base origin/main
+
+The lint pass also includes the PTL8xx SPMD/collective consistency
+rules (analysis/shardcheck.py: PartitionSpec arity vs the mesh,
+rank-divergent collective order, donation aliasing, DistributedStrategy
+knob coverage) over the distributed layer.
 
 The cost-model pass (PTL301) runs paddle_tpu.tuning.cost_model
 .sanity_check(); the metrics-schema pass (PTL502) validates every
@@ -50,6 +63,28 @@ sys.path.insert(0, _REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _changed_files(repo: str, base: str = "HEAD") -> list:
+    """Python files changed vs ``base`` plus untracked ones — the
+    incremental lint surface.  Deleted files are filtered (nothing to
+    lint); a git failure raises so --changed-only never silently lints
+    nothing."""
+    import subprocess
+    out = subprocess.run(
+        ["git", "diff", "--name-only", base, "--"],
+        cwd=repo, capture_output=True, text=True, check=True).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=repo, capture_output=True, text=True, check=True).stdout
+    files = []
+    for rel in sorted(set(out.splitlines()) | set(untracked.splitlines())):
+        if not rel.endswith(".py"):
+            continue
+        p = os.path.join(repo, rel)
+        if os.path.isfile(p):
+            files.append(p)
+    return files
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--no-registry", action="store_true",
@@ -70,6 +105,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-pass-verify", action="store_true",
                     help="skip the program-pass replay-equivalence "
                          "verification (PTL601; imports jax)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only .py files changed vs --diff-base "
+                         "(plus untracked); skips the import-heavy "
+                         "whole-repo passes (registry, cost/perf "
+                         "model, event schema, pass verify) — the "
+                         "fast pre-commit gate.  CI keeps full runs.")
+    ap.add_argument("--diff-base", default="HEAD", metavar="REF",
+                    help="git ref --changed-only diffs against "
+                         "(default HEAD)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("paths", nargs="*",
                     help="override the default lint targets")
@@ -78,8 +122,24 @@ def main(argv=None) -> int:
     from paddle_tpu.analysis.lint import lint_paths
     from paddle_tpu.analysis.cli import findings_to_json
 
-    targets = args.paths or [os.path.join(_REPO, d)
-                             for d in ("paddle_tpu", "examples", "tools")]
+    if args.changed_only:
+        # incremental mode: the changed-file list IS the target set,
+        # and the whole-repo passes (which cannot be diff-scoped and
+        # import the framework) are off unless explicitly requested
+        targets = _changed_files(_REPO, args.diff_base)
+        args.no_registry = True
+        args.no_cost_model = True
+        args.no_perf_model = True
+        args.no_pass_verify = True
+        if not args.metrics_schema:
+            args.no_metrics_schema = True
+        if not targets:
+            print("analysis: --changed-only found no changed .py files")
+            return 0
+    else:
+        targets = args.paths or [os.path.join(_REPO, d)
+                                 for d in ("paddle_tpu", "examples",
+                                           "tools")]
     findings = lint_paths(targets)
     if not args.no_registry:
         from paddle_tpu.analysis.registry_check import check_registry
