@@ -346,12 +346,20 @@ def _measure_serving(on_tpu):
     streams (the paddle_tpu.serving acceptance metric — the engine
     must beat the sequential baseline >= 2x at >= 8 streams on the
     CPU smoke config).  Latency quantiles come straight from the
-    engine's registry histograms."""
+    engine's registry histograms.
+
+    The engine side runs TWICE — single-step (FLAGS_serving_fused_steps
+    = 1) and fused persistent-program windows — with the dispatch-stream
+    ``serving_host_sync`` markers counted per run, so
+    ``host_syncs_per_100_tokens`` and ``steps_per_dispatch`` report the
+    fused win as a measured number."""
     import threading
 
     import numpy as np
     import paddle_tpu as paddle
+    from paddle_tpu.core.dispatch import observe_op_stream
     from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.flags import get_flags, set_flags
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
     from paddle_tpu.serving import ServingEngine
     from paddle_tpu.serving.engine import _REQ_LATENCY, _TTFT
@@ -363,6 +371,7 @@ def _measure_serving(on_tpu):
     model = GPTForPretraining(cfg)
     model.eval()
     n_streams, prompt_len, n_new = 8, 16, 16
+    fused_steps = 8
     rs = np.random.RandomState(0)
     prompts = [rs.randint(0, 512, (prompt_len,)).tolist()
                for _ in range(n_streams)]
@@ -378,44 +387,82 @@ def _measure_serving(on_tpu):
     seq_s = time.perf_counter() - t0
     seq_tps = n_streams * n_new / seq_s
 
-    engine = ServingEngine(model, max_batch=n_streams, page_size=16,
-                           prefix_caching=False)
-    with engine:
-        # warm the prefill + decode program buckets outside the timing
-        engine.submit(prompts[0], max_new_tokens=2).wait(timeout=120)
-        lat_before = _REQ_LATENCY.labels(engine=engine.engine_id) \
-            .hist.count
-        t0 = time.perf_counter()
-        reqs = []
+    def _engine_run(n_fused):
+        """One timed engine pass at FLAGS_serving_fused_steps=n_fused;
+        host syncs + iterations counted off the dispatch stream."""
+        marks = {"syncs": 0, "steps": 0}
 
-        def _one(p):
-            reqs.append(engine.submit(p, max_new_tokens=n_new))
+        def _hook(ev):
+            if ev.op_name == "serving_host_sync":
+                marks["syncs"] += 1
+                marks["steps"] += int(ev.in_avals[0][0][0])
 
-        threads = [threading.Thread(target=_one, args=(p,))
-                   for p in prompts]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        for r in list(reqs):
-            r.wait(timeout=300)
-        eng_s = time.perf_counter() - t0
-        lat = _REQ_LATENCY.labels(engine=engine.engine_id).hist
-        ttft = _TTFT.labels(engine=engine.engine_id).hist
-        stats = engine.stats()
-    eng_tps = n_streams * n_new / eng_s
+        keep = get_flags(["FLAGS_serving_fused_steps"])
+        set_flags({"FLAGS_serving_fused_steps": n_fused})
+        try:
+            engine = ServingEngine(model, max_batch=n_streams,
+                                   page_size=16, prefix_caching=False)
+            with engine:
+                # warm the prefill + decode (+ fused window) programs
+                # outside the timing
+                engine.submit(prompts[0],
+                              max_new_tokens=4).wait(timeout=120)
+                lat_before = _REQ_LATENCY.labels(
+                    engine=engine.engine_id).hist.count
+                with observe_op_stream(_hook):
+                    t0 = time.perf_counter()
+                    reqs = []
+
+                    def _one(p):
+                        reqs.append(engine.submit(p,
+                                                  max_new_tokens=n_new))
+
+                    threads = [threading.Thread(target=_one, args=(p,))
+                               for p in prompts]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    for r in list(reqs):
+                        r.wait(timeout=300)
+                    eng_s = time.perf_counter() - t0
+                lat = _REQ_LATENCY.labels(engine=engine.engine_id).hist
+                ttft = _TTFT.labels(engine=engine.engine_id).hist
+                stats = engine.stats()
+        finally:
+            set_flags(keep)
+        total = n_streams * n_new
+        return {
+            "tokens_per_sec": round(total / eng_s, 2),
+            "steps_per_sec": round(marks["steps"] / eng_s, 2),
+            "host_syncs": marks["syncs"],
+            "host_syncs_per_100_tokens": round(
+                100.0 * marks["syncs"] / total, 2),
+            "steps_per_dispatch": round(
+                marks["steps"] / max(marks["syncs"], 1), 2),
+            "request_latency": lat.summary(),
+            "ttft": ttft.summary(),
+            "timed_requests": lat.count - lat_before,
+            "engine_stats": stats,
+        }
+
+    single = _engine_run(1)
+    fused = _engine_run(fused_steps)
+    eng_tps = single["tokens_per_sec"]
     return {
         "model": "gpt-4l-h128", "streams": n_streams,
         "prompt_len": prompt_len, "new_tokens": n_new,
         "sequential_tokens_per_sec": round(seq_tps, 2),
-        "engine_tokens_per_sec": round(eng_tps, 2),
+        "engine_tokens_per_sec": eng_tps,
         "speedup": round(eng_tps / seq_tps, 3),
-        # registry-histogram snapshot (counts include the warm request;
-        # quantiles are dominated by the timed batch)
-        "request_latency": lat.summary(),
-        "ttft": ttft.summary(),
-        "timed_requests": lat.count - lat_before,
-        "engine_stats": stats,
+        # the persistent-program serving step, before/after: same
+        # traffic, FLAGS_serving_fused_steps=1 vs =8
+        "single_step": single,
+        "fused": dict(fused, fused_steps_flag=fused_steps),
+        "fused_speedup": round(
+            fused["tokens_per_sec"] / max(eng_tps, 1e-9), 3),
+        "host_sync_reduction": round(
+            single["host_syncs"] / max(fused["host_syncs"], 1), 2),
     }
 
 

@@ -76,14 +76,24 @@ TIMING_GLOBS = (
 )
 
 # continuous-batching serving files (PTL701 scope): step-loop code
-# paths (functions named *step*/*loop*) must not read device values
-# back to the host — every sync serializes the whole batch pipeline
-# per token.  The ONE sanctioned read is the per-iteration admission
+# paths (functions named *step*/*loop*/*fused*/*window*) must not read
+# device values back to the host — every sync serializes the whole
+# batch pipeline per token.  The ONE sanctioned read is the per-window
 # boundary (a reasoned noqa)
 SERVING_GLOBS = (
     "*/serving/scheduler.py",
     "*/serving/engine.py",
 )
+SERVING_HOT_NAMES = ("step", "loop", "fused", "window")
+
+# the fused-window builders live next to generate() in
+# models/generation.py — only the compiled-window code paths
+# (*fused*/*window* names) are PTL701-hot there; generate()'s eager
+# loop legitimately syncs at its hoisted stop checks
+GENERATION_GLOBS = (
+    "*/models/generation.py",
+)
+GENERATION_HOT_NAMES = ("fused", "window")
 
 # program-pass files (PTL602 scope): graph passes must build new
 # _OpRecords, never mutate the shared ones in place
@@ -636,16 +646,19 @@ _BOOL_CASTS = {"bool", "int", "float"}
 
 class _ServingStepHygiene(ast.NodeVisitor):
     """PTL701: host syncs inside serving step-loop code paths, scoped
-    to SERVING_GLOBS.  Active only inside functions whose name contains
-    ``step`` or ``loop`` (the per-iteration hot path): flags
+    to SERVING_GLOBS (hot names ``step``/``loop``/``fused``/``window``)
+    and to the fused-window builders in models/generation.py (hot
+    names ``fused``/``window``): flags
     ``.item()``/``.numpy()``/``.tolist()``/``.block_until_ready()``,
     ``np.asarray``/``np.array``/``jax.device_get`` calls, and
     ``finished.all()``-style reads steering an ``if``/``while`` or a
-    bool/int/float cast.  The single per-iteration admission-boundary
-    read carries a reasoned noqa."""
+    bool/int/float cast.  The single per-window boundary read carries
+    a reasoned noqa."""
 
-    def __init__(self, filename: str):
+    def __init__(self, filename: str,
+                 hot_names: Tuple[str, ...] = SERVING_HOT_NAMES):
         self.filename = filename
+        self.hot_names = tuple(hot_names)
         self.findings: List[Finding] = []
         self._depth = 0
         self._seen: Set[Tuple[int, int]] = set()
@@ -664,7 +677,7 @@ class _ServingStepHygiene(ast.NodeVisitor):
 
     def _visit_func(self, node):
         name = node.name.lower()
-        hot = "step" in name or "loop" in name
+        hot = any(k in name for k in self.hot_names)
         self._depth += 1 if hot else 0
         for child in node.body:
             self.visit(child)
@@ -718,6 +731,11 @@ class _ServingStepHygiene(ast.NodeVisitor):
 def is_serving_path(path: str) -> bool:
     p = path.replace(os.sep, "/")
     return any(fnmatch.fnmatch(p, g) for g in SERVING_GLOBS)
+
+
+def is_generation_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in GENERATION_GLOBS)
 
 
 # jnp/np array constructors whose default dtype follows the x64 flag
@@ -888,6 +906,11 @@ def lint_source(source: str, filename: str = "<string>",
         serving = _ServingStepHygiene(filename)
         serving.visit(tree)
         findings.extend(serving.findings)
+    if is_generation_path(filename):
+        gen = _ServingStepHygiene(filename,
+                                  hot_names=GENERATION_HOT_NAMES)
+        gen.visit(tree)
+        findings.extend(gen.findings)
     if is_shard_path(filename):
         findings.extend(shard_findings_source(source, filename, tree=tree))
     if is_strategy_path(filename):

@@ -45,11 +45,14 @@ OP_CLASSES = ("matmul", "reduce", "gather_scatter", "collective",
               "control", "elementwise")
 
 # batch_step record fields that become features, in row order.
-# page_occupancy is optional on old logs (defaults 0.0); the rest are
+# page_occupancy and fused_steps are optional on old logs (a record
+# predating them was a single-step iteration on an unknown pool, so
+# fused_steps defaults 1.0 and page_occupancy 0.0); the rest are
 # required — a record missing one yields no sample.
 BATCH_STEP_FIELDS = ("batch", "prefill_seqs", "decode_seqs", "q_width",
                      "tokens", "queue_depth")
-_BATCH_STEP_OPTIONAL = ("page_occupancy",)
+_BATCH_STEP_OPTIONAL = ("page_occupancy", "fused_steps")
+_BATCH_STEP_DEFAULTS = {"page_occupancy": 0.0, "fused_steps": 1.0}
 
 STEP_CONTEXT_FIELDS = tuple(f"ops_{c}" for c in OP_CLASSES) + (
     "ops_total", "host_transfers", "graph_pass_removed")
@@ -76,7 +79,7 @@ def batch_step_features(rec: Dict[str, Any]) -> Optional[Dict[str, float]]:
         out[f] = v
     for f in _BATCH_STEP_OPTIONAL:
         v = _num(rec.get(f))
-        out[f] = v if v is not None else 0.0
+        out[f] = v if v is not None else _BATCH_STEP_DEFAULTS[f]
     return out
 
 

@@ -177,6 +177,16 @@ define_flag("serving_engine", False,
             "cache sharing, per-request token streaming.  Off: the "
             "endpoint answers 404 and only the npz /predict path "
             "serves")
+define_flag("serving_fused_steps", 1,
+            "serving engine: fuse up to N ragged batch iterations into "
+            "ONE jitted lax.while_loop dispatch (the persistent-program "
+            "serving step).  The compiled window keeps EOS/budget "
+            "tracking, page-append cursors and sampling keys on device "
+            "and exits early when a sequence finishes or page pressure "
+            "binds; the host sees one packed read per window.  1 (the "
+            "default) keeps the classic one-dispatch-per-step path; "
+            "prefill and eviction-pressured steps always run the "
+            "single-step path regardless")
 define_flag("eager_finished_sync_every", 8,
             "eager decode loop: poll finished.all() on the host only "
             "every K generated tokens (the exact eager stop point is "

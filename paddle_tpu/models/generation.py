@@ -42,7 +42,8 @@ from ..core.tensor import Tensor
 from ..flags import get_flag
 from ..random_state import default_generator
 
-__all__ = ["generate", "decode_loop", "build_ragged_decode_step"]
+__all__ = ["generate", "decode_loop", "build_ragged_decode_step",
+           "build_fused_window_step"]
 
 _GREEDY = ("greedy_search", "greedy")
 
@@ -570,6 +571,98 @@ def build_ragged_decode_step(model):
         f"{type(model).__name__}.build_decode_step() params carry "
         "neither a GPT ('blocks') nor a LLaMA ('layers') layout — "
         "build_ragged_decode_step has no adapter for it")
+
+
+def build_fused_window_step(model, max_window: int):
+    """Persistent-program serving step: fuse up to ``max_window``
+    ragged batch iterations into ONE compiled ``lax.while_loop``
+    dispatch (the serving-engine analogue of ``decode_loop``).
+
+    Returns ``(params, window)`` with::
+
+        window(params, tok [B], pools, kv_lens [B], live [B] bool,
+               tables [B, ppseq], temps [B], eos_ids [B], budgets [B],
+               key, n_steps)
+          -> (packed [B, max_window + 2] int32, pools', key')
+
+    ``kv_lens`` are the PRE-append lengths (tokens already in KV);
+    ``tok`` is each live lane's pending last-sampled token.  Every
+    iteration re-derives the page-append cursors on device
+    (``append_positions``), runs the family-generic ragged step at
+    Q=1, and samples EXACTLY like the engine's single-step program
+    (one ``jax.random.split`` per iteration, argmax/categorical
+    blend on temperature) so the RNG stream and the sampled tokens
+    match the one-dispatch-per-step path token for token.
+
+    The loop carries EOS/budget state on device and exits as soon as
+    ANY lane finishes (EOS sampled, or its remaining ``budgets`` hit) —
+    lane layout therefore never shifts mid-window and the host-side
+    scheduler sees exactly the states the single-step engine would
+    have seen at a boundary.  ``n_steps`` is a TRACED scalar (≤ the
+    static ``max_window``), so one compiled program serves every
+    window length the scheduler budgets.
+
+    The single host read per window is the ``packed`` array: columns
+    ``[:max_window]`` hold the per-lane sampled tokens (column ``j``
+    = iteration ``j``; only the first ``steps`` columns are live),
+    column ``[max_window]`` the finished mask, and column
+    ``[max_window + 1]`` the number of iterations actually run,
+    broadcast to every lane."""
+    from ..ops.pallas.ragged_paged_attention import append_positions
+
+    params, step = build_ragged_decode_step(model)
+
+    def fused_window(params, tok, pools, kv_lens, live, tables, temps,
+                     eos_ids, budgets, key, n_steps):
+        b = tok.shape[0]
+        page_size = pools[0][0].shape[2]
+        sink = pools[0][0].shape[1] - 1
+        buf0 = jnp.zeros((b, max_window), jnp.int32)
+        q_lens = live.astype(jnp.int32)                    # lane layout
+        t32 = temps.astype(jnp.float32)                    # is static
+        n_steps = jnp.asarray(n_steps, jnp.int32)          # per window
+
+        def cond(carry):
+            i, _, _, _, finished, _, _, _ = carry
+            return jnp.logical_and(i < n_steps,
+                                   jnp.logical_not(jnp.any(finished)))
+
+        def body(carry):
+            i, tok, pools, kv, finished, key, buf, ngen = carry
+            page_ids, slots = append_positions(kv, tables, live,
+                                               page_size, sink)
+            kv_next = kv + q_lens
+            logits, pools = step(params, tok[:, None], kv[:, None],
+                                 pools, page_ids[:, None],
+                                 slots[:, None], kv_next, q_lens,
+                                 tables)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            key, sub = jax.random.split(key)
+            scaled = logits.astype(jnp.float32) \
+                / jnp.maximum(t32, jnp.float32(1e-6))[:, None]
+            sampled = jax.random.categorical(sub, scaled, axis=-1) \
+                .astype(jnp.int32)
+            nxt = jnp.where(t32 > jnp.float32(0.0), sampled, greedy)
+            buf = jax.lax.dynamic_update_slice(
+                buf, nxt[:, None], (jnp.int32(0), i))
+            ngen = ngen + q_lens
+            finished = finished | (live & ((nxt == eos_ids)
+                                           | (ngen >= budgets)))
+            tok = jnp.where(live, nxt, jnp.int32(0))
+            return (i + jnp.int32(1), tok, pools, kv_next, finished,
+                    key, buf, ngen)
+
+        init = (jnp.int32(0), tok.astype(jnp.int32), pools,
+                kv_lens.astype(jnp.int32), jnp.zeros((b,), bool), key,
+                buf0, jnp.zeros((b,), jnp.int32))
+        i, _, pools, _, finished, key, buf, _ = jax.lax.while_loop(
+            cond, body, init)
+        packed = jnp.concatenate(
+            [buf, finished.astype(jnp.int32)[:, None],
+             jnp.broadcast_to(i, (b,))[:, None]], axis=1)
+        return packed, pools, key
+
+    return params, fused_window
 
 
 def decode_loop(model, input_ids, **kwargs):

@@ -360,6 +360,13 @@ class LlamaForCausalLM(nn.Layer):
         from .generation import build_ragged_decode_step
         return build_ragged_decode_step(self)
 
+    def build_fused_window_step(self, max_window: int):
+        """Persistent-program serving window: up to ``max_window``
+        ragged batch iterations in one compiled ``lax.while_loop``.
+        See models.generation.build_fused_window_step."""
+        from .generation import build_fused_window_step
+        return build_fused_window_step(self, max_window)
+
 
 def _build_llama_decode_step(model: "LlamaForCausalLM"):
     from ..ops.pallas import fused_decode as _fd

@@ -113,7 +113,8 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
                    "decode_seqs": "int", "q_width": "int",
                    "tokens": "int", "queue_depth": "int",
                    "step_s": "float", "page_occupancy": "float",
-                   "cold_start": "bool"},
+                   "cold_start": "bool", "fused_steps": "int",
+                   "exit_reason": "str"},
     # learned performance model lifecycle (tuning.learned): a versioned
     # model file was fitted/saved from accumulated telemetry
     "perf_model": {"action": "str", "version": "int", "heads": "object",

@@ -56,7 +56,24 @@ from jax.experimental.pallas import tpu as pltpu
 from ...flags import get_flag
 
 __all__ = ["ragged_paged_attention", "ragged_paged_attention_ref",
-           "available"]
+           "append_positions", "available"]
+
+
+def append_positions(kv_lens, tables, live, page_size, sink):
+    """On-device page-append cursors for ONE decode token per lane:
+    where lane ``b``'s next k/v row lands given its current ``kv_lens
+    [B]`` and ``tables [B, ppseq]``.  Returns ``(page_ids [B], slots
+    [B])`` int32; lanes with ``live`` False target the ``sink`` page at
+    slot 0 (written, never read back — the engine's padding-lane
+    contract).  Pure jnp so the fused serving window can re-derive the
+    cursors inside its compiled loop body instead of reading them from
+    the host every iteration."""
+    kv = kv_lens.astype(jnp.int32)
+    lanes = jnp.arange(kv.shape[0], dtype=jnp.int32)
+    ps = jnp.int32(page_size)
+    page_ids = jnp.where(live, tables[lanes, kv // ps], jnp.int32(sink))
+    slots = jnp.where(live, kv % ps, jnp.int32(0))
+    return page_ids, slots
 
 
 def available() -> bool:

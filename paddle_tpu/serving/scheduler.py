@@ -217,6 +217,34 @@ class StepPlan:
             setattr(self, k, v)
 
 
+class _Prestage:
+    """The double-buffered plan half-step: admission work computed
+    WHILE the device runs a fused window, against the projected
+    post-window state (every live lane + ``window`` decode tokens, no
+    finishes, no page churn).  ``matches`` decides at the next
+    boundary whether the projection held — a finish, an eviction or a
+    queue-head change invalidates it and the staged work is
+    discarded."""
+
+    __slots__ = ("running_ids", "head_id", "free_pages", "queue_depth",
+                 "prediction")
+
+    def __init__(self, running_ids, head_id, free_pages, queue_depth,
+                 prediction):
+        self.running_ids = running_ids
+        self.head_id = head_id
+        self.free_pages = free_pages
+        self.queue_depth = queue_depth
+        self.prediction = prediction   # (req_id, predicted_cost_s)
+
+    def matches(self, sched: "Scheduler") -> bool:
+        if tuple(s.req.id for s in sched.running) != self.running_ids:
+            return False
+        if not sched.waiting or sched.waiting[0].req.id != self.head_id:
+            return False
+        return sched.pool.available() == self.free_pages
+
+
 class Scheduler:
     """Plans one ragged step per call; owns admission, page
     accounting, eviction and completion.  Thread-compatible: the
@@ -251,6 +279,14 @@ class Scheduler:
         self.waiting: deque = deque()
         self.running: List[_Sequence] = []
         self.evictions = 0
+        # double-buffered plan (fused serving windows): admission
+        # decisions pre-staged against the projected post-window state
+        # while the device runs, committed or discarded at the boundary
+        self._prestage: Optional[_Prestage] = None
+        self._staged_pred = None
+        self.prestaged_plans = 0
+        self.prestage_commits = 0
+        self.prestage_discards = 0
 
     # -- queue side ------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -309,16 +345,26 @@ class Scheduler:
             n = min(n, self.max_prefill_chunk)
         return n
 
-    def _predicted_admit_cost(self, seq: _Sequence) -> Optional[float]:
+    def _predicted_admit_cost(self, seq: _Sequence,
+                              projected_decode: bool = False
+                              ) -> Optional[float]:
         """The learned model's batch-step seconds for the NEXT
         iteration with ``seq`` admitted on top of the running batch
         (the same feature vector ``batch_step`` events log).  None when
         the model can't answer — admission then falls back to the raw
-        caps; a model error must never wedge the queue."""
+        caps; a model error must never wedge the queue.
+
+        ``projected_decode`` evaluates the POST-window projection the
+        fused path pre-stages against: every running lane a one-token
+        decode (the state a full fused window leaves behind)."""
         chunk = self._chunk_len(seq)
-        chunks = [self._chunk_len(s) for s in self.running]
-        decode = sum(1 for s in self.running
-                     if s.kv_len >= len(s.req.prompt))
+        if projected_decode:
+            chunks = [1 for _ in self.running]
+            decode = len(self.running)
+        else:
+            chunks = [self._chunk_len(s) for s in self.running]
+            decode = sum(1 for s in self.running
+                         if s.kv_len >= len(s.req.prompt))
         feats = {
             "batch": float(len(self.running) + 1),
             "prefill_seqs": float(len(self.running) - decode + 1),
@@ -329,6 +375,8 @@ class Scheduler:
             "page_occupancy": round(
                 1.0 - self.pool.available()
                 / max(self.pool.num_pages - 1, 1), 4),
+            # the step being priced is a single-step admission boundary
+            "fused_steps": 1.0,
         }
         try:
             return self.perf_model.predict("batch_step", feats)
@@ -341,7 +389,14 @@ class Scheduler:
             return None
         seq = self.waiting[0]
         if self.perf_model is not None and self.max_step_cost_s > 0:
-            pred = self._predicted_admit_cost(seq)
+            staged = self._staged_pred
+            if staged is not None and staged[0] == seq.req.id:
+                # double-buffered plan: the prediction was computed
+                # while the device ran the last fused window
+                pred = staged[1]
+                self._staged_pred = None
+            else:
+                pred = self._predicted_admit_cost(seq)
             seq.predicted_cost_s = pred
             if pred is not None and pred > self.max_step_cost_s \
                     and self.running:
@@ -416,6 +471,14 @@ class Scheduler:
         (evicting under pressure), and lay out the padded step arrays.
         Returns (plan, admitted, evicted) — plan is None when nothing
         is runnable."""
+        pre, self._prestage = self._prestage, None
+        self._staged_pred = None
+        if pre is not None:
+            if pre.matches(self):
+                self.prestage_commits += 1
+                self._staged_pred = pre.prediction
+            else:
+                self.prestage_discards += 1
         admitted: List[_Sequence] = []
         evicted: List[_Sequence] = []
         while True:
@@ -512,3 +575,82 @@ class Scheduler:
             if seq.req.done:
                 continue
             seq.kv_len = int(plan.kv_lens[i])
+
+    # -- fused serving windows (persistent-program step) -----------------
+    def window_budget(self, plan: StepPlan, max_steps: int):
+        """How many iterations the device may run on ``plan`` without
+        a host boundary: clamp ``max_steps`` to the tightest remaining
+        token budget (a lane hitting its budget finishes — the window
+        exits there anyway) and to what the page pool can host WITHOUT
+        eviction, then pre-allocate every page the window can touch
+        and refresh ``plan.tables`` so the compiled loop's on-device
+        append cursors stay in-bounds.  Returns ``(w, clamp_reason)``;
+        ``w == 1`` means the single-step path (with its eviction
+        machinery) should run instead — nothing was allocated."""
+        w = int(max_steps)
+        reason = "window_full"
+        rem = min(seq.req.max_new_tokens - len(seq.req.tokens)
+                  for seq in plan.seqs)
+        w = min(w, max(rem, 1))
+        avail = self.pool.available()
+        while w > 1 and sum(self._pages_needed(s, s.kv_len + w)
+                            for s in plan.seqs) > avail:
+            w -= 1
+            reason = "page_limit"
+        if w <= 1:
+            return 1, reason
+        for seq in plan.seqs:
+            if not self._grow(seq, seq.kv_len + w):
+                # the avail math above makes this unreachable; any
+                # pages already granted are owned and trimmed at the
+                # next commit, so bailing to single-step is safe
+                return 1, "page_limit"
+        for i, seq in enumerate(plan.seqs):
+            plan.tables[i, :len(seq.pages)] = seq.pages
+        return w, reason
+
+    def commit_window(self, plan: StepPlan, steps: int) -> None:
+        """Commit a fused window's outcome: every surviving lane ran
+        exactly ``steps`` decode iterations (the loop exits on the
+        FIRST finish, so lanes never diverge mid-window).  Pages the
+        clamped window reserved but never wrote are returned to the
+        pool."""
+        for seq in plan.seqs:
+            if seq.req.done:
+                continue
+            seq.kv_len += int(steps)
+            self._trim_pages(seq)
+
+    def _trim_pages(self, seq: _Sequence) -> None:
+        """Drop owned pages past what ``kv_len`` occupies (window
+        over-allocation after an early exit).  Trailing pages are
+        never prefix-cache-shared — shared pages cover only the prompt
+        prefix — so a plain unref is enough."""
+        keep = -(-seq.kv_len // self.pool.page_size)
+        while len(seq.pages) > max(keep, 1):
+            self.pool.unref(seq.pages.pop())
+
+    def prestage_plan(self, plan: StepPlan, window: int) -> None:
+        """Double-buffered plan: called right after a fused window is
+        DISPATCHED (device busy, host free) — run the expensive
+        admission work for the next boundary against the projected
+        post-window state: all plan lanes decoding, window pages
+        already charged to the pool, queue unchanged.  ``plan_step``
+        commits the staged work when the window exits exactly as
+        projected (full run, no finishes) and discards it otherwise."""
+        if not self.waiting:
+            self._prestage = None
+            return
+        self.prestaged_plans += 1
+        head = self.waiting[0]
+        prediction = None
+        if self.perf_model is not None and self.max_step_cost_s > 0:
+            pred = self._predicted_admit_cost(head,
+                                              projected_decode=True)
+            prediction = (head.req.id, pred)
+        self._prestage = _Prestage(
+            running_ids=tuple(s.req.id for s in self.running),
+            head_id=head.req.id,
+            free_pages=self.pool.available(),
+            queue_depth=len(self.waiting),
+            prediction=prediction)
