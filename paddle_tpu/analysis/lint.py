@@ -58,11 +58,14 @@ SURFACE_EXEMPT = ("*/tensor/op_registry.py", "*/ops/pallas/autotune.py")
 
 # resilience-critical files (PTL401 exception-hygiene scope): a
 # swallow-and-continue handler here turns a torn checkpoint / dead
-# worker / failed predict into silent wrong behavior
+# worker / failed predict into silent wrong behavior — and in the
+# fleet tier, a router/health-poll handler that silently eats a
+# replica failure routes traffic into a corpse
 RESILIENCE_GLOBS = (
     "*/resilience/*.py",
     "*/distributed/checkpoint/*.py",
     "*/inference/*.py",
+    "*/serving/fleet/*.py",
 )
 
 # instrumented subsystems (PTL501 raw-timing scope): timings reported
@@ -83,6 +86,7 @@ TIMING_GLOBS = (
 SERVING_GLOBS = (
     "*/serving/scheduler.py",
     "*/serving/engine.py",
+    "*/serving/fleet/*.py",
 )
 SERVING_HOT_NAMES = ("step", "loop", "fused", "window")
 

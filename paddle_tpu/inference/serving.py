@@ -444,6 +444,11 @@ def serve(model_prefix: str, host: str = "127.0.0.1", port: int = 0,
                            max_in_flight=max_in_flight).start()
 
 
+# retry-backoff sleep, routed through one module hook so tests can
+# observe the exact delays the clients choose (incl. Retry-After)
+_retry_sleep = time.sleep
+
+
 def _retriable_http(exc: BaseException) -> bool:
     """Retry overload shedding (503) and connection resets — the two
     failure modes a resilient deployment produces on purpose (load
@@ -460,6 +465,26 @@ def _retriable_http(exc: BaseException) -> bool:
                           (ConnectionResetError, ConnectionRefusedError,
                            ConnectionAbortedError, BrokenPipeError))
     return False
+
+
+def _retry_after_delay(exc: BaseException) -> Optional[float]:
+    """Server-directed backoff: a 503's ``Retry-After`` header
+    (delta-seconds form) overrides the client's fixed schedule — the
+    server (or the fleet router, while draining a replica) knows when
+    capacity returns; guessing earlier just re-sheds the load.
+    HTTP-date form and absent/garbled headers fall back to the
+    schedule (None)."""
+    import urllib.error
+    if not (isinstance(exc, urllib.error.HTTPError)
+            and exc.code == 503):
+        return None
+    val = exc.headers.get("Retry-After") if exc.headers else None
+    if val is None:
+        return None
+    try:
+        return max(float(val), 0.0)
+    except ValueError:
+        return None
 
 
 def predict_http(url: str, *inputs: np.ndarray, timeout: float = 30.0,
@@ -484,7 +509,9 @@ def predict_http(url: str, *inputs: np.ndarray, timeout: float = 30.0,
     return with_retries(_once, attempts=max(1, int(retries)),
                         retry_on=_retriable_http,
                         base_delay=retry_backoff, max_delay=2.0,
-                        label="predict_http")
+                        label="predict_http",
+                        sleep=lambda d: _retry_sleep(d),
+                        delay_from=_retry_after_delay)
 
 
 def generate_http(url: str, input_ids, max_new_tokens: int = 32,
@@ -495,8 +522,9 @@ def generate_http(url: str, input_ids, max_new_tokens: int = 32,
     """Streaming client for the engine-mode ``POST /generate`` route:
     a generator yielding token ids as the server's batch iterations
     land.  Connection establishment (incl. the 503 overload answer)
-    retries with the shared backoff; once the stream starts, a
-    truncated response (no ``done`` line) raises.
+    retries with the shared backoff — honoring a 503's ``Retry-After``
+    header as the exact delay when the server sends one; once the
+    stream starts, a truncated response (no ``done`` line) raises.
 
     A W3C ``traceparent`` header always rides the request: the one
     given, else the ambient tracing context, else a fresh trace — so
@@ -521,10 +549,14 @@ def generate_http(url: str, input_ids, max_new_tokens: int = 32,
             headers={_tracing.TRACEPARENT_HEADER: traceparent})
         return urllib.request.urlopen(req, timeout=timeout)
 
+    # a 503's Retry-After (the router sets it while draining) beats
+    # the fixed schedule — see _retry_after_delay
     resp = with_retries(_connect, attempts=max(1, int(retries)),
                         retry_on=_retriable_http,
                         base_delay=retry_backoff, max_delay=2.0,
-                        label="generate_http")
+                        label="generate_http",
+                        sleep=lambda d: _retry_sleep(d),
+                        delay_from=_retry_after_delay)
     with resp:
         done = False
         for line in resp:

@@ -139,6 +139,21 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
     # shared span (e.g. one ragged batch iteration) served
     "trace_span": {"name": "str", "status": "str", "start_ts": "float",
                    "attrs": "object", "links": "object"},
+    # fleet router placement (serving.fleet.router): one routing
+    # decision — which replica got the request and why (affinity pages
+    # matched, merged-perf-model cost estimate, queue depth at
+    # placement); resubmitted marks a failover leg after a replica
+    # died mid-stream (generated-so-far tokens kept)
+    "router_route": {"request": "str", "replica": "str",
+                     "affinity_pages": "int",
+                     "predicted_cost_s": "float",
+                     "queue_depth": "int", "resubmitted": "bool",
+                     "candidates": "int"},
+    # the replica supervisor (serving.fleet.replica) relaunched (or
+    # gave up on / rolling-restarted) one engine process
+    "replica_restart": {"replica": "str", "reason": "str",
+                        "restarts": "int", "code": "int",
+                        "url": "str"},
     # the collective sanitizer (distributed.communication.sanitizer)
     # caught two ranks disagreeing on a collective fingerprint —
     # emitted BEFORE the raise so the watchdog and flight recorder see

@@ -39,11 +39,20 @@ from ..observability import tracing as obs_tracing
 from .faults import STATE_FILE_ENV, maybe_fault
 
 __all__ = ["ResilientTrainLoop", "RunReport", "run_resilient",
-           "CKPT_DIR_ENV"]
+           "restart_backoff", "CKPT_DIR_ENV"]
 
 # the supervisor exports the checkpoint dir to workers under this name
 # so one script serves both standalone and supervised runs
 CKPT_DIR_ENV = "PADDLE_RESILIENT_CKPT_DIR"
+
+
+def restart_backoff(restarts: int, base_delay: float,
+                    max_delay: float) -> float:
+    """Deterministic exponential backoff before the ``restarts``-th
+    relaunch — shared by :func:`run_resilient` and the fleet replica
+    supervisor (``serving.fleet.replica``) so chaos runs reproduce."""
+    return min(float(max_delay),
+               float(base_delay) * (2 ** (max(int(restarts), 1) - 1)))
 
 
 # ---------------------------------------------------------------------------
@@ -363,9 +372,9 @@ def run_resilient(script: str, script_args: Optional[Sequence[str]] = None,
                             restarts=report.restarts,
                             code=int(code or 1))
             # deterministic exponential backoff — reproducible chaos runs
-            time.sleep(min(max_backoff_s,
-                           restart_backoff_s
-                           * (2 ** (report.restarts - 1))))
+            time.sleep(restart_backoff(report.restarts,
+                                       restart_backoff_s,
+                                       max_backoff_s))
     finally:
         if in_main and prev_handler is not None:
             signal.signal(signal.SIGTERM, prev_handler)

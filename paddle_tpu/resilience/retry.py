@@ -48,7 +48,10 @@ def with_retries(fn: Callable[[], Any], *,
                  label: str = "",
                  sleep: Callable[[float], None] = time.sleep,
                  on_retry: Optional[Callable[[int, BaseException, float],
-                                             None]] = None) -> Any:
+                                             None]] = None,
+                 delay_from: Optional[Callable[[BaseException],
+                                               Optional[float]]] = None
+                 ) -> Any:
     """Call ``fn()`` up to ``attempts`` times.
 
     * ``retry_on`` — an exception type / tuple, or a predicate
@@ -58,6 +61,10 @@ def with_retries(fn: Callable[[], Any], *,
     * backoff — ``base_delay * 2**(attempt-1)`` capped at ``max_delay``,
       scaled by ``1 + jitter * deterministic_jitter(label, seed,
       attempt)``: exponential, bounded, reproducible.
+    * ``delay_from`` — server-directed backoff: when it returns a
+      number for the caught exception (e.g. a 503's ``Retry-After``
+      header), that exact delay replaces the schedule for this attempt
+      (no cap, no jitter — the server's word beats the client's guess).
     * ``sleep`` / ``on_retry`` — injectable for tests and for callers
       that want to log each retry.
     """
@@ -69,9 +76,13 @@ def with_retries(fn: Callable[[], Any], *,
         except Exception as e:
             if attempt >= attempts or not _matches(e, retry_on):
                 raise
-            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
-            delay *= 1.0 + jitter * deterministic_jitter(
-                label, seed, attempt)
+            directed = delay_from(e) if delay_from is not None else None
+            if directed is not None:
+                delay = max(float(directed), 0.0)
+            else:
+                delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+                delay *= 1.0 + jitter * deterministic_jitter(
+                    label, seed, attempt)
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(delay)

@@ -1,0 +1,27 @@
+"""``paddle_tpu.serving.fleet`` — the multi-replica serving tier.
+
+One :class:`~paddle_tpu.serving.ServingEngine` process is the
+single-process ceiling; this package multiplies it:
+
+* :mod:`~paddle_tpu.serving.fleet.replica` — a supervisor launching
+  and monitoring N engine processes (restart cap, deterministic
+  backoff, SIGTERM-grace, drain-aware rolling restarts);
+* :mod:`~paddle_tpu.serving.fleet.router` — an HTTP front-end with
+  the same ``POST /generate`` NDJSON contract, placing each request
+  by prefix-cache affinity → least predicted cost (merged perf
+  model) → least queue depth, resubmitting mid-stream work from a
+  dead replica to a survivor with generated-so-far tokens kept;
+* :mod:`~paddle_tpu.serving.fleet.perf_merge` — the sample-weighted
+  merge of per-replica ``perf_model.json`` files that makes the
+  learned performance model fleet-wide.
+
+``python -m paddle_tpu.serving.fleet --replicas 2`` runs a live demo;
+the same entry with ``--worker`` is the per-replica process the
+supervisor launches.
+"""
+from .perf_merge import merge_heads, merge_models, save_merged
+from .replica import ReplicaHandle, ReplicaSupervisor
+from .router import FleetRouter
+
+__all__ = ["FleetRouter", "ReplicaHandle", "ReplicaSupervisor",
+           "merge_heads", "merge_models", "save_merged"]

@@ -30,7 +30,7 @@ import hashlib
 import itertools
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "chained_page_keys"]
 
 
 def _default_hash(prev_key: str, tokens: Tuple[int, ...]) -> str:
@@ -38,6 +38,22 @@ def _default_hash(prev_key: str, tokens: Tuple[int, ...]) -> str:
     h.update(prev_key.encode())
     h.update(",".join(str(t) for t in tokens).encode())
     return h.hexdigest()
+
+
+def chained_page_keys(prompt: Sequence[int], page_size: int,
+                      hash_fn: Optional[Callable] = None):
+    """Yield ``(key, page_tokens)`` for each FULL page of ``prompt``
+    with the chained content hash (each page's key folds in the
+    previous page's).  This IS the cache identity — shared with the
+    fleet router, whose affinity placement routes a prompt to the
+    replica whose cache owns these exact keys."""
+    hash_fn = hash_fn or _default_hash
+    ps = int(page_size)
+    key = ""
+    for i in range(len(prompt) // ps):
+        chunk = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+        key = hash_fn(key, chunk)
+        yield key, chunk
 
 
 class _Entry:
@@ -71,12 +87,7 @@ class PrefixCache:
 
     def _keys_for(self, prompt: Sequence[int]):
         """Yield (key, page_tokens) for each FULL page of the prompt."""
-        ps = self.page_size
-        key = ""
-        for i in range(len(prompt) // ps):
-            chunk = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
-            key = self._hash(key, chunk)
-            yield key, chunk
+        return chained_page_keys(prompt, self.page_size, self._hash)
 
     # -- lookup ----------------------------------------------------------
     def match(self, prompt: Sequence[int]) -> List[int]:

@@ -11,6 +11,8 @@
     python -m paddle_tpu.tuning fit    [--dir DIR] [--json]
                                        [--from-events OBS_DIR ...]
                                        [--min-samples N]
+    python -m paddle_tpu.tuning merge  MODEL_JSON ... [--out PATH]
+                                       [--json]
 
 ``warm`` writes cost-model (analytic) block picks so a cold process
 resolves ``flash_blocks`` from disk without ever timing; ``fit``
@@ -22,13 +24,18 @@ the ``coefficients`` kind.  With ``--from-events <obs-dir>``
 event logs under each dir (``batch_step`` durations, ``step``
 telemetry with dispatch/graph-pass context) and persists it as the
 versioned ``perf_model.json`` the autotuner, Engine.tune, the serving
-scheduler, and the divergence watchdog consult.  ``--dir`` overrides
-FLAGS_tuning_cache_dir.
+scheduler, and the divergence watchdog consult.  ``merge`` folds
+several replicas' ``perf_model.json`` files into one fleet-wide model
+(``serving.fleet.perf_merge``: sample-count-weighted head average,
+version = max input + 1, atomic write) — what the fleet router
+consumes, usable standalone for offline fleet logs.  ``--dir``
+overrides FLAGS_tuning_cache_dir.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -181,6 +188,35 @@ def cmd_fit(args) -> int:
     return 0
 
 
+def cmd_merge(args) -> int:
+    # stdlib-only path through serving.fleet.perf_merge: usable on a
+    # machine that only has the JSON files (offline fleet logs)
+    from ..serving.fleet import perf_merge
+    try:
+        models = perf_merge.load_models(args.models)
+    except (OSError, ValueError, KeyError) as e:
+        sys.stderr.write(f"merge: {type(e).__name__}: {e}\n")
+        return 2
+    merged = perf_merge.merge_models(models)
+    out_path = args.out
+    if not out_path:
+        from . import learned
+        cache = _open_cache(args)
+        out_path = learned.model_path(cache.directory)
+    perf_merge.save_merged(merged, out_path)
+    summary = {
+        "out": os.path.abspath(out_path),
+        "version": merged.version,
+        "sources": len(models),
+        "source_versions": [m.version for m in models],
+        "heads": {fam: head.stats.get("n_samples", 0)
+                  for fam, head in sorted(merged.heads.items())},
+    }
+    print(json.dumps(summary, indent=2 if args.json else None,
+                     sort_keys=True))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m paddle_tpu.tuning",
                                  description=__doc__)
@@ -214,9 +250,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--min-samples", type=int, default=8,
                    help="per-family sample floor below which a learned "
                         "head is skipped (default 8)")
+    p = sub.add_parser("merge", help="merge per-replica "
+                                     "perf_model.json files (sample-"
+                                     "count-weighted head average, "
+                                     "version bump, atomic write)")
+    p.add_argument("models", nargs="+", metavar="MODEL_JSON",
+                   help="two or more perf_model.json files (one per "
+                        "replica / run)")
+    p.add_argument("--out", default="",
+                   help="output path (default: perf_model.json in "
+                        "the cache dir)")
+    p.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     return {"stats": cmd_stats, "dump": cmd_dump, "prune": cmd_prune,
-            "warm": cmd_warm, "fit": cmd_fit}[args.cmd](args)
+            "warm": cmd_warm, "fit": cmd_fit,
+            "merge": cmd_merge}[args.cmd](args)
 
 
 if __name__ == "__main__":
