@@ -66,6 +66,11 @@ RESILIENCE_GLOBS = (
     "*/distributed/checkpoint/*.py",
     "*/inference/*.py",
     "*/serving/fleet/*.py",
+    # the engine's fault-containment layer (quarantine bisection,
+    # watchdog relaunch, deadline cancellation): a swallowed failure
+    # here silently truncates client streams
+    "*/serving/engine.py",
+    "*/serving/scheduler.py",
 )
 
 # instrumented subsystems (PTL501 raw-timing scope): timings reported
@@ -88,7 +93,11 @@ SERVING_GLOBS = (
     "*/serving/engine.py",
     "*/serving/fleet/*.py",
 )
-SERVING_HOT_NAMES = ("step", "loop", "fused", "window")
+SERVING_HOT_NAMES = ("step", "loop", "fused", "window",
+                     # fault-containment paths run INSIDE the
+                     # iteration loop's cadence — a host sync there
+                     # stalls recovery exactly when latency matters
+                     "watchdog", "quarantine", "recover")
 
 # the fused-window builders live next to generate() in
 # models/generation.py — only the compiled-window code paths

@@ -269,9 +269,10 @@ def _apply_fault_schedule(text: str):
 define_flag("fault_schedule", "",
             "deterministic fault-injection schedule "
             "'point@N=kind[:arg];...' over the named fault points "
-            "(step, ckpt_write, collective, compile); kinds: crash, "
-            "exit, stall, exc, truncate, corrupt.  Empty: disabled. "
-            "See paddle_tpu.resilience.faults",
+            "(step, ckpt_write, collective, compile, serving_step); "
+            "kinds: crash, exit, stall, exc, truncate, corrupt, nan "
+            "(nan: serving_step only — on-device NaN-logits poison). "
+            "Empty: disabled.  See paddle_tpu.resilience.faults",
             on_change=_apply_fault_schedule)
 # read lazily by distributed.communication.sanitizer.get_sanitizer()
 # on each collective entry — deliberately no on_change hook (the
@@ -320,6 +321,16 @@ define_flag("learned_perf_model", True,
             "zero timing runs on a cold cache.  False forces "
             "measurement; no model file falls back to measurement "
             "either way")
+define_flag("serving_step_timeout_s", 0.0,
+            "serving engine hung-step watchdog (seconds): >0 bounds "
+            "every device dispatch (single step or fused window); on "
+            "expiry the watchdog dumps the flight recorder, emits a "
+            "step_timeout event, abandons the wedged loop thread "
+            "(fresh device pools + page pool) and resumes every "
+            "running stream via requeue-at-front — token-exact under "
+            "deterministic decode, no stream silently truncated.  "
+            "0 (default): disabled",
+            )
 define_flag("serving_predicted_admission", 0.0,
             "per-iteration batch-step cost budget (seconds) for "
             "serving admission: >0 admits new prefills only while the "

@@ -12,7 +12,12 @@ The wire format is npz both ways (dense arrays, zero deps):
 - ``POST /generate`` — continuous-batching LLM serving (engine mode,
   behind ``FLAGS_serving_engine`` with a ``paddle_tpu.serving.
   ServingEngine`` attached): JSON request ``{"input_ids": [...],
-  "max_new_tokens", "eos_token_id", "temperature", "stream"}``;
+  "max_new_tokens", "eos_token_id", "temperature", "stream",
+  "deadline_s"}`` — a ``deadline_s`` the predicted-cost admission says
+  cannot be met answers **503** up front, and one that expires
+  mid-decode cancels the request (pages freed immediately); a client
+  that disconnects mid-stream is detected at the next token write and
+  cancelled the same way;
   streaming responses are newline-delimited JSON — one
   ``{"token": id}`` line per generated token as the batch iterations
   land, closed by ``{"done": true, "tokens": [...]}``.  Streaming
@@ -231,6 +236,8 @@ class InferenceServer:
                           float(spec.get("temperature", 0.0))}
                     if spec.get("eos_token_id") is not None:
                         kw["eos_token_id"] = int(spec["eos_token_id"])
+                    if spec.get("deadline_s") is not None:
+                        kw["deadline_s"] = float(spec["deadline_s"])
                 except Exception as e:  # noqa: PTL401, BLE001 —
                     # answered to the client as HTTP 400
                     outer._c_bad.inc()
@@ -250,8 +257,21 @@ class InferenceServer:
                 tp_headers = () if tp is None else \
                     ((_tracing.TRACEPARENT_HEADER, tp),)
                 if req.done and req.error:
-                    # rejected at admission (too long, queue full):
-                    # still the request's shape, not our failure
+                    kind = getattr(req, "error_kind", None)
+                    if kind in ("deadline", "unhealthy"):
+                        # capacity/health shaped: the request is fine,
+                        # the engine can't serve it NOW — 503 so a
+                        # retrying client (or the fleet router's
+                        # failover legs) tries elsewhere/later
+                        outer._c_rejected.inc()
+                        self._reply(503, json.dumps(
+                            {"error": req.error}).encode(),
+                            extra_headers=tp_headers
+                            + (("Retry-After", "1"),))
+                        return
+                    # rejected at admission (too long, queue full,
+                    # quarantined prompt): still the request's shape,
+                    # not our failure
                     outer._c_bad.inc()
                     self._reply(400, json.dumps(
                         {"error": req.error}).encode(),
@@ -286,9 +306,19 @@ class InferenceServer:
                 self.end_headers()
                 try:
                     for tok in req.stream(timeout=outer.stream_timeout):
-                        self.wfile.write(json.dumps(
-                            {"token": int(tok)}).encode() + b"\n")
-                        self.wfile.flush()
+                        try:
+                            self.wfile.write(json.dumps(
+                                {"token": int(tok)}).encode() + b"\n")
+                            self.wfile.flush()
+                        except OSError:
+                            # client-disconnect detection: the socket
+                            # died mid-stream — cancel NOW so the
+                            # engine frees the pages and batch slot
+                            # instead of decoding for a ghost
+                            outer._c_errors.inc()
+                            req.cancel("client disconnected "
+                                       "mid-stream")
+                            return
                     self.wfile.write(json.dumps(
                         {"done": True, "tokens": req.tokens,
                          "request_id": req.id}).encode() + b"\n")

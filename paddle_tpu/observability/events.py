@@ -154,6 +154,29 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
     "replica_restart": {"replica": "str", "reason": "str",
                         "restarts": "int", "code": "int",
                         "url": "str"},
+    # fault containment (serving.engine): a poisoned request was
+    # isolated by bisection / the NaN-logits sentinel and quarantined
+    # (action="quarantined"), or a repeat offender was rejected at
+    # admission by prompt hash (action="rejected")
+    "quarantine": {"request": "str", "reason": "str",
+                   "prompt_hash": "str", "action": "str",
+                   "batch": "int"},
+    # the hung-step watchdog expired a device dispatch: flight recorder
+    # dumped, loop thread abandoned (epoch bumped), survivors requeued
+    # at the queue front for token-exact resume
+    "step_timeout": {"engine": "str", "age_s": "float",
+                     "timeout_s": "float", "batch": "int",
+                     "relaunches": "int"},
+    # a request was cancelled mid-flight (client disconnect, stream/
+    # wait consumer timeout, deadline expiry) — pages and batch slot
+    # freed immediately
+    "request_cancelled": {"request": "str", "reason": "str",
+                          "n_tokens": "int", "deadline_s": "float"},
+    # the engine health state machine moved (ok -> degraded ->
+    # quarantining -> failed and back); the value is exported as the
+    # paddle_serving_engine_health gauge the fleet router consumes
+    "health_transition": {"engine": "str", "previous": "str",
+                          "state": "str", "reason": "str"},
     # the collective sanitizer (distributed.communication.sanitizer)
     # caught two ranks disagreeing on a collective fingerprint —
     # emitted BEFORE the raise so the watchdog and flight recorder see

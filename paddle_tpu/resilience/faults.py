@@ -17,6 +17,8 @@ schedule is installed):
                 manifest is written (the torn-checkpoint window)
 ``collective``  entry of ``distributed.all_reduce`` (host side)
 ``compile``     a ``jit.TrainStep`` jit-cache miss, before ``jax.jit``
+``serving_step`` top of each serving-engine device dispatch (single
+                step AND fused window — ``serving.engine``)
 ========== ============================================================
 
 Schedule syntax (``FLAGS_fault_schedule`` / the env var of the same
@@ -42,6 +44,18 @@ which the fault fires.  Kinds:
   flips one rank's dtype) — the cross-rank divergence the
   ``FLAGS_collective_sanitizer`` cross-check must surface as a raised
   ``collective_mismatch`` instead of a hang
+* ``nan``            — only at ``serving_step``: poison one request's
+  logits with NaN on device, so the engine's NaN-logits sentinel (not
+  the host) must attribute and quarantine the offender
+
+``serving_step`` faults are STICKY poisons for the ``exc`` and ``nan``
+kinds: firing queues a poison directive (``take_serving_poison``) that
+the engine pins to one member request of the in-flight plan, and every
+subsequent batch containing that request fails the same way — which is
+what makes quarantine-by-bisection converge on the offender
+deterministically.  ``stall`` (and ``crash``/``exit``) execute directly
+at the dispatch, exactly once: a stalled dispatch is the hung-step
+watchdog's target, and recovery must not re-stall.
 
 Cross-relaunch semantics: occurrence counters are per-process (each
 relaunch counts from 1 again), but when ``PADDLE_FAULT_STATE_FILE`` is
@@ -68,10 +82,11 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = ["FaultSpec", "FaultInjector", "InjectedFault", "POINTS",
            "KINDS", "parse_schedule", "install_schedule", "get_injector",
            "maybe_fault", "queue_collective_damage",
-           "take_collective_damage"]
+           "take_collective_damage", "queue_serving_poison",
+           "take_serving_poison"]
 
-POINTS = ("step", "ckpt_write", "collective", "compile")
-KINDS = ("crash", "exit", "stall", "exc", "truncate", "corrupt")
+POINTS = ("step", "ckpt_write", "collective", "compile", "serving_step")
+KINDS = ("crash", "exit", "stall", "exc", "truncate", "corrupt", "nan")
 
 STATE_FILE_ENV = "PADDLE_FAULT_STATE_FILE"
 
@@ -130,6 +145,10 @@ def parse_schedule(text: str) -> List[FaultSpec]:
             raise ValueError(
                 f"{kind!r} only applies to the ckpt_write and "
                 f"collective points ({item!r})")
+        if kind == "nan" and point != "serving_step":
+            raise ValueError(
+                f"'nan' only applies to the serving_step point "
+                f"({item!r})")
         specs.append(FaultSpec(point, occ, kind, m["arg"]))
     return specs
 
@@ -260,6 +279,15 @@ class FaultInjector:
             self._execute(spec, path)
 
     def _execute(self, spec: FaultSpec, path: Optional[str]) -> None:
+        if spec.point == "serving_step" and spec.kind in ("exc", "nan"):
+            # sticky poison: the engine pins this to ONE member request
+            # of the in-flight plan and re-fails every batch containing
+            # it — the determinism quarantine-by-bisection relies on.
+            # (stall falls through to the direct sleep below: a hung
+            # dispatch is the watchdog's target and must not re-stall
+            # after recovery.)
+            queue_serving_poison(spec.kind, spec.arg)
+            return
         if spec.kind in ("crash", "exit"):
             # the process never returns from these: dump the flight
             # recorder FIRST so the post-mortem ring survives (SIGKILL
@@ -320,6 +348,27 @@ def take_collective_damage() -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# serving-step poison (exc/nan at the serving_step point)
+# ---------------------------------------------------------------------------
+
+# pending (kind, arg) poison directives queued by _execute for the
+# serving engine; bounded like the collective queue so an unconsumed
+# directive (engine stopped) cannot grow
+_SERVING_POISON: List[Tuple[str, Optional[str]]] = []
+_SERVING_POISON_CAP = 8
+
+
+def queue_serving_poison(kind: str, arg: Optional[str] = None) -> None:
+    if len(_SERVING_POISON) < _SERVING_POISON_CAP:
+        _SERVING_POISON.append((kind, arg))
+
+
+def take_serving_poison() -> Optional[Tuple[str, Optional[str]]]:
+    """Pop the oldest queued serving poison ``(kind, arg)``, or None."""
+    return _SERVING_POISON.pop(0) if _SERVING_POISON else None
+
+
+# ---------------------------------------------------------------------------
 # flag-bound singleton (FLAGS_fault_schedule installs it)
 # ---------------------------------------------------------------------------
 
@@ -333,6 +382,7 @@ def install_schedule(text: Optional[str]) -> Optional[FaultInjector]:
     global _INSTALLED
     specs = parse_schedule(text) if text else []
     _COLLECTIVE_DAMAGE.clear()       # stale damage must not leak across
+    _SERVING_POISON.clear()          # schedules — both queues reset
     _INSTALLED = FaultInjector(specs) if specs else None
     return _INSTALLED
 

@@ -75,7 +75,14 @@ def main(argv=None) -> int:
             reqs = [engine.submit(p, max_new_tokens=args.max_new)
                     for p in prompts]
             for i, req in enumerate(reqs):
-                toks = req.wait(timeout=120)
+                try:
+                    toks = req.wait(timeout=120)
+                except (RuntimeError, TimeoutError) as e:
+                    # a quarantined / deadline-cancelled request fails
+                    # alone — the remaining streams still complete
+                    print(f"request {req.id}: prompt[{len(prompts[i])}] "
+                          f"-> FAILED ({req.error_kind}): {e}")
+                    continue
                 print(f"request {req.id}: prompt[{len(prompts[i])}] "
                       f"-> {toks}")
         print("engine stats:", engine.stats())

@@ -39,12 +39,44 @@ exports when the engine serves behind ``InferenceServer``
 (``core.dispatch.observe_op_stream``) carrying the REAL fed-token
 counts, so tests and the analyzer can prove prefix-cache sharing
 skips prefill work.
+
+Fault containment: co-batching couples failure domains — one poisoned
+request or one wedged dispatch would otherwise take down every
+in-flight stream.  Four interlocking pieces bound the blast radius:
+
+* **poison quarantine** — a failed ragged step is retried by
+  bisection over the batch's request ids (the eviction-resume
+  machinery makes re-running a chunk token-exact under greedy
+  decode); innocents complete unchanged, the isolated offender fails
+  alone with a ``quarantine`` event, and its prompt hash is rejected
+  at admission from then on;
+* **hung-step watchdog** — ``FLAGS_serving_step_timeout_s`` bounds
+  every device dispatch; on expiry the flight recorder dumps, the
+  iteration loop relaunches under a new epoch with fresh device pools
+  and every survivor requeued at the FRONT (no stream is silently
+  truncated);
+* **deadlines + cancellation** — ``deadline_s`` requests are swept
+  every iteration and cancelled mid-batch (pages and the slot free
+  immediately); predicted-cost admission 503s doomed requests up
+  front;
+* **health state machine** — ``ok → degraded → quarantining →
+  failed`` rides ``health_transition`` events and the
+  ``paddle_serving_engine_health`` gauge, so the fleet router drains
+  a sick replica before its supervisor must restart it.
+
+Chaos hooks: ``FLAGS_fault_schedule`` ``serving_step@N=exc|stall|nan``
+(resilience.faults) makes each path provable — ``nan`` rides an
+on-device NaN-logits sentinel (a poisoned lane's sampled token
+collapses to -1 inside the jitted program, so detection costs no
+extra host read).
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
 import time
+import warnings
 from typing import Optional, Sequence
 
 import numpy as np
@@ -54,6 +86,7 @@ __all__ = ["ServingEngine"]
 from ..observability import events as _events
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..resilience import faults as _faults
 from .prefix_cache import PrefixCache
 from .scheduler import PagePool, Request, Scheduler
 
@@ -89,6 +122,35 @@ _DISPATCHES = _metrics.counter(
     "paddle_serving_engine_dispatches_total",
     "jitted program launches (a fused window is ONE dispatch covering "
     "fused_steps iterations)", labels=("engine",))
+_HEALTH = _metrics.gauge(
+    "paddle_serving_engine_health",
+    "engine health state machine (0 ok / 1 degraded / 2 quarantining "
+    "/ 3 failed) — the fleet router consumes this to drain sick "
+    "replicas before their supervisor must restart them",
+    labels=("engine",))
+_QUARANTINED = _metrics.counter(
+    "paddle_serving_engine_quarantined_total",
+    "requests quarantined (poison isolation / NaN-logits sentinel)",
+    labels=("engine",))
+_CANCELLED = _metrics.counter(
+    "paddle_serving_engine_cancelled_total",
+    "requests cancelled (deadline, client disconnect, consumer "
+    "timeout)", labels=("engine",))
+_STEP_TIMEOUTS = _metrics.counter(
+    "paddle_serving_engine_step_timeouts_total",
+    "hung-step watchdog firings (each one dumps the flight recorder "
+    "and relaunches the iteration loop)", labels=("engine",))
+
+# the health ladder the gauge exports; "failed" is terminal for the
+# engine object (the fleet supervisor restarts the whole replica)
+_HEALTH_RANK = {"ok": 0, "degraded": 1, "quarantining": 2, "failed": 3}
+
+# extra watchdog budget for a dispatch that misses the program cache:
+# its wall time is dominated by trace+compile (minutes on a real TPU),
+# which must never be mistaken for a hung device.  A stall injected or
+# occurring during a cold dispatch is still caught — just this much
+# later.
+_COLD_DISPATCH_GRACE_S = 120.0
 
 _ENGINE_SEQ = itertools.count(1)
 
@@ -119,7 +181,9 @@ class ServingEngine:
                  max_queue: int = 1024, max_prefill_chunk: int = 0,
                  prefix_caching: bool = True, seed: int = 0,
                  dtype: str = "float32", perf_model="auto",
-                 max_step_cost_s: Optional[float] = None):
+                 max_step_cost_s: Optional[float] = None,
+                 health_recovery_steps: int = 64,
+                 max_watchdog_relaunches: int = 3):
         import jax
         import jax.numpy as jnp
         from ..flags import get_flag
@@ -144,6 +208,13 @@ class ServingEngine:
         self.pool = PagePool(num_pages, ps)
         self.prefix_cache = PrefixCache(self.pool) if prefix_caching \
             else None
+        # device-pool geometry, kept so a watchdog relaunch can build
+        # FRESH buffers (the wedged dispatch may still write into the
+        # old ones — they are abandoned wholesale, never reused)
+        self._nkv, self._hd, self._n_layers = nkv, hd, n_layers
+        self._num_pages, self._page_size = int(num_pages), ps
+        self._dtype = dtype
+        self._prefix_caching = bool(prefix_caching)
         # predicted-cost admission (FLAGS_serving_predicted_admission,
         # seconds): the scheduler admits prefills against the learned
         # model's predicted batch-step cost instead of raw caps alone.
@@ -187,29 +258,79 @@ class ServingEngine:
         self._c_evict = _EVICTIONS.labels(engine=eid)
         self._c_steps = _STEPS.labels(engine=eid)
         self._c_dispatch = _DISPATCHES.labels(engine=eid)
+        self._g_health = _HEALTH.labels(engine=eid)
+        self._g_health.set(0)
+        self._c_quarantined = _QUARANTINED.labels(engine=eid)
+        self._c_cancelled = _CANCELLED.labels(engine=eid)
+        self._c_step_timeout = _STEP_TIMEOUTS.labels(engine=eid)
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._accepting = False
+        # -- fault containment state (all under self._lock) --
+        # epoch fences the loop thread and in-flight dispatches: a
+        # watchdog relaunch bumps it, and any zombie thread that wakes
+        # later sees the mismatch and drops its result on the floor
+        self._epoch = 0
+        self._dispatch_t0: Optional[float] = None
+        self._dispatch_plan = None
+        # a dispatch that misses the program cache spends its time in
+        # trace+compile, not device execution — the watchdog grants it
+        # _COLD_DISPATCH_GRACE_S on top of the step budget so a slow
+        # compile (routine after a relaunch re-prefills into a new
+        # Q-bucket) is never mistaken for a hung device
+        self._dispatch_cold = False
+        self._step_timeout_s = 0.0
+        self._watchdog: Optional[threading.Thread] = None
+        self._relaunches = 0
+        self.max_watchdog_relaunches = int(max_watchdog_relaunches)
+        self.health = "ok"
+        self._clean_steps = 0
+        self.health_recovery_steps = int(health_recovery_steps)
+        # prompt_hash -> offence count: repeat offenders rejected at
+        # admission (the poison travels with the prompt, not the id)
+        self._quarantined: dict = {}
+        # request id -> (kind, arg): chaos-injected sticky poison
+        # pinned to a request so quarantine bisection is deterministic
+        self._poison: dict = {}
+        self._n_quarantined = 0
+        self._n_cancelled = 0
+        self._wedged_threads = 0
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingEngine":
+        from ..flags import get_flag
         with self._wake:
             if self._running:
                 return self
             self._running = True
             self._accepting = True
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+            self._step_timeout_s = float(
+                get_flag("serving_step_timeout_s") or 0.0)
+            epoch = self._epoch
+        self._thread = threading.Thread(target=self._loop, args=(epoch,),
+                                        daemon=True,
                                         name=f"serving-engine-"
                                              f"{self.engine_id}")
         self._thread.start()
+        if self._step_timeout_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                args=(self._step_timeout_s,), daemon=True,
+                name=f"serving-watchdog-{self.engine_id}")
+            self._watchdog.start()
         return self
 
-    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+    def stop(self, drain: bool = True, timeout: float = 30.0,
+             join_timeout: float = 5.0) -> dict:
         """Stop accepting requests; with ``drain`` finish every
         admitted/queued request first (bounded by ``timeout``), else
-        fail them fast."""
+        fail them fast.  Returns ``{"engine", "health", "wedged"}`` —
+        ``wedged=True`` means the loop thread failed to join within
+        ``join_timeout`` (a hung device dispatch survived shutdown);
+        the flight recorder is dumped and health goes ``failed`` so
+        the leak is loud instead of silent."""
         with self._wake:
             self._accepting = False
             self._wake.notify_all()
@@ -229,9 +350,29 @@ class ServingEngine:
             for seq in leftovers:
                 self.scheduler.finish(seq, error="engine stopped")
             self._wake.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        wedged = False
+        t = self._thread
+        if t is not None:
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                wedged = True
+                self._wedged_threads += 1
+                warnings.warn(
+                    f"serving engine {self.engine_id}: loop thread "
+                    f"failed to join within {join_timeout}s — a wedged "
+                    f"device dispatch is leaking a thread",
+                    stacklevel=2)
+                _tracing.dump_flight("serving_stop_wedged")
+                with self._lock:
+                    self._set_health("failed",
+                                     "loop thread wedged at stop")
             self._thread = None
+        wd = self._watchdog
+        if wd is not None:
+            wd.join(timeout=max(float(join_timeout), 1.0))
+            self._watchdog = None
+        return {"engine": self.engine_id, "health": self.health,
+                "wedged": wedged}
 
     def __enter__(self):
         return self.start()
@@ -244,16 +385,23 @@ class ServingEngine:
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0,
                request_id: Optional[str] = None,
-               trace=None) -> Request:
+               trace=None,
+               deadline_s: Optional[float] = None) -> Request:
         """Queue one generation request; returns the live handle.
         ``trace`` is an optional :class:`~..observability.tracing.
         TraceContext` to parent the request's root span on (the HTTP
         layer passes the client ``traceparent`` here); without it a
-        fresh trace roots at this request when tracing is enabled."""
+        fresh trace roots at this request when tracing is enabled.
+        ``deadline_s`` bounds the request end to end: it is 503'd up
+        front when predicted cost says it cannot finish in time, and
+        cancelled mid-batch (pages freed immediately) when the
+        deadline passes while it runs."""
         req = Request(input_ids, max_new_tokens=max_new_tokens,
                       eos_token_id=(self.default_eos if eos_token_id
                                     is None else eos_token_id),
-                      temperature=temperature, request_id=request_id)
+                      temperature=temperature, request_id=request_id,
+                      deadline_s=deadline_s)
+        req._cancel_cb = self._cancel_request
         root = _tracing.start_span(
             "serving_request", parent=trace,
             attrs={"request": req.id, "engine": self.engine_id,
@@ -265,7 +413,26 @@ class ServingEngine:
             req._queue_span = _tracing.start_span("queue", parent=root)
         with self._wake:
             if not self._accepting:
-                req._finish(error="engine is not accepting requests")
+                if self.health == "failed":
+                    req.error_kind = "unhealthy"
+                    req._finish(error="engine is unhealthy (failed)")
+                else:
+                    req._finish(error="engine is not accepting "
+                                      "requests")
+                return req
+            h = self._prompt_hash(req.prompt)
+            if h in self._quarantined:
+                # repeat offender: this exact prompt already poisoned
+                # a batch — reject at admission instead of letting it
+                # fail another co-scheduled batch
+                req.error_kind = "quarantined"
+                _events.emit("quarantine", request=req.id,
+                             reason="repeat offender (prompt hash "
+                                    "previously quarantined)",
+                             prompt_hash=h, action="rejected", batch=0)
+                req._finish(error=f"prompt quarantined after "
+                                  f"{self._quarantined[h]} prior "
+                                  f"failure(s) (hash {h})")
                 return req
             self.scheduler.submit(req)
             self._g_queue.set(self.scheduler.queue_depth())
@@ -277,12 +444,36 @@ class ServingEngine:
         return self.submit(input_ids, **kw).wait()
 
     # -- the iteration loop ----------------------------------------------
-    def _loop(self):
+    def _loop(self, epoch: int):
+        """One engine epoch of the iteration loop.  The watchdog bumps
+        ``self._epoch`` and launches a replacement thread when a
+        dispatch hangs; this wrapper also catches a loop-level crash
+        (a planning bug, not a step failure — those are contained
+        per-step) so the engine fails LOUDLY instead of leaving every
+        consumer blocked on a dead thread."""
+        try:
+            self._loop_body(epoch)
+        except Exception as e:  # noqa: BLE001 — last-resort
+            # containment: the loop thread dying silently would hang
+            # every consumer; report + fail everything + mark failed
+            warnings.warn(f"serving engine loop died: "
+                          f"{type(e).__name__}: {e}", stacklevel=1)
+            with self._wake:
+                if epoch != self._epoch:
+                    return          # a relaunch already superseded us
+                self._accepting = False
+                self._set_health("failed", f"loop thread died: "
+                                           f"{type(e).__name__}")
+                self._fail_all_locked(f"engine loop failed: "
+                                      f"{type(e).__name__}: {e}")
+
+    def _loop_body(self, epoch: int):
         from ..flags import get_flag
         while True:
             with self._wake:
-                if not self._running:
+                if not self._running or epoch != self._epoch:
                     return
+                self._sweep_deadlines_locked()
                 if not self.scheduler.has_work():
                     self._wake.wait(0.05)
                     continue
@@ -336,7 +527,10 @@ class ServingEngine:
                 # machinery — byte for byte
                 fused_w, fused_max, fused_reason = 1, 0, "single_step"
                 if plan is not None and plan.n_prefill == 0 \
-                        and plan.tok.shape[1] == 1:
+                        and plan.tok.shape[1] == 1 \
+                        and not self.scheduler.bisect_groups:
+                    # (a bisection episode pins the single-step path:
+                    # probe batches must fail one iteration at a time)
                     fused_max = int(get_flag("serving_fused_steps")
                                     or 1)
                     if fused_max > 1:
@@ -349,23 +543,55 @@ class ServingEngine:
                 # finishes) — yield briefly instead of spinning
                 time.sleep(0.005)
                 continue
+            with self._lock:
+                if epoch != self._epoch:
+                    return
+                # watchdog bracket: the dispatch about to start is
+                # bounded by FLAGS_serving_step_timeout_s from here
+                self._dispatch_t0 = time.monotonic()
+                self._dispatch_plan = plan
+                self._dispatch_cold = False
             try:
                 if fused_w > 1:
                     self._run_window(plan, fused_w, fused_max,
-                                     fused_reason)
+                                     fused_reason, epoch)
                 else:
-                    self._run_step(plan)
-            except Exception as e:  # noqa: BLE001 — a failed step must
-                # fail its requests loudly, not hang their consumers
-                import warnings
+                    self._run_step(plan, epoch)
+            except Exception as e:  # noqa: BLE001 — containment, not
+                # crash-out: the batch is retried by bisection and
+                # only the isolated offender fails
                 warnings.warn(f"serving step failed: "
                               f"{type(e).__name__}: {e}", stacklevel=1)
-                with self._wake:
-                    for seq in list(plan.seqs):
-                        self.scheduler.finish(
-                            seq, error=f"{type(e).__name__}: {e}")
+                self._contain_step_failure(plan, e, epoch)
+            finally:
+                with self._lock:
+                    if epoch == self._epoch:
+                        self._dispatch_t0 = None
+                        self._dispatch_plan = None
 
-    def _run_step(self, plan):
+    def _maybe_poison(self, plan):
+        """Chaos hook (``serving_step@N=exc|nan``): a fired fault pins
+        STICKY poison to the first request of the triggering batch, so
+        every retry containing it fails deterministically and the
+        quarantine bisection provably converges on it.  Returns the
+        lane index to NaN-poison on device, or None."""
+        _faults.maybe_fault("serving_step")
+        directive = _faults.take_serving_poison()
+        if directive is not None and plan.seqs:
+            self._poison[plan.seqs[0].req.id] = directive
+        lane = None
+        for i, seq in enumerate(plan.seqs):
+            d = self._poison.get(seq.req.id)
+            if d is None:
+                continue
+            if d[0] == "exc":
+                raise _faults.InjectedFault(
+                    f"injected serving_step poison "
+                    f"(request {seq.req.id})")
+            lane = i                      # kind "nan": poison on device
+        return lane
+
+    def _run_step(self, plan, epoch: int):
         # one SHARED step span for the whole ragged iteration, linked
         # from every member request's trace — each request's timeline
         # pulls its batch steps in through the links without owning
@@ -376,25 +602,38 @@ class ServingEngine:
                  for s in plan.seqs if s.req.trace is not None]
         with _tracing.trace_span("batch_step", links=links or None,
                                  attrs={"engine": self.engine_id}):
-            self._run_step_traced(plan)
+            self._run_step_traced(plan, epoch)
 
-    def _run_step_traced(self, plan):
+    def _run_step_traced(self, plan, epoch: int):
         from ..core.dispatch import _emit_op_event
+        # snapshot the device state FIRST: if this thread stalls and
+        # the watchdog relaunches around it, the zombie must keep
+        # writing into the ABANDONED buffers it captured here — never
+        # into the fresh epoch's pools (self._pools by then)
+        pools_in, key_in = self._pools, self._key
+        nan_lane = self._maybe_poison(plan)
         qw = _bucket(plan.tok.shape[1])
         n_progs = len(self._programs)
         prog = self._program(qw)
         cold_start = len(self._programs) > n_progs
+        if cold_start:
+            self._dispatch_cold = True   # grant the compile grace
         pad = qw - plan.tok.shape[1]
         tok = np.pad(plan.tok, ((0, 0), (0, pad)))
         pos = np.pad(plan.pos, ((0, 0), (0, pad)))
         page_ids = np.pad(plan.page_ids, ((0, 0), (0, pad)),
                           constant_values=self.pool.sink)
         slots = np.pad(plan.slots, ((0, 0), (0, pad)))
+        # chaos NaN injection rides a logits bias vector: 0 everywhere
+        # (jit-compiled no-op add) except the poisoned lane
+        poison = np.zeros((self.max_batch,), "float32")
+        if nan_lane is not None:
+            poison[nan_lane] = np.nan
         with self._h_step.time() as step_timer:
-            nxt, self._pools, self._key = prog(
-                self._params, tok, pos, self._pools, page_ids, slots,
+            nxt, pools, rng = prog(
+                self._params, tok, pos, pools_in, page_ids, slots,
                 plan.kv_lens, plan.q_lens, plan.tables, plan.temps,
-                self._key)
+                key_in, poison)
             # THE boundary sync: exactly one device read per window
             # (this path is the degenerate one-iteration window) —
             # admission, eviction and EOS all key off it
@@ -415,7 +654,21 @@ class ServingEngine:
         _emit_op_event("serving_host_sync",
                        [np.empty((1,), "int8")], [], True)
         with self._wake:
+            if epoch != self._epoch:
+                return    # watchdog relaunched mid-dispatch: zombie
+                          # result — the fresh epoch re-runs the work
+            self._pools, self._key = pools, rng
             self.scheduler.commit(plan)
+            group = plan.bisect_group
+            if group is not None:
+                # this probe batch ran clean: its members are proven
+                # innocent — retire the group and, once every group
+                # resolved, close the quarantine episode
+                self.scheduler.bisect_done(group)
+                if not self.scheduler.bisect_groups:
+                    self._end_quarantine_locked(
+                        "bisection episode resolved")
+            self._note_clean_step_locked()
             self._c_steps.inc()
             self._c_dispatch.inc()
             self._c_prefill.inc(plan.fed_prefill)
@@ -427,6 +680,15 @@ class ServingEngine:
                     continue        # chunked prefill still in flight
                 req = seq.req
                 tok_i = int(toks[i])
+                if tok_i < 0:
+                    # on-device NaN-logits sentinel tripped for this
+                    # lane (injected or genuine): quarantine it alone
+                    # — co-batched lanes never mix activations, so
+                    # the rest of the batch is sound
+                    self._quarantine_locked(
+                        seq, reason="nan_logits",
+                        batch=len(plan.seqs))
+                    continue
                 seq.tokens.append(tok_i)
                 req._emit(tok_i)
                 self._c_decode.inc()
@@ -463,7 +725,8 @@ class ServingEngine:
                              / max(self.pool.num_pages - 1, 1), 4),
                          fused_steps=1, exit_reason="single_step")
 
-    def _run_window(self, plan, w, max_window, clamp_reason):
+    def _run_window(self, plan, w, max_window, clamp_reason,
+                    epoch: int):
         """Fused serving window: up to ``w`` decode iterations in one
         compiled dispatch (same shared batch_step span contract as
         ``_run_step``)."""
@@ -473,14 +736,38 @@ class ServingEngine:
         with _tracing.trace_span("batch_step", links=links or None,
                                  attrs={"engine": self.engine_id,
                                         "fused": True}):
-            self._run_window_traced(plan, w, max_window, clamp_reason)
+            self._run_window_traced(plan, w, max_window, clamp_reason,
+                                    epoch)
 
-    def _run_window_traced(self, plan, w, max_window, clamp_reason):
+    def _run_window_traced(self, plan, w, max_window, clamp_reason,
+                           epoch: int):
         from ..core.dispatch import _emit_op_event
+        # snapshot the device state FIRST (see _run_step_traced): a
+        # zombie thread must only ever write into these captured,
+        # abandoned buffers after a watchdog relaunch
+        pools_in, key_in = self._pools, self._key
+        # the fused program has no poison vector input, so "nan"
+        # poison degrades to a pre-dispatch raise here — the failure
+        # still quarantines through the same bisection (which pins the
+        # single-step path, where the on-device sentinel takes over)
+        for i, seq in enumerate(plan.seqs):
+            if seq.req.id in self._poison:
+                raise _faults.InjectedFault(
+                    f"injected serving_step poison "
+                    f"(request {seq.req.id})")
+        _faults.maybe_fault("serving_step")
+        directive = _faults.take_serving_poison()
+        if directive is not None and plan.seqs:
+            self._poison[plan.seqs[0].req.id] = directive
+            raise _faults.InjectedFault(
+                f"injected serving_step poison "
+                f"(request {plan.seqs[0].req.id})")
         b = self.max_batch
         n_progs = len(self._programs)
         prog = self._window_program(max_window)
         cold_start = len(self._programs) > n_progs
+        if cold_start:
+            self._dispatch_cold = True   # grant the compile grace
         # PRE-append lengths: the committed KV, not the plan's
         # post-step kv_lens — the compiled loop owns the append cursor
         kv0 = (plan.kv_lens - plan.q_lens).astype("int32")
@@ -493,16 +780,17 @@ class ServingEngine:
             eos_ids[i] = -1 if eos is None else int(eos)
             budgets[i] = seq.req.max_new_tokens - len(seq.req.tokens)
         with self._h_step.time() as step_timer:
-            packed, self._pools, self._key = prog(
-                self._params, tok0, self._pools, kv0, live,
-                plan.tables, plan.temps, eos_ids, budgets, self._key,
+            packed, pools, rng = prog(
+                self._params, tok0, pools_in, kv0, live,
+                plan.tables, plan.temps, eos_ids, budgets, key_in,
                 np.int32(w))
             # double-buffered plan: the device is running the window —
             # pre-stage the next boundary's admission work NOW, while
             # the host is otherwise idle (async dispatch means the
             # blocking read below is where the wait happens)
             with self._wake:
-                self.scheduler.prestage_plan(plan, w)
+                if epoch == self._epoch:
+                    self.scheduler.prestage_plan(plan, w)
             # THE boundary sync: ONE packed device read per fused
             # window — tokens, finished mask and iteration count ride
             # a single int32 array
@@ -514,6 +802,10 @@ class ServingEngine:
         _emit_op_event("serving_host_sync",
                        [np.empty((steps,), "int8")], [], True)
         with self._wake:
+            if epoch != self._epoch:
+                return    # zombie window result after a relaunch
+            self._pools, self._key = pools, rng
+            self._note_clean_step_locked(steps)
             self.scheduler.commit_window(plan, steps)
             self._c_steps.inc(steps)
             self._c_dispatch.inc()
@@ -560,6 +852,225 @@ class ServingEngine:
                                  shared=seq.shared)
         seq.cache_inserted = True
 
+    # -- fault containment: quarantine bisection -------------------------
+    @staticmethod
+    def _prompt_hash(prompt) -> str:
+        return hashlib.sha256(
+            ",".join(map(str, prompt)).encode()).hexdigest()[:16]
+
+    def _contain_step_failure(self, plan, exc, epoch: int) -> None:
+        """A dispatch raised.  Nothing was committed (tokens only land
+        after the boundary read), so re-feeding the same chunks to the
+        same pages is idempotent — instead of failing the whole batch,
+        split its live members in half and probe each half as its own
+        restricted plan until the offender is alone."""
+        with self._wake:
+            if epoch != self._epoch:
+                return              # a relaunch already superseded us
+            self._clean_steps = 0
+            group = plan.bisect_group
+            if group is not None:
+                self.scheduler.bisect_done(group)
+            live = [s for s in plan.seqs
+                    if s in self.scheduler.running and not s.req.done]
+            if len(live) <= 1:
+                # isolated (or the batch emptied mid-flight): the
+                # offender fails ALONE; everyone else was or will be
+                # proven innocent by their own clean probe
+                for seq in live:
+                    self._quarantine_locked(
+                        seq,
+                        reason=f"step failure: "
+                               f"{type(exc).__name__}: {exc}",
+                        batch=len(plan.seqs))
+                if not self.scheduler.bisect_groups:
+                    self._end_quarantine_locked("offender isolated")
+                return
+            if self.health in ("ok", "degraded"):
+                self._set_health(
+                    "quarantining",
+                    f"step failed over {len(live)} requests "
+                    f"({type(exc).__name__}) — bisecting")
+            ids = [s.req.id for s in live]
+            mid = len(ids) // 2
+            self.scheduler.bisect_push_front([ids[:mid], ids[mid:]])
+
+    def _quarantine_locked(self, seq, reason: str, batch: int) -> None:
+        req = seq.req
+        h = self._prompt_hash(req.prompt)
+        self._quarantined[h] = self._quarantined.get(h, 0) + 1
+        self._poison.pop(req.id, None)
+        self._n_quarantined += 1
+        self._c_quarantined.inc()
+        _events.emit("quarantine", request=req.id, reason=reason,
+                     prompt_hash=h, action="quarantined", batch=batch)
+        req.error_kind = "quarantined"
+        self.scheduler.finish(
+            seq, error=f"request quarantined: {reason}")
+        self._g_occ.set(len(self.scheduler.running))
+
+    # -- fault containment: health state machine -------------------------
+    def _set_health(self, state: str, reason: str) -> None:
+        prev = self.health
+        if state == prev:
+            return
+        self.health = state
+        self._clean_steps = 0
+        self._g_health.set(_HEALTH_RANK[state])
+        _events.emit("health_transition", engine=self.engine_id,
+                     previous=prev, state=state, reason=reason)
+
+    def _end_quarantine_locked(self, reason: str) -> None:
+        if self.health == "quarantining":
+            self._set_health("degraded", reason)
+
+    def _note_clean_step_locked(self, n: int = 1) -> None:
+        self._clean_steps += int(n)
+        if self.health == "degraded" \
+                and self._clean_steps >= self.health_recovery_steps:
+            self._set_health(
+                "ok", f"{self._clean_steps} clean steps")
+
+    def _fail_all_locked(self, error: str) -> None:
+        leftovers = list(self.scheduler.waiting) \
+            + list(self.scheduler.running)
+        self.scheduler.waiting.clear()
+        for seq in leftovers:
+            seq.req.error_kind = seq.req.error_kind or "unhealthy"
+            self.scheduler.finish(seq, error=error)
+        self._g_queue.set(0)
+        self._g_occ.set(0)
+
+    # -- fault containment: hung-step watchdog ---------------------------
+    def _watchdog_loop(self, timeout: float) -> None:
+        poll = max(min(timeout / 4.0, 0.25), 0.01)
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                t0 = self._dispatch_t0
+                budget = timeout + (_COLD_DISPATCH_GRACE_S
+                                    if self._dispatch_cold else 0.0)
+            if t0 is not None and time.monotonic() - t0 > budget:
+                self._recover_from_stall(timeout)
+            time.sleep(poll)
+
+    def _recover_from_stall(self, timeout: float) -> None:
+        """A device dispatch exceeded the watchdog budget: dump the
+        flight recorder, abandon the wedged epoch (thread, device
+        pools, page accounting) and relaunch with every survivor
+        requeued at the FRONT — the eviction-resume contract replays
+        their prompt+generated tokens, so no stream truncates."""
+        with self._wake:
+            t0 = self._dispatch_t0
+            budget = timeout + (_COLD_DISPATCH_GRACE_S
+                                if self._dispatch_cold else 0.0)
+            if t0 is None or time.monotonic() - t0 <= budget:
+                return          # resolved while we were scheduled
+            age = time.monotonic() - t0
+            plan = self._dispatch_plan
+            self._relaunches += 1
+            self._clean_steps = 0
+            self._c_step_timeout.inc()
+            _events.emit(
+                "step_timeout", engine=self.engine_id,
+                age_s=round(age, 3), timeout_s=float(timeout),
+                batch=len(plan.seqs) if plan is not None else 0,
+                relaunches=self._relaunches)
+            _tracing.dump_flight("serving_step_timeout")
+            if self._relaunches > self.max_watchdog_relaunches:
+                # a dispatch that hangs this persistently is not
+                # coming back: stop relaunching, fail LOUDLY and let
+                # the fleet supervisor restart the whole replica
+                self._epoch += 1
+                self._dispatch_t0 = None
+                self._dispatch_plan = None
+                self._accepting = False
+                self._set_health(
+                    "failed",
+                    f"{self._relaunches} watchdog relaunches exceed "
+                    f"the cap ({self.max_watchdog_relaunches})")
+                self._fail_all_locked(
+                    "engine failed: repeated hung steps")
+                self._wake.notify_all()
+                return
+            self._set_health(
+                "degraded",
+                f"hung step ({age:.1f}s > {timeout}s) — relaunching "
+                f"the iteration loop")
+            self._relaunch_locked()
+
+    def _relaunch_locked(self) -> None:
+        import jax  # noqa: F401 — jnp import hides behind it
+        import jax.numpy as jnp
+        self._epoch += 1
+        epoch = self._epoch
+        self._dispatch_t0 = None
+        self._dispatch_plan = None
+        # requeue EVERY running sequence at the front, generated
+        # tokens kept: re-admission re-prefills prompt+generated and
+        # continues token-exact (greedy), exactly like an eviction
+        for seq in reversed(list(self.scheduler.running)):
+            seq.pages = []      # the pool they point into is dead
+            seq.shared = set()
+            seq.kv_len = 0
+            seq.cached_tokens = 0
+            seq.cache_inserted = False
+            seq.req.evictions += 1
+            self.scheduler.evictions += 1
+            self.scheduler.waiting.appendleft(seq)
+        self.scheduler.running.clear()
+        # fresh page accounting + DEVICE pools: the wedged dispatch
+        # may still be writing into the old buffers, so they are
+        # abandoned, never reused (the zombie thread's results are
+        # fenced off by the epoch check at every commit point)
+        self.pool = PagePool(self._num_pages, self._page_size)
+        self.prefix_cache = PrefixCache(self.pool) \
+            if self._prefix_caching else None
+        self.scheduler.rebind_pool(self.pool, self.prefix_cache)
+        self._pools = tuple(
+            (jnp.zeros((self._nkv, self._num_pages, self._page_size,
+                        self._hd), self._dtype),
+             jnp.zeros((self._nkv, self._num_pages, self._page_size,
+                        self._hd), self._dtype))
+            for _ in range(self._n_layers))
+        self._thread = threading.Thread(
+            target=self._loop, args=(epoch,), daemon=True,
+            name=f"serving-engine-{self.engine_id}-e{epoch}")
+        self._thread.start()
+        self._wake.notify_all()
+
+    # -- fault containment: deadlines + cancellation ---------------------
+    def _cancel_request(self, req, reason: str) -> None:
+        """``Request.cancel()`` hook: routes through the engine lock
+        so pages and the batch slot free immediately."""
+        with self._wake:
+            self._cancel_locked(req, reason)
+
+    def _cancel_locked(self, req, reason: str) -> None:
+        if req.done:
+            return
+        req.error_kind = req.error_kind or "cancelled"
+        self._n_cancelled += 1
+        self._c_cancelled.inc()
+        _events.emit("request_cancelled", request=req.id,
+                     reason=reason, n_tokens=len(req.tokens),
+                     deadline_s=req.deadline_s)
+        self.scheduler.drop(req, error=reason)
+        self._g_queue.set(self.scheduler.queue_depth())
+        self._g_occ.set(len(self.scheduler.running))
+
+    def _sweep_deadlines_locked(self) -> None:
+        now = time.monotonic()
+        expired = [s.req for s in (list(self.scheduler.running)
+                                   + list(self.scheduler.waiting))
+                   if s.req.deadline_at is not None
+                   and now > s.req.deadline_at]
+        for req in expired:
+            req.error_kind = "deadline"
+            self._cancel_locked(
+                req, f"deadline exceeded ({req.deadline_s}s)")
+
     # -- the jitted ragged program ---------------------------------------
     def _program(self, qw: int):
         import jax
@@ -574,9 +1085,12 @@ class ServingEngine:
         step = self._step_fn
 
         def program(params, tok, pos, pools, page_ids, slots, kv_lens,
-                    q_lens, tables, temps, rng):
+                    q_lens, tables, temps, rng, poison):
             logits, pools = step(params, tok, pos, pools, page_ids,
                                  slots, kv_lens, q_lens, tables)
+            # chaos bias (zeros in production — a no-op add) lets the
+            # fault injector NaN one lane's logits without a host hook
+            logits = logits + poison[:, None]
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             rng, sub = jax.random.split(rng)
             t32 = temps.astype(jnp.float32)
@@ -585,6 +1099,12 @@ class ServingEngine:
             sampled = jax.random.categorical(sub, scaled, axis=-1) \
                 .astype(jnp.int32)
             nxt = jnp.where(t32 > jnp.float32(0.0), sampled, greedy)
+            # on-device NaN-logits sentinel: a NaN row (injected or a
+            # genuine numeric blow-up) collapses the sampled token to
+            # -1, so the host's ONE boundary read doubles as the
+            # detector and the lane quarantines with no extra sync
+            bad = jnp.isnan(logits).any(axis=-1)
+            nxt = jnp.where(bad, jnp.int32(-1), nxt)
             return nxt, pools, rng
 
         # pools are index 3; donated so XLA reuses the page buffers in
@@ -627,7 +1147,13 @@ class ServingEngine:
                "prestage_commits": self.scheduler.prestage_commits,
                "prestage_discards": self.scheduler.prestage_discards,
                "free_pages": self.pool.available(),
-               "programs": len(self._programs)}
+               "programs": len(self._programs),
+               "health": self.health,
+               "quarantined": self._n_quarantined,
+               "quarantined_prompts": len(self._quarantined),
+               "cancelled": self._n_cancelled,
+               "watchdog_relaunches": self._relaunches,
+               "wedged_threads": self._wedged_threads}
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
         return out

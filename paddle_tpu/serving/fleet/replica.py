@@ -71,6 +71,11 @@ class ReplicaHandle:
         self.healthy = False
         self.queue_depth = 0.0
         self.occupancy = 0.0
+        # engine health state machine (ok/degraded/quarantining/
+        # failed), parsed by the router's poller from the replica's
+        # paddle_serving_engine_health gauge; "failed" makes the
+        # replica unroutable even while its process is alive
+        self.health_state = "ok"
 
     @property
     def alive(self) -> bool:
@@ -82,7 +87,7 @@ class ReplicaHandle:
         proc_ok = self.proc is None or self.alive
         return (self.url is not None and proc_ok
                 and not self.draining and not self.gone
-                and self.healthy)
+                and self.healthy and self.health_state != "failed")
 
     def __repr__(self) -> str:
         return (f"ReplicaHandle(id={self.id!r}, url={self.url!r}, "
@@ -160,6 +165,7 @@ class ReplicaSupervisor:
             pass
         handle.url = None
         handle.healthy = False
+        handle.health_state = "ok"   # fresh process, fresh engine
         argv = self._argv(handle.id, handle.port_file)
         handle.proc = subprocess.Popen(argv,
                                        env=self._child_env(handle),
@@ -325,6 +331,34 @@ class ReplicaSupervisor:
             if h.id == str(replica_id) and h.proc is not None:
                 h.proc.kill()
                 return
+        raise KeyError(f"no replica {replica_id!r}")
+
+    def restart_replica(self, replica_id: str,
+                        reason: str = "health") -> bool:
+        """Deliberately restart one replica (the router calls this
+        when an engine reports ``failed`` health): mark it unroutable,
+        SIGTERM it off-thread (grace window, then SIGKILL), and let
+        the supervise loop relaunch it through the normal
+        crash-with-backoff path.  Returns False when the replica is
+        unknown or already gone (restart cap exhausted)."""
+        for h in self.replicas:
+            if h.id != str(replica_id):
+                continue
+            with self._lock:
+                if h.gone or h.proc is None:
+                    return False
+                h.healthy = False
+            _RESTARTS.labels(replica=h.id, reason=reason).inc()
+            _events.emit("replica_restart", replica=h.id,
+                         reason=reason, restarts=h.restarts,
+                         code=0)
+            # terminate OFF-thread: the grace window can be seconds
+            # and the caller is the router's poll loop — blocking it
+            # would stall health updates for every other replica
+            threading.Thread(target=self._terminate, args=(h,),
+                             name=f"fleet-restart-{h.id}",
+                             daemon=True).start()
+            return True
         raise KeyError(f"no replica {replica_id!r}")
 
     def stop(self) -> None:

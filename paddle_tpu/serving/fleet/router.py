@@ -18,6 +18,14 @@ unchanged.  Placement is a scored policy, first signal wins:
 3. **least queue depth**, then round-robin — the load-balancing
    floor when no model is available.
 
+Before any of that, **engine health gates the candidate set**: the
+poller parses each replica's ``paddle_serving_engine_health`` gauge
+(ok/degraded/quarantining/failed), placement prefers the healthiest
+rank available, a ``failed`` replica is unroutable entirely and is
+handed to the supervisor for a restart (``restart_replica``,
+debounced per failure episode) — the fleet drains a sick replica
+BEFORE it dies, not after.
+
 Failure semantics lift the scheduler's eviction-resume contract to
 the fleet: a replica dying mid-stream (crash, SIGKILL, drain window
 expiry) does NOT kill the client stream — the router resubmits the
@@ -209,6 +217,10 @@ class FleetRouter:
         self._closing = False
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
+        # replicas already handed to the supervisor for a health
+        # restart this episode (debounce: the poll loop would
+        # otherwise re-fire every interval until the relaunch lands)
+        self._health_restarted: set = set()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -290,8 +302,32 @@ class FleetRouter:
                                "paddle_serving_engine_batch_occupancy")
             h.queue_depth = qd if qd is not None else 0.0
             h.occupancy = occ if occ is not None else 0.0
+            # engine health state machine: route around a degraded /
+            # quarantining engine BEFORE it dies, and hand a failed
+            # one to the supervisor for a restart (debounced — once
+            # per failure episode)
+            hv = _parse_gauge(text, "paddle_serving_engine_health")
+            if hv is None:
+                h.health_state = "ok"   # pre-health replica build
+            else:
+                h.health_state = ("ok", "degraded", "quarantining",
+                                  "failed")[min(max(int(hv), 0), 3)]
+            if h.health_state == "failed":
+                if self.supervisor is not None \
+                        and h.id not in self._health_restarted:
+                    # restart_replica emits replica_restart
+                    # (reason="health") and terminates off-thread
+                    self._health_restarted.add(h.id)
+                    try:
+                        self.supervisor.restart_replica(
+                            h.id, reason="health")
+                    except KeyError:
+                        pass
+            else:
+                self._health_restarted.discard(h.id)
             h.healthy = True
-            live += 1
+            if h.health_state != "failed":
+                live += 1
         self._g_live.set(live)
         if self.model_dirs:
             merged = perf_merge.merged_from_dirs(self.model_dirs)
@@ -341,6 +377,14 @@ class FleetRouter:
                  if h.id not in exclude]
         if not cands:
             return None
+        # health rank comes FIRST: an ok replica always beats a
+        # degraded/quarantining one, whatever affinity or cost says —
+        # draining a sick replica of new work is how it heals (and how
+        # the blast radius stays contained if it doesn't)
+        rank = {"ok": 0, "degraded": 1, "quarantining": 2}
+        lo_rank = min(rank.get(h.health_state, 2) for h in cands)
+        cands = [h for h in cands
+                 if rank.get(h.health_state, 2) <= lo_rank]
         keys = self._prompt_keys(prompt)
         best_aff = 0
         if keys:
@@ -380,6 +424,14 @@ class FleetRouter:
             if placed is not None or \
                     time.monotonic() > deadline:
                 return placed
+            if not any((not h.gone) and (not h.draining)
+                       and h.health_state != "failed"
+                       for h in self.endpoints):
+                # nothing can become routable without supervisor
+                # action (every replica draining / failed / given
+                # up): fail FAST with Retry-After instead of holding
+                # the client for the full placement window
+                return None
             if self._stop.wait(0.1):
                 return None
 
@@ -613,6 +665,7 @@ class FleetRouter:
     def fleet_stats(self) -> dict:
         reps = [{"id": h.id, "url": h.url, "healthy": h.healthy,
                  "draining": h.draining,
+                 "health_state": h.health_state,
                  "queue_depth": h.queue_depth,
                  "occupancy": h.occupancy,
                  "restarts": h.restarts}

@@ -99,7 +99,8 @@ class Request:
     def __init__(self, input_ids: Sequence[int], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 deadline_s: Optional[float] = None):
         self.id = request_id if request_id is not None \
             else str(next(Request._IDS))
         self.prompt: List[int] = [int(t) for t in np.asarray(
@@ -112,9 +113,20 @@ class Request:
         self.temperature = float(temperature)
         self.tokens: List[int] = []        # generated ids, in order
         self.error: Optional[str] = None
+        # machine-readable failure class for the HTTP layer's status
+        # mapping: "deadline"/"unhealthy" -> 503, "quarantined" -> 400,
+        # "cancelled" stays in-band; None for ordinary errors
+        self.error_kind: Optional[str] = None
         self._queue: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
         self.submitted_at = time.monotonic()
+        self.deadline_s = None if deadline_s is None \
+            else float(deadline_s)
+        self.deadline_at = None if deadline_s is None \
+            else self.submitted_at + float(deadline_s)
+        # engine-installed cancel hook: routes cancel() through the
+        # engine lock so pages and the batch slot free immediately
+        self._cancel_cb = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.evictions = 0
@@ -129,9 +141,23 @@ class Request:
     # -- consumer side ---------------------------------------------------
     def stream(self, timeout: Optional[float] = 60.0):
         """Yield generated token ids as they land; returns on EOS /
-        budget / failure (raises RuntimeError on failure)."""
+        budget / failure (raises RuntimeError on failure).
+
+        A ``timeout`` expiry CANCELS the request before raising: the
+        consumer is gone, so leaving it running headless would silently
+        burn batch slots and truncate the stream with no error
+        anywhere — instead the engine frees its pages now and the
+        failure is loud on both sides (request_cancelled event +
+        RuntimeError here)."""
         while True:
-            tok = self._queue.get(timeout=timeout)
+            try:
+                tok = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                self.cancel(f"stream consumer timed out after "
+                            f"{timeout}s without a token")
+                raise RuntimeError(
+                    self.error or f"request {self.id}: stream timed "
+                                  f"out after {timeout}s") from None
             if tok is None:
                 if self.error:
                     raise RuntimeError(self.error)
@@ -139,13 +165,29 @@ class Request:
             yield tok
 
     def wait(self, timeout: Optional[float] = 60.0) -> List[int]:
-        """Block until the request finishes; returns the generated ids."""
+        """Block until the request finishes; returns the generated ids.
+        A timeout cancels the request (see :meth:`stream`) before
+        raising TimeoutError."""
         if not self._done.wait(timeout):
+            self.cancel(f"wait consumer timed out after {timeout}s")
             raise TimeoutError(f"request {self.id} still running after "
-                               f"{timeout}s")
+                               f"{timeout}s (request cancelled)")
         if self.error:
             raise RuntimeError(self.error)
         return list(self.tokens)
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Cancel a queued/running request: pages and the batch slot
+        free immediately (via the engine's cancel hook when admitted),
+        consumers see the error.  Idempotent after finish."""
+        if self._done.is_set():
+            return
+        cb = self._cancel_cb
+        if cb is not None:
+            cb(self, reason)
+        else:
+            self.error_kind = self.error_kind or "cancelled"
+            self._finish(error=reason)
 
     @property
     def done(self) -> bool:
@@ -210,7 +252,8 @@ class StepPlan:
 
     __slots__ = ("seqs", "slots_map", "tok", "pos", "page_ids", "slots",
                  "kv_lens", "q_lens", "tables", "temps",
-                 "n_prefill", "n_decode", "fed_prefill", "fed_decode")
+                 "n_prefill", "n_decode", "fed_prefill", "fed_decode",
+                 "bisect_group")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -279,6 +322,12 @@ class Scheduler:
         self.waiting: deque = deque()
         self.running: List[_Sequence] = []
         self.evictions = 0
+        # quarantine bisection (engine fault containment): while
+        # non-empty, plan_step restricts each plan to the front
+        # group's members (by request id) and pauses admission — the
+        # engine splits a failed group in half and pushes both halves
+        # here until the offender is isolated
+        self.bisect_groups: deque = deque()
         # double-buffered plan (fused serving windows): admission
         # decisions pre-staged against the projected post-window state
         # while the device runs, committed or discarded at the boundary
@@ -301,7 +350,28 @@ class Scheduler:
         if len(self.waiting) >= self.max_queue:
             req._finish(error="queue full")
             return
-        self.waiting.append(_Sequence(req))
+        seq = _Sequence(req)
+        if req.deadline_at is not None and self.perf_model is not None:
+            # predicted-cost admission consults the remaining deadline:
+            # a request whose full decode cannot fit inside it is doomed
+            # — reject up front (HTTP maps error_kind="deadline" to
+            # 503) instead of burning batch slots on a stream that must
+            # be cancelled mid-flight.  The per-step prediction is a
+            # conservative per-token estimate (it prices the admission
+            # step, prefill included).
+            pred = self._predicted_admit_cost(seq)
+            if pred is not None:
+                need_s = pred * max(req.max_new_tokens, 1)
+                remaining = req.deadline_at - time.monotonic()
+                if need_s > remaining:
+                    req.error_kind = "deadline"
+                    req._finish(
+                        error=f"deadline infeasible: predicted "
+                              f"{need_s:.3f}s of decode exceeds the "
+                              f"{remaining:.3f}s remaining before the "
+                              f"deadline")
+                    return
+        self.waiting.append(seq)
 
     def queue_depth(self) -> int:
         return len(self.waiting)
@@ -380,7 +450,8 @@ class Scheduler:
         }
         try:
             return self.perf_model.predict("batch_step", feats)
-        except Exception:
+        except Exception:  # noqa: PTL401 — a perf-model failure must
+            # never wedge admission; None falls back to the raw caps
             return None
 
     # -- admission / eviction --------------------------------------------
@@ -465,6 +536,48 @@ class Scheduler:
         self._release(seq)
         seq.req._finish(error=error)
 
+    def drop(self, req: Request, error: str) -> bool:
+        """Cancellation path: remove ``req`` wherever it sits (wait
+        queue or running batch), free its pages NOW, and finish it with
+        ``error``.  Returns True when it was still scheduled."""
+        for seq in list(self.waiting):
+            if seq.req is req:
+                self.waiting.remove(seq)
+                seq.req._finish(error=error)
+                return True
+        for seq in list(self.running):
+            if seq.req is req:
+                self.running.remove(seq)
+                self._release(seq)
+                seq.req._finish(error=error)
+                return True
+        req._finish(error=error)
+        return False
+
+    def rebind_pool(self, pool: PagePool, prefix_cache=None) -> None:
+        """Watchdog relaunch: the abandoned dispatch may still write
+        into the old device buffers, so the engine replaces them AND
+        the host page accounting wholesale — rebind to the fresh pool,
+        drop staged plans and any in-flight bisection episode."""
+        self.pool = pool
+        self.prefix_cache = prefix_cache
+        self._prestage = None
+        self._staged_pred = None
+        self.bisect_groups.clear()
+
+    # -- quarantine bisection (engine fault containment) -----------------
+    def bisect_push_front(self, groups) -> None:
+        """Push request-id groups at the FRONT of the bisection queue
+        (the engine splits a failed batch in half and narrows first)."""
+        for g in reversed(list(groups)):
+            self.bisect_groups.appendleft(frozenset(g))
+
+    def bisect_done(self, group) -> None:
+        """A restricted plan for ``group`` resolved (ran clean, or was
+        contained) — retire it."""
+        if self.bisect_groups and self.bisect_groups[0] == group:
+            self.bisect_groups.popleft()
+
     # -- the per-iteration plan ------------------------------------------
     def plan_step(self):
         """Admit what fits, grow pages for this iteration's tokens
@@ -479,19 +592,31 @@ class Scheduler:
                 self._staged_pred = pre.prediction
             else:
                 self.prestage_discards += 1
+        # quarantine bisection: restrict the plan to the front group's
+        # members and pause admission until the episode resolves
+        group = None
+        while self.bisect_groups:
+            g = self.bisect_groups[0]
+            if any(s.req.id in g for s in self.running):
+                group = g
+                break
+            self.bisect_groups.popleft()   # members finished meanwhile
         admitted: List[_Sequence] = []
         evicted: List[_Sequence] = []
-        while True:
-            seq = self._admit_one()
-            if seq is None:
-                break
-            admitted.append(seq)
+        if group is None:
+            while True:
+                seq = self._admit_one()
+                if seq is None:
+                    break
+                admitted.append(seq)
 
         # per-sequence chunk of NEW tokens this iteration
         active: List[Tuple[_Sequence, List[int]]] = []
         for seq in list(self.running):
             if seq not in self.running:
                 continue       # evicted by an earlier seq's growth
+            if group is not None and seq.req.id not in group:
+                continue       # parked while the bisection probes
             chunk = seq.tokens[seq.kv_len:]
             if self.max_prefill_chunk and \
                     len(chunk) > self.max_prefill_chunk:
@@ -562,7 +687,8 @@ class Scheduler:
                         slots=slots, kv_lens=kv_lens, q_lens=q_lens,
                         tables=tables, temps=temps,
                         n_prefill=n_prefill, n_decode=n_decode,
-                        fed_prefill=fed_prefill, fed_decode=fed_decode)
+                        fed_prefill=fed_prefill, fed_decode=fed_decode,
+                        bisect_group=group)
         return plan, admitted, evicted
 
     def commit(self, plan: StepPlan) -> None:

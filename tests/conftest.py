@@ -43,6 +43,9 @@ def pytest_configure(config):
         "markers", "lint: static-analysis self-checks (paddle_tpu."
         "analysis self-lint + registry consistency); tier-1 runs these "
         "as the CI gate — `pytest -m lint` runs just the gate")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (FLAGS_fault_schedule "
+        "driven); selectable as a nightly tier with `pytest -m chaos`")
 
 
 def pytest_collection_modifyitems(config, items):

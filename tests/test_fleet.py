@@ -53,12 +53,16 @@ class _StubReplica:
     for the first ``die_times`` requests."""
 
     def __init__(self, queue_depth=0.0, occupancy=0.0,
-                 die_after=None, die_times=0, token_delay=0.0):
+                 die_after=None, die_times=0, token_delay=0.0,
+                 health=None):
         self.queue_depth = queue_depth
         self.occupancy = occupancy
         self.die_after = die_after
         self.die_times = die_times
         self.token_delay = token_delay
+        # engine health gauge value (0 ok .. 3 failed); None omits the
+        # family entirely, like a pre-health replica build
+        self.health = health
         self.requests = []            # (spec, headers) per /generate
         self._lock = threading.Lock()
         outer = self
@@ -72,13 +76,17 @@ class _StubReplica:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = (
+                text = (
                     "# HELP paddle_serving_engine_queue_depth d\n"
                     "# TYPE paddle_serving_engine_queue_depth gauge\n"
                     'paddle_serving_engine_queue_depth{engine="s"} '
                     f"{outer.queue_depth}\n"
                     'paddle_serving_engine_batch_occupancy'
-                    f'{{engine="s"}} {outer.occupancy}\n').encode()
+                    f'{{engine="s"}} {outer.occupancy}\n')
+                if outer.health is not None:
+                    text += ('paddle_serving_engine_health'
+                             f'{{engine="s"}} {outer.health}\n')
+                body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -675,3 +683,118 @@ def test_fleet_package_files_report_clean():
         findings = [f for f in lint_file(os.path.join(fleet_dir, name))
                     if f.code in ("PTL401", "PTL501", "PTL701")]
         assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# health-aware routing: drain degraded, restart failed, fast-fail
+# ---------------------------------------------------------------------------
+
+def _wait_until(cond, timeout=5.0, every=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+def test_router_fast_503_when_all_draining_then_recovers(obs_dir):
+    """Every replica draining: placement fails FAST with 503 +
+    Retry-After instead of holding the client for the whole placement
+    window — and un-draining resumes routing with no restart."""
+    stubs = [_StubReplica().start(), _StubReplica().start()]
+    router = _mk_router(stubs, placement_wait_s=10.0).start()
+    try:
+        assert _wait_until(
+            lambda: all(h.healthy for h in router.endpoints))
+        for h in router.endpoints:
+            h.draining = True
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _generate(router.url, [1, 2], max_new=2, retries=1)
+        elapsed = time.monotonic() - t0
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "1.0"
+        # well under placement_wait_s: the fast-fail path, not the
+        # full bounded wait
+        assert elapsed < 5.0
+        for h in router.endpoints:
+            h.draining = False
+        prompt = [2, 4]
+        assert _generate(router.url, prompt, max_new=4) == \
+            _expected_stream(prompt, 4)
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_router_routes_around_degraded_replica(obs_dir):
+    """Health rank beats every other placement signal: while an ok
+    replica exists, a degraded one receives NO new work (draining it
+    is how it heals) — and fleet_stats surfaces the state."""
+    stubs = [_StubReplica(health=1.0).start(),   # degraded
+             _StubReplica().start()]             # no gauge -> ok
+    router = _mk_router(stubs).start()
+    try:
+        assert _wait_until(
+            lambda: router.endpoints[0].health_state == "degraded"
+            and router.endpoints[1].healthy)
+        for _ in range(3):
+            _generate(router.url, [5, 6], max_new=2)
+        assert not stubs[0].requests
+        assert len(stubs[1].requests) == 3
+        states = {r["id"]: r["health_state"]
+                  for r in router.fleet_stats()["replicas"]}
+        assert states == {"0": "degraded", "1": "ok"}
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()
+
+
+def test_router_hands_failed_replica_to_supervisor(obs_dir):
+    """A replica reporting health=failed is unroutable AND handed to
+    the supervisor for a restart — exactly once per failure episode
+    (debounced), however many polls see it down."""
+    from paddle_tpu.serving.fleet.replica import ReplicaHandle
+
+    stubs = [_StubReplica(health=3.0).start(),   # failed
+             _StubReplica().start()]
+
+    class _FakeSup:
+        def __init__(self):
+            self.replicas = []
+            self.calls = []
+
+        def restart_replica(self, rid, reason="health"):
+            self.calls.append((rid, reason))
+            return True
+
+    sup = _FakeSup()
+    for i, s in enumerate(stubs):
+        h = ReplicaHandle(str(i), port_file="")
+        h.url = s.url
+        sup.replicas.append(h)
+    router = FleetRouter(supervisor=sup, poll_interval=0.05,
+                         placement_wait_s=2.0).start()
+    try:
+        assert _wait_until(lambda: sup.calls)
+        time.sleep(0.4)                  # many more poll cycles...
+        assert sup.calls == [("0", "health")]     # ...one restart
+        # traffic keeps flowing, all of it on the healthy replica
+        prompt = [3, 1]
+        assert _generate(router.url, prompt, max_new=3) == \
+            _expected_stream(prompt, 3)
+        assert not stubs[0].requests
+        # recovery clears the debounce: the NEXT failure episode gets
+        # its own restart
+        stubs[0].health = 0.0
+        assert _wait_until(
+            lambda: router.endpoints[0].health_state == "ok")
+        stubs[0].health = 3.0
+        assert _wait_until(lambda: len(sup.calls) == 2)
+    finally:
+        router.stop()
+        for s in stubs:
+            s.stop()

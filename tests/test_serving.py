@@ -1014,3 +1014,388 @@ def test_fused_engine_prestages_plans(gpt_model, fused_flags):
     assert stats["prestaged_plans"] >= 1
     assert stats["prestage_commits"] + stats["prestage_discards"] \
         <= stats["prestaged_plans"]
+
+
+# ---------------------------------------------------------------------------
+# fault containment: quarantine, watchdog, deadlines, health machine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def chaos(tmp_path):
+    """Observability capture plus guaranteed fault-schedule and
+    timeout-flag cleanup — a leaked schedule would poison every test
+    that follows."""
+    from paddle_tpu.resilience import faults
+    set_flags({"FLAGS_observability_dir": str(tmp_path)})
+    try:
+        yield str(tmp_path)
+    finally:
+        faults.install_schedule(None)
+        set_flags({"FLAGS_observability_dir": "",
+                   "FLAGS_serving_step_timeout_s": 0.0})
+
+
+def _run_all(reqs, timeout=180):
+    """wait() every request; returns (results, errored_indices) with
+    None in the slot of each failed stream."""
+    results, errs = [], []
+    for i, r in enumerate(reqs):
+        try:
+            results.append(r.wait(timeout=timeout))
+        except (RuntimeError, TimeoutError):
+            results.append(None)
+            errs.append(i)
+    return results, errs
+
+
+@pytest.mark.chaos
+def test_quarantine_bisection_isolates_offender(gpt_model, chaos):
+    """The headline chaos contract: poison ONE of 8 co-batched streams
+    (serving_step@3=exc pins sticky poison to a single request) — the
+    7 innocents finish token-identical to an unpoisoned run, the
+    offender alone fails, and the quarantine event names it."""
+    from paddle_tpu.observability import events as obs_events
+    from paddle_tpu.resilience import faults
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 128, (n,)).tolist()
+               for n in (4, 6, 8, 5, 7, 9, 3, 10)]
+    want = _greedy_reference(gpt_model, prompts, 8)
+    faults.install_schedule("serving_step@3=exc")
+    engine = ServingEngine(gpt_model, max_batch=8, page_size=8)
+    try:
+        engine.start()
+        reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        results, errs = _run_all(reqs)
+    finally:
+        engine.stop(drain=False)
+    assert len(errs) == 1                    # the offender fails ALONE
+    bad = errs[0]
+    assert reqs[bad].error_kind == "quarantined"
+    for i in range(8):                       # innocents: token-exact
+        if i != bad:
+            assert results[i] == want[i], f"stream {i} diverged"
+    st = engine.stats()
+    assert st["quarantined"] == 1
+    assert st["quarantined_prompts"] == 1
+    evs = obs_events.read_events(chaos, kinds=["quarantine"])
+    mine = [e for e in evs if e["action"] == "quarantined"]
+    assert len(mine) == 1 and mine[0]["request"] == reqs[bad].id
+    # the health machine walked ok -> quarantining -> degraded
+    states = [e["state"] for e in obs_events.read_events(
+        chaos, kinds=["health_transition"])]
+    assert "quarantining" in states and "degraded" in states
+
+
+@pytest.mark.chaos
+def test_quarantined_prompt_rejected_at_admission(gpt_model, chaos):
+    """Repeat offender: the SAME prompt resubmitted after a quarantine
+    is rejected at admission (by prompt hash) — and the engine keeps
+    serving other work."""
+    from paddle_tpu.observability import events as obs_events
+    from paddle_tpu.resilience import faults
+    rs = np.random.RandomState(12)
+    poison_prompt = rs.randint(0, 128, (6,)).tolist()
+    clean_prompt = rs.randint(0, 128, (5,)).tolist()
+    [want_clean] = _greedy_reference(gpt_model, [clean_prompt], 6)
+    faults.install_schedule("serving_step@1=exc")
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    try:
+        engine.start()
+        first = engine.submit(poison_prompt, max_new_tokens=6)
+        with pytest.raises(RuntimeError, match="quarantined"):
+            first.wait(timeout=120)
+        again = engine.submit(poison_prompt, max_new_tokens=6)
+        with pytest.raises(RuntimeError, match="quarantined"):
+            again.wait(timeout=10)
+        assert again.error_kind == "quarantined"
+        clean = engine.submit(clean_prompt, max_new_tokens=6)
+        assert clean.wait(timeout=120) == want_clean
+    finally:
+        engine.stop(drain=False)
+    evs = obs_events.read_events(chaos, kinds=["quarantine"])
+    assert [e for e in evs if e["action"] == "rejected"
+            and e["request"] == again.id]
+    assert engine.stats()["quarantined_prompts"] == 1
+
+
+@pytest.mark.chaos
+def test_nan_sentinel_quarantines_offending_lane(gpt_model, chaos):
+    """On-device NaN-logits sentinel: a lane whose logits go NaN
+    (injected via serving_step@2=nan) is quarantined alone — ragged
+    attention never mixes lanes, so co-batched innocents are sound and
+    token-exact, with no extra host read to detect it."""
+    from paddle_tpu.observability import events as obs_events
+    from paddle_tpu.resilience import faults
+    rs = np.random.RandomState(13)
+    prompts = [rs.randint(0, 128, (n,)).tolist() for n in (4, 6, 8, 5)]
+    want = _greedy_reference(gpt_model, prompts, 8)
+    faults.install_schedule("serving_step@2=nan")
+    engine = ServingEngine(gpt_model, max_batch=4, page_size=8)
+    try:
+        engine.start()
+        reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        results, errs = _run_all(reqs)
+    finally:
+        engine.stop(drain=False)
+    assert len(errs) == 1
+    bad = errs[0]
+    assert reqs[bad].error_kind == "quarantined"
+    assert "nan_logits" in (reqs[bad].error or "")
+    for i in range(4):
+        if i != bad:
+            assert results[i] == want[i]
+    evs = obs_events.read_events(chaos, kinds=["quarantine"])
+    assert [e for e in evs if e["reason"] == "nan_logits"]
+
+
+@pytest.mark.chaos
+def test_watchdog_relaunch_keeps_all_streams_exact(gpt_model, chaos):
+    """Hung-step watchdog: a stalled dispatch trips the timeout, the
+    iteration loop relaunches, every survivor requeues at the front —
+    ALL streams still finish token-identical to the no-fault oracle
+    (zero silent truncation)."""
+    from paddle_tpu.observability import events as obs_events
+    from paddle_tpu.resilience import faults
+    rs = np.random.RandomState(14)
+    prompts = [rs.randint(0, 128, (n,)).tolist() for n in (4, 6, 8, 5)]
+    want = _greedy_reference(gpt_model, prompts, 8)
+    faults.install_schedule("serving_step@4=stall:2")
+    set_flags({"FLAGS_serving_step_timeout_s": 0.5})
+    engine = ServingEngine(gpt_model, max_batch=4, page_size=8)
+    try:
+        engine.start()
+        reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        results, errs = _run_all(reqs)
+    finally:
+        engine.stop(drain=False)
+    assert errs == []
+    assert results == want                   # zero truncation, exact
+    st = engine.stats()
+    assert st["watchdog_relaunches"] == 1
+    assert st["health"] == "degraded"
+    evs = obs_events.read_events(chaos, kinds=["step_timeout"])
+    assert len(evs) == 1 and evs[0]["relaunches"] == 1
+    assert evs[0]["timeout_s"] == 0.5
+    # the survivors were requeued (eviction-resume), not restarted
+    assert all(r.evictions >= 1 for r in reqs)
+
+
+@pytest.mark.chaos
+def test_watchdog_relaunch_cap_fails_engine(gpt_model, chaos):
+    """Past the relaunch cap the engine stops thrashing: health goes
+    failed (terminal), every consumer fails loudly, and new submits
+    are rejected — the fleet supervisor owns recovery from here."""
+    from paddle_tpu.observability import events as obs_events
+    from paddle_tpu.resilience import faults
+    faults.install_schedule("serving_step@2=stall:2")
+    set_flags({"FLAGS_serving_step_timeout_s": 0.3})
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8,
+                           max_watchdog_relaunches=0)
+    try:
+        engine.start()
+        reqs = [engine.submit([1, 2, 3], max_new_tokens=8),
+                engine.submit([4, 5, 6], max_new_tokens=8)]
+        results, errs = _run_all(reqs, timeout=60)
+    finally:
+        engine.stop(drain=False)
+    assert errs == [0, 1]                    # nobody hangs silently
+    assert all(r.error_kind == "unhealthy" for r in reqs)
+    assert engine.stats()["health"] == "failed"
+    late = engine.submit([7, 8], max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="unhealthy"):
+        late.wait(timeout=10)
+    assert late.error_kind == "unhealthy"
+    states = [e["state"] for e in obs_events.read_events(
+        chaos, kinds=["health_transition"])]
+    assert states[-1] == "failed"
+
+
+def test_wait_timeout_cancels_and_raises():
+    """satellite: a wait() timeout fails the request LOUDLY — the
+    request is cancelled (not left running headless) and the consumer
+    gets TimeoutError, never a silent partial stream."""
+    req = Request([1, 2, 3], max_new_tokens=4)
+    with pytest.raises(TimeoutError, match="cancelled"):
+        req.wait(timeout=0.1)
+    assert req.done
+    assert req.error_kind == "cancelled"
+    with pytest.raises(RuntimeError):
+        req.wait(timeout=1)                  # already finished-in-error
+
+
+def test_stream_timeout_cancels_and_raises():
+    req = Request([1, 2, 3], max_new_tokens=4)
+    it = req.stream(timeout=0.1)
+    with pytest.raises(RuntimeError, match="timed out"):
+        next(it)
+    assert req.done and req.error_kind == "cancelled"
+
+
+def test_deadline_cancels_mid_batch_and_frees_pages(gpt_model, chaos):
+    """A request whose deadline expires mid-decode is cancelled from
+    inside the loop: pages free immediately, the co-batched request is
+    untouched, and the failure is a request_cancelled event + an
+    error_kind="deadline" error on the consumer side."""
+    from paddle_tpu.observability import events as obs_events
+    rs = np.random.RandomState(15)
+    p_ok = rs.randint(0, 128, (5,)).tolist()
+    p_doomed = rs.randint(0, 128, (5,)).tolist()
+    [want_ok] = _greedy_reference(gpt_model, [p_ok], 8)
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8,
+                           prefix_caching=False)
+    try:
+        engine.start()
+        free0 = engine.pool.available()
+        doomed = engine.submit(p_doomed, max_new_tokens=120,
+                               deadline_s=0.3)
+        ok = engine.submit(p_ok, max_new_tokens=8)
+        assert ok.wait(timeout=120) == want_ok
+        with pytest.raises(RuntimeError, match="deadline"):
+            doomed.wait(timeout=60)
+        assert doomed.error_kind == "deadline"
+        deadline = time.monotonic() + 10
+        while engine.pool.available() != free0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert engine.pool.available() == free0   # pages all freed
+    finally:
+        engine.stop(drain=False)
+    evs = obs_events.read_events(chaos, kinds=["request_cancelled"])
+    mine = [e for e in evs if e["request"] == doomed.id]
+    assert mine and "deadline" in mine[0]["reason"]
+    assert mine[0]["deadline_s"] == 0.3
+
+
+class _StubPerfModel:
+    """Minimal learned-model stand-in: every batch step predicted to
+    take ``step_s`` seconds."""
+
+    def __init__(self, step_s):
+        self.step_s = step_s
+
+    def has(self, head):
+        return True
+
+    def predict(self, head, feats):
+        return self.step_s
+
+
+def test_deadline_doomed_rejected_up_front(gpt_model):
+    """Predicted-cost admission: a request whose full decode cannot
+    fit inside its deadline is rejected at submit, before burning a
+    batch slot on a stream that must be cancelled mid-flight."""
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8,
+                           perf_model=_StubPerfModel(10.0))
+    try:
+        engine.start()
+        req = engine.submit([1, 2, 3], max_new_tokens=8,
+                            deadline_s=0.5)
+        with pytest.raises(RuntimeError, match="deadline infeasible"):
+            req.wait(timeout=10)
+        assert req.error_kind == "deadline"
+        # no deadline -> the same request is served normally
+        free = engine.submit([1, 2, 3], max_new_tokens=4)
+        assert len(free.wait(timeout=120)) == 4
+    finally:
+        engine.stop(drain=False)
+
+
+def test_http_deadline_maps_to_503(gpt_model, flags_guard):
+    """HTTP mapping: deadline_s rides the /generate spec and an
+    infeasible deadline answers 503 + Retry-After (try again / try
+    elsewhere), not 400 (the request itself is well-formed)."""
+    from paddle_tpu.inference.serving import InferenceServer
+    set_flags({"FLAGS_serving_engine": True})
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8,
+                           perf_model=_StubPerfModel(10.0))
+    engine.start()
+    srv = InferenceServer(engine=engine, max_in_flight=8).start()
+    try:
+        body = json.dumps({"input_ids": [1, 2, 3],
+                           "max_new_tokens": 8,
+                           "deadline_s": 0.25}).encode()
+        req = urllib.request.Request(srv.url + "/generate", data=body,
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") is not None
+    finally:
+        srv.stop()
+        engine.stop(drain=False)
+
+
+@pytest.mark.chaos
+def test_stop_detects_wedged_loop(gpt_model, chaos):
+    """satellite: stop() on a wedged loop thread does not hang or lie
+    — the failed join is detected, the flight recorder dumps, and the
+    wedge is surfaced in stop()'s return and stats()."""
+    from paddle_tpu.resilience import faults
+    faults.install_schedule("serving_step@2=stall:3")
+    engine = ServingEngine(gpt_model, max_batch=2, page_size=8)
+    try:
+        engine.start()
+        req = engine.submit([1, 2, 3, 4], max_new_tokens=6)
+        deadline = time.monotonic() + 60
+        while not req.tokens and time.monotonic() < deadline:
+            time.sleep(0.02)                 # wait for prefill commit
+        assert req.tokens                    # step 2 (the stall) is next
+        time.sleep(0.3)                      # let the loop enter it
+        st = engine.stop(drain=False, join_timeout=0.3)
+    finally:
+        faults.install_schedule(None)
+    assert st["wedged"] is True
+    assert st["health"] == "failed"
+    assert engine.stats()["wedged_threads"] == 1
+
+
+# -- lint scopes: the containment layer is PTL401/PTL701 territory ----------
+
+_ENGINE_PTL401_BAD = '''
+def recover_from_stall(url):
+    try:
+        return relaunch(url)
+    except Exception:
+        return None
+'''
+
+_ENGINE_PTL701_BAD = '''
+import numpy as np
+
+def watchdog_tick(batch):
+    x = np.asarray(batch.tokens)
+    if batch.mask.all():
+        return x.item()
+    return None
+'''
+
+
+def test_engine_files_in_ptl401_scope():
+    """serving/engine.py + scheduler.py joined the PTL401 scope with
+    the containment layer: a swallowed exception in a quarantine /
+    relaunch path would BE the silent truncation this PR exists to
+    prevent."""
+    from paddle_tpu.analysis.lint import lint_source
+    for fn in ("paddle_tpu/serving/engine.py",
+               "paddle_tpu/serving/scheduler.py"):
+        findings = lint_source(_ENGINE_PTL401_BAD, filename=fn)
+        assert any(f.code == "PTL401" for f in findings), fn
+    findings = lint_source(_ENGINE_PTL401_BAD,
+                           filename="paddle_tpu/vision/thing.py")
+    assert not any(f.code == "PTL401" for f in findings)
+
+
+def test_watchdog_names_in_ptl701_hot_scope():
+    """watchdog/quarantine/recover joined SERVING_HOT_NAMES: host
+    syncs inside the containment machinery would serialize the very
+    loop it guards."""
+    from paddle_tpu.analysis.lint import lint_source
+    findings = lint_source(_ENGINE_PTL701_BAD,
+                           filename="paddle_tpu/serving/engine.py")
+    codes = [f.code for f in findings]
+    assert codes.count("PTL701") >= 3       # asarray, .all(), .item()
+    # cold names in the same file stay out of scope
+    cold = _ENGINE_PTL701_BAD.replace("watchdog_tick", "build_table")
+    findings = lint_source(cold,
+                           filename="paddle_tpu/serving/engine.py")
+    assert not any(f.code == "PTL701" for f in findings)
