@@ -387,9 +387,12 @@ def _measure_serving(on_tpu):
     seq_s = time.perf_counter() - t0
     seq_tps = n_streams * n_new / seq_s
 
-    def _engine_run(n_fused):
+    def _engine_run(n_fused, sanitizer=False):
         """One timed engine pass at FLAGS_serving_fused_steps=n_fused;
-        host syncs + iterations counted off the dispatch stream."""
+        host syncs + iterations counted off the dispatch stream.
+        ``sanitizer=True`` runs the same traffic with
+        FLAGS_lock_sanitizer on (instrumented locks) for the overhead
+        comparison."""
         marks = {"syncs": 0, "steps": 0}
 
         def _hook(ev):
@@ -397,8 +400,14 @@ def _measure_serving(on_tpu):
                 marks["syncs"] += 1
                 marks["steps"] += int(ev.in_avals[0][0][0])
 
-        keep = get_flags(["FLAGS_serving_fused_steps"])
-        set_flags({"FLAGS_serving_fused_steps": n_fused})
+        keep = get_flags(["FLAGS_serving_fused_steps",
+                          "FLAGS_lock_sanitizer"])
+        set_flags({"FLAGS_serving_fused_steps": n_fused,
+                   "FLAGS_lock_sanitizer": bool(sanitizer)})
+        if sanitizer:
+            from paddle_tpu.observability.lockwatch import \
+                reset_lockwatch
+            reset_lockwatch()
         try:
             engine = ServingEngine(model, max_batch=n_streams,
                                    page_size=16, prefix_caching=False)
@@ -448,6 +457,17 @@ def _measure_serving(on_tpu):
 
     single = _engine_run(1)
     fused = _engine_run(fused_steps)
+    # lock-sanitizer overhead gate: the same fused traffic with
+    # FLAGS_lock_sanitizer on — instrumented locks (order-graph check
+    # per acquire) must cost < 15% tokens/sec, or the chaos tier gets
+    # too slow to run the sanitizer by default
+    sanitized = _engine_run(fused_steps, sanitizer=True)
+    tps_off = fused["tokens_per_sec"]
+    tps_on = sanitized["tokens_per_sec"]
+    overhead = max(0.0, 1.0 - tps_on / max(tps_off, 1e-9))
+    assert overhead < 0.15, (
+        f"lock sanitizer overhead {overhead:.1%} >= 15% "
+        f"({tps_on} vs {tps_off} tokens/sec)")
     eng_tps = single["tokens_per_sec"]
     return {
         "model": "gpt-4l-h128", "streams": n_streams,
@@ -463,6 +483,11 @@ def _measure_serving(on_tpu):
             fused["tokens_per_sec"] / max(eng_tps, 1e-9), 3),
         "host_sync_reduction": round(
             single["host_syncs"] / max(fused["host_syncs"], 1), 2),
+        "lock_sanitizer": {
+            "tokens_per_sec_off": tps_off,
+            "tokens_per_sec_on": tps_on,
+            "overhead_frac": round(overhead, 4),
+        },
     }
 
 

@@ -29,6 +29,14 @@ severity levels, per-line ``# noqa: PTLxxx`` suppression, JSON output):
   Stdlib-only; rides ``lint_source`` behind path predicates.  Its
   runtime twin is the ``FLAGS_collective_sanitizer`` fingerprint
   cross-check in ``distributed/communication/sanitizer.py``.
+* **concheck** (PTL9xx) — static concurrency rules over the threaded
+  serving tier: lock-order cycles via a call-graph-closed acquisition
+  graph (PTL901), unsynchronized shared state (PTL902), condition-wait
+  hygiene (PTL903), thread-lifecycle / epoch-fence hygiene (PTL904),
+  plus the stale-noqa sweep (PTL905, ``--stale-noqa``).  Stdlib-only;
+  rides ``lint_source`` behind path predicates.  Its runtime twin is
+  the ``FLAGS_lock_sanitizer`` lock-graph sanitizer in
+  ``observability/lockwatch.py``.
 
 Import cost mirrors the passes: ``rules``/``lint``/``shardcheck``
 import no jax; the other passes import the framework lazily inside
@@ -36,7 +44,10 @@ their entry points.
 """
 from .rules import (ERROR, INFO, RULES, WARNING, Finding, Rule,
                     has_errors, make_finding, max_severity)
-from .lint import is_surface_path, lint_file, lint_paths, lint_source
+from .concheck import (PTL902_ALLOWLIST, concheck_findings_source,
+                       is_concurrency_path)
+from .lint import (is_surface_path, lint_file, lint_paths, lint_source,
+                   stale_noqa_paths)
 from .shardcheck import (STRATEGY_KNOB_HANDLERS, is_shard_path,
                          is_strategy_path, shard_findings_source,
                          strategy_findings_source)
@@ -45,8 +56,11 @@ __all__ = [
     "ERROR", "WARNING", "INFO", "RULES", "Rule", "Finding",
     "make_finding", "max_severity", "has_errors",
     "lint_source", "lint_file", "lint_paths", "is_surface_path",
+    "stale_noqa_paths",
     "is_shard_path", "is_strategy_path", "shard_findings_source",
     "strategy_findings_source", "STRATEGY_KNOB_HANDLERS",
+    "is_concurrency_path", "concheck_findings_source",
+    "PTL902_ALLOWLIST",
     "check_registry", "analyze", "inspect_static_fn", "stream_report",
     "check_jaxpr", "verify_registered_passes", "main",
 ]

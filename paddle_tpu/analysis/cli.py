@@ -10,6 +10,7 @@ the package + jax, so it is opt-in for speed).
     python -m paddle_tpu.analysis --registry             # registry pass
     python -m paddle_tpu.analysis examples/ --select PTL001,PTL006
     python -m paddle_tpu.analysis paddle_tpu/ --ignore PTL501,PTL701
+    python -m paddle_tpu.analysis paddle_tpu/ --stale-noqa  # PTL905 sweep
 
 ``--select`` keeps only the named codes; ``--ignore`` drops the named
 codes; when both name the same code, ignore wins.  Exit-code semantics
@@ -71,6 +72,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--ignore", metavar="CODES",
                     help="comma-separated PTL codes to drop (applied "
                          "after --select; ignore wins on overlap)")
+    ap.add_argument("--stale-noqa", action="store_true",
+                    help="also report noqa comments whose rule no "
+                         "longer fires on that line (PTL905, warning "
+                         "severity — never gates)")
     ap.add_argument("--registry", action="store_true",
                     help="also run the op-registry consistency check "
                          "(imports paddle_tpu + jax)")
@@ -96,6 +101,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .lint import lint_paths
         findings.extend(lint_paths(args.paths, select=select,
                                    ignore=ignore))
+        if args.stale_noqa:
+            from .lint import stale_noqa_paths
+            stale = stale_noqa_paths(args.paths)
+            if select is not None:
+                stale = [f for f in stale if f.code in select]
+            if ignore is not None:
+                stale = [f for f in stale if f.code not in ignore]
+            findings.extend(stale)
 
     if args.registry:
         from .registry_check import check_registry

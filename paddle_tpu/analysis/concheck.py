@@ -1,0 +1,909 @@
+"""PTL9xx — concurrency rules for the threaded serving tier.
+
+PRs 17-19 made the serving tier genuinely concurrent: the iteration
+loop, the hung-step watchdog with epoch-fenced relaunches, supervisor
+restart threads, router poll threads, and per-request stream queues
+all share state under a handful of ``threading`` locks.  The only
+thing standing between that and a deadlock or torn read is
+convention — these rules turn the conventions into machine checks:
+
+* **PTL901 — lock-order consistency.**  Builds a per-module
+  lock-acquisition graph from ``with self._lock:`` / ``.acquire()``
+  nesting, closed over the intra-class/intra-module call graph.  Any
+  cycle between two named locks is an error: two threads taking the
+  same pair of locks in opposite orders is the textbook deadlock, and
+  on a serving replica it wedges the whole engine until the fleet
+  router drains it.
+* **PTL902 — unsynchronized shared-state access.**  An attribute
+  accessed under a lock somewhere and written (or read while
+  lock-written) lock-free elsewhere in the same class is a torn-read /
+  lost-update hazard.  Deliberate GIL-atomic patterns carry a
+  ``# noqa: PTL902`` with a one-line justification; a small allowlist
+  (:data:`PTL902_ALLOWLIST`) covers the documented poller-published
+  scalars and registry-backed counters.
+* **PTL903 — condition-wait hygiene.**  ``Condition.wait()`` outside a
+  ``while``-predicate loop misses wakeups and suffers spurious ones;
+  ``notify()`` without holding the owning lock races the waiter's
+  predicate re-check.
+* **PTL904 — thread-lifecycle hygiene.**  A ``threading.Thread``
+  started without a daemon/join decision leaks past process shutdown;
+  an epoch-guard comparison (``... != self._epoch``) evaluated outside
+  the lock that fences the epoch lets a zombie thread commit into the
+  relaunched engine's state.
+
+Runtime twin: ``paddle_tpu.observability.lockwatch``
+(``FLAGS_lock_sanitizer``) — instrumented Lock/RLock/Condition
+wrappers that detect wait-for cycles at acquire time and raise
+``LockOrderError`` instead of hanging, the same static graph enforced
+against actual execution.
+
+Scope: the threaded tier only (:data:`CONCURRENCY_GLOBS`).  Like every
+``analysis`` module this file is stdlib-only — it must import neither
+jax nor paddle_tpu runtime modules.
+"""
+import ast
+import fnmatch
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import Finding, make_finding
+
+__all__ = [
+    "CONCURRENCY_GLOBS", "PTL902_ALLOWLIST", "is_concurrency_path",
+    "concheck_findings_source",
+]
+
+# the threaded scope: serving tier (engine/scheduler/fleet), the
+# resilience supervisor, observability writers, the inference HTTP
+# server, and the TCP coordination store (fnmatch '*' crosses '/')
+CONCURRENCY_GLOBS = (
+    "*/serving/*.py",
+    "*/resilience/*.py",
+    "*/observability/*.py",
+    "*/inference/serving.py",
+    "*/distributed/communication/store.py",
+)
+
+
+def is_concurrency_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return any(fnmatch.fnmatch(p, g) for g in CONCURRENCY_GLOBS)
+
+
+# Attributes exempt from PTL902 by design, not by accident — each is a
+# single GIL-atomic scalar published by exactly one writer thread for
+# racy-but-monotonic consumption (the reader tolerates one stale
+# poll):
+#   healthy / queue_depth / occupancy / health_state — the fleet
+#     ReplicaHandle scalars the poll thread publishes and the router
+#     reads; documented "last completed poll wins" in fleet/replica.py.
+PTL902_ALLOWLIST: Set[str] = {
+    "healthy", "queue_depth", "occupancy", "health_state",
+}
+
+# dotted-callee tails that create a lock-like object
+_LOCK_CTORS = ("Lock", "RLock", "allocate_lock", "make_lock", "make_rlock")
+_COND_CTORS = ("Condition", "make_condition")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X`` (one level only)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# lock discovery (per class / per module)
+# ---------------------------------------------------------------------------
+
+class _LockEnv:
+    """The lock vocabulary of one class (or of the module top level).
+
+    ``canon`` maps a local attribute/global name to a canonical
+    owner-qualified lock id: ``Condition(self._lock)`` aliases the
+    condition to the wrapped lock (the engine's ``_wake`` IS ``_lock``
+    — treating them as two locks would invent a false PTL901 cycle).
+    A class env chains to the module env so methods using module-level
+    locks (``with _REG_LOCK:``) still participate in the graph.
+    """
+
+    def __init__(self, owner: str, parent: Optional["_LockEnv"] = None):
+        self.owner = owner
+        self.parent = parent
+        self.canon: Dict[str, str] = {}
+        self.conditions: Set[str] = set()    # canonical ids
+
+    def add_lock(self, name: str) -> None:
+        self.canon.setdefault(name, "%s.%s" % (self.owner, name))
+
+    def add_condition(self, name: str,
+                      wrapped: Optional[str] = None) -> None:
+        if wrapped is not None and wrapped in self.canon:
+            self.canon[name] = self.canon[wrapped]
+        else:
+            self.canon.setdefault(name, "%s.%s" % (self.owner, name))
+        self.conditions.add(self.canon[name])
+
+    def resolve(self, name: Optional[str]) -> Optional[str]:
+        if name is None:
+            return None
+        return self.canon.get(name)
+
+    def resolve_global(self, name: Optional[str]) -> Optional[str]:
+        env: Optional[_LockEnv] = self
+        while env is not None:
+            got = env.canon.get(name) if name is not None else None
+            if got is not None:
+                return got
+            env = env.parent
+        return None
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    """'lock' / 'condition' when the call constructs a lock-like."""
+    base = _dotted(call.func)
+    if base is None:
+        return None
+    tail = base.rsplit(".", 1)[-1]
+    if tail in _LOCK_CTORS:
+        return "lock"
+    if tail in _COND_CTORS:
+        return "condition"
+    return None
+
+
+def _discover_locks(body: Sequence[ast.stmt], env: _LockEnv,
+                    self_based: bool) -> None:
+    """Register lock/condition attributes created anywhere in *body*.
+
+    ``self_based`` selects ``self.X = ...`` targets (class scan) vs
+    bare ``NAME = ...`` targets (module scan).  A second sweep
+    registers bare ``with self.X:`` / ``self.X.acquire()`` names that
+    were constructed out of sight (injected locks).
+    """
+    for node in ast.walk(_Suite(body)):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            kind = _ctor_kind(node.value)
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                name = (_self_attr(tgt) if self_based
+                        else (tgt.id if isinstance(tgt, ast.Name) else None))
+                if name is None:
+                    continue
+                if kind == "lock":
+                    env.add_lock(name)
+                else:
+                    wrapped = None
+                    for arg in node.value.args:
+                        a = _self_attr(arg) if self_based else (
+                            arg.id if isinstance(arg, ast.Name) else None)
+                        if a is not None and a in env.canon:
+                            wrapped = a
+                            break
+                    env.add_condition(name, wrapped)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                name = (_self_attr(item.context_expr) if self_based
+                        else (item.context_expr.id
+                              if isinstance(item.context_expr, ast.Name)
+                              else None))
+                # a bare (non-call) lock-named context manager on
+                # self/module scope is a lock we did not see built —
+                # e.g. an injected `self._lock = lock`
+                if name is not None and name not in env.canon:
+                    env.add_lock(name)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in ("acquire", "release")):
+            name = (_self_attr(node.func.value) if self_based
+                    else (node.func.value.id
+                          if isinstance(node.func.value, ast.Name)
+                          else None))
+            if name is not None and name not in env.canon:
+                env.add_lock(name)
+
+
+class _Suite(ast.stmt):
+    """Wrap a statement list so ast.walk can traverse it."""
+
+    _fields = ("body",)
+
+    def __init__(self, body):
+        self.body = list(body)
+
+
+# ---------------------------------------------------------------------------
+# per-function event walk
+# ---------------------------------------------------------------------------
+
+class _Acquire:
+    __slots__ = ("lock", "line", "held")
+
+    def __init__(self, lock, line, held):
+        self.lock, self.line, self.held = lock, line, tuple(held)
+
+
+class _Access:
+    __slots__ = ("attr", "write", "line", "col", "locked", "fn")
+
+    def __init__(self, attr, write, line, col, locked, fn):
+        self.attr, self.write = attr, write
+        self.line, self.col = line, col
+        self.locked, self.fn = locked, fn
+
+
+class _CallEvent:
+    __slots__ = ("callee", "line", "held")
+
+    def __init__(self, callee, line, held):
+        self.callee, self.line, self.held = callee, line, tuple(held)
+
+
+class _WaitEvent:
+    __slots__ = ("cond", "line", "col", "in_while", "locked")
+
+    def __init__(self, cond, line, col, in_while, locked):
+        self.cond, self.line, self.col = cond, line, col
+        self.in_while, self.locked = in_while, locked
+
+
+class _NotifyEvent:
+    __slots__ = ("cond", "line", "col", "holds_owner")
+
+    def __init__(self, cond, line, col, holds_owner):
+        self.cond, self.line, self.col = cond, line, col
+        self.holds_owner = holds_owner
+
+
+class _ThreadEvent:
+    __slots__ = ("line", "col", "daemon_decided", "bind")
+
+    def __init__(self, line, col, daemon_decided, bind):
+        self.line, self.col = line, col
+        self.daemon_decided = daemon_decided
+        self.bind = bind          # ('self', attr) | ('local', name) | None
+
+
+class _EpochEvent:
+    __slots__ = ("attr", "line", "col", "locked", "fn")
+
+    def __init__(self, attr, line, col, locked, fn):
+        self.attr, self.line, self.col = attr, line, col
+        self.locked, self.fn = locked, fn
+
+
+class _FnEvents:
+    def __init__(self, name: str):
+        self.name = name
+        self.acquires: List[_Acquire] = []
+        self.accesses: List[_Access] = []
+        self.calls: List[_CallEvent] = []
+        self.waits: List[_WaitEvent] = []
+        self.notifies: List[_NotifyEvent] = []
+        self.threads: List[_ThreadEvent] = []
+        self.epochs: List[_EpochEvent] = []
+        self.joined: Set[str] = set()        # names .join()ed / .daemon= set
+        self.method_refs: Set[str] = set()   # self.m referenced uncalled
+
+
+class _FnWalker:
+    """Walk one function body tracking the held-lock set linearly.
+
+    Nested ``def``/``lambda`` bodies run later on some other thread, so
+    they are walked with an *empty* held set and attributed to a child
+    event record.
+    """
+
+    def __init__(self, env: _LockEnv, fn: _FnEvents,
+                 children: List[_FnEvents], self_based: bool):
+        self.env = env
+        self.fn = fn
+        self.children = children
+        self.self_based = self_based
+
+    # -- name resolution ----------------------------------------------------
+    def _lock_of(self, node: ast.AST) -> Optional[str]:
+        attr = _self_attr(node)
+        if attr is not None:
+            return self.env.resolve(attr) if self.self_based else None
+        if isinstance(node, ast.Name):
+            # bare names resolve through the module env too, so class
+            # methods using module-level locks stay in the graph
+            return self.env.resolve_global(node.id)
+        return None
+
+    # -- statement walk -----------------------------------------------------
+    def walk(self, stmts: Sequence[ast.stmt], held: Tuple[str, ...],
+             in_while: bool) -> None:
+        held = tuple(held)
+        for stmt in stmts:
+            held = self._stmt(stmt, held, in_while)
+
+    def _stmt(self, stmt: ast.stmt, held: Tuple[str, ...],
+              in_while: bool) -> Tuple[str, ...]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._nested_def(stmt)
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                self._expr(item.context_expr, held, in_while, reads=True)
+                if lock is not None:
+                    self.fn.acquires.append(
+                        _Acquire(lock, stmt.lineno, inner))
+                    if lock not in inner:
+                        inner = inner + (lock,)
+            self.walk(stmt.body, inner, in_while)
+            return held
+        if isinstance(stmt, ast.Expr):
+            call = stmt.value
+            if isinstance(call, ast.Call) and isinstance(
+                    call.func, ast.Attribute):
+                lock = self._lock_of(call.func.value)
+                if lock is not None and call.func.attr == "acquire":
+                    self.fn.acquires.append(
+                        _Acquire(lock, stmt.lineno, held))
+                    self._expr(call, held, in_while)
+                    if lock not in held:
+                        held = held + (lock,)
+                    return held
+                if lock is not None and call.func.attr == "release":
+                    self._expr(call, held, in_while)
+                    return tuple(h for h in held if h != lock)
+            self._expr(stmt.value, held, in_while)
+            return held
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assign(stmt, held, in_while)
+            return held
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held, in_while)
+            self.walk(stmt.body, held, True)
+            self.walk(stmt.orelse, held, in_while)
+            return held
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter, held, in_while)
+            self.walk(stmt.body, held, in_while)
+            self.walk(stmt.orelse, held, in_while)
+            return held
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, held, in_while)
+            self.walk(stmt.body, held, in_while)
+            self.walk(stmt.orelse, held, in_while)
+            return held
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body, held, in_while)
+            for handler in stmt.handlers:
+                self.walk(handler.body, held, in_while)
+            self.walk(stmt.orelse, held, in_while)
+            self.walk(stmt.finalbody, held, in_while)
+            return held
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child, held, in_while)
+            return held
+        if isinstance(stmt, ast.ClassDef):
+            return held
+        # default: visit embedded expressions generically
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held, in_while)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, held, in_while)
+        return held
+
+    def _nested_def(self, node) -> None:
+        child = _FnEvents("%s.<local %s>" % (self.fn.name, node.name))
+        self.children.append(child)
+        walker = _FnWalker(self.env, child, self.children, self.self_based)
+        walker.walk(node.body, (), False)
+        # join/daemon decisions inside the closure count for the
+        # enclosing function's thread bookkeeping (replica's _restart)
+        self.fn.joined.update(child.joined)
+
+    # -- assignment ---------------------------------------------------------
+    def _assign(self, stmt, held, in_while) -> None:
+        if isinstance(stmt, ast.AugAssign):
+            attr = _self_attr(stmt.target)
+            if attr is not None and self.self_based:
+                self._record_access(attr, True, stmt.target, held)
+                self._record_access(attr, False, stmt.target, held)
+            self._expr(stmt.value, held, in_while)
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else (
+            [stmt.target] if stmt.target is not None else [])
+        value = stmt.value
+        if value is not None:
+            # thread creation bound to a name: Thread(...) kwargs plus
+            # later X.join()/X.daemon decide PTL904
+            tev = self._thread_ctor(value)
+            if tev is not None:
+                bind = None
+                for tgt in targets:
+                    a = _self_attr(tgt)
+                    if a is not None:
+                        bind = ("self", a)
+                    elif isinstance(tgt, ast.Name):
+                        bind = ("local", tgt.id)
+                tev.bind = bind
+                self.fn.threads.append(tev)
+                for arg in ast.walk(value):
+                    if arg is not value:
+                        self._mark_refs(arg)
+            else:
+                self._expr(value, held, in_while)
+        for tgt in targets:
+            # t.daemon = True is a lifecycle decision, not state access
+            if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"):
+                base = _dotted(tgt.value)
+                if base is not None:
+                    self.fn.joined.add(base)
+                continue
+            attr = _self_attr(tgt)
+            if attr is not None and self.self_based:
+                self._record_access(attr, True, tgt, held)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in tgt.elts:
+                    a = _self_attr(elt)
+                    if a is not None and self.self_based:
+                        self._record_access(a, True, elt, held)
+            elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                self._expr(tgt, held, in_while, reads=True)
+
+    def _record_access(self, attr, write, node, held) -> None:
+        if self.env.resolve(attr) is not None:
+            return                        # locks themselves are not state
+        self.fn.accesses.append(_Access(
+            attr, write, node.lineno, node.col_offset, bool(held),
+            self.fn.name))
+        if "epoch" in attr.lower():
+            # raw reads feed the epoch events only via comparisons
+            pass
+
+    def _thread_ctor(self, node: ast.AST) -> Optional[_ThreadEvent]:
+        if not isinstance(node, ast.Call):
+            return None
+        base = _dotted(node.func)
+        if base is None or base.rsplit(".", 1)[-1] != "Thread":
+            return None
+        daemon = any(kw.arg == "daemon" for kw in node.keywords)
+        return _ThreadEvent(node.lineno, node.col_offset, daemon, None)
+
+    def _mark_refs(self, node: ast.AST) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self.fn.method_refs.add(attr)
+
+    # -- expression walk ----------------------------------------------------
+    def _expr(self, node: ast.AST, held, in_while, reads=True) -> None:
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub, held, in_while)
+            elif isinstance(sub, ast.Attribute):
+                attr = _self_attr(sub)
+                if (attr is not None and self.self_based
+                        and isinstance(sub.ctx, ast.Load)
+                        and not self._is_callee(sub, node)):
+                    self._record_access(attr, False, sub, held)
+                    # an uncalled self.m load is a callback/thread
+                    # target: it bars m from locked-only promotion
+                    self.fn.method_refs.add(attr)
+            elif isinstance(sub, ast.Compare):
+                self._compare(sub, held)
+            elif isinstance(sub, (ast.Lambda,)):
+                child = _FnEvents("%s.<lambda>" % self.fn.name)
+                self.children.append(child)
+                walker = _FnWalker(self.env, child, self.children,
+                                   self.self_based)
+                walker._expr(sub.body, (), False)
+
+    def _is_callee(self, attr_node: ast.Attribute,
+                   scope: ast.AST) -> bool:
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call) and sub.func is attr_node:
+                return True
+        return False
+
+    def _call(self, call: ast.Call, held, in_while) -> None:
+        func = call.func
+        # inline Thread(...).start() with no binding
+        tev = self._thread_ctor(call)
+        if tev is not None:
+            self.fn.threads.append(tev)
+            for sub in ast.walk(call):
+                if sub is not call:
+                    self._mark_refs(sub)
+            return
+        if isinstance(func, ast.Attribute):
+            base_lock = self._lock_of(func.value)
+            if base_lock is not None:
+                if func.attr == "wait":
+                    if base_lock in self.env.conditions:
+                        self.fn.waits.append(_WaitEvent(
+                            base_lock, call.lineno, call.col_offset,
+                            in_while, base_lock in held))
+                    return
+                if func.attr in ("notify", "notify_all"):
+                    if base_lock in self.env.conditions:
+                        self.fn.notifies.append(_NotifyEvent(
+                            base_lock, call.lineno, call.col_offset,
+                            base_lock in held))
+                    return
+                if func.attr in ("acquire", "release"):
+                    # expression-position acquire (e.g. `if X.acquire`)
+                    # conservatively records the edge but not the hold
+                    if func.attr == "acquire":
+                        self.fn.acquires.append(
+                            _Acquire(base_lock, call.lineno, held))
+                    return
+            # .join() / thread-lifecycle bookkeeping
+            if func.attr == "join":
+                base = _dotted(func.value)
+                if base is not None:
+                    self.fn.joined.add(base)
+            # self.method(...) -> call-graph edge
+            attr = _self_attr(func)
+            if attr is not None:
+                self.fn.calls.append(
+                    _CallEvent(attr, call.lineno, held))
+        elif isinstance(func, ast.Name):
+            self.fn.calls.append(
+                _CallEvent(func.id, call.lineno, held))
+
+    def _compare(self, node: ast.Compare, held) -> None:
+        for side in [node.left] + list(node.comparators):
+            attr = _self_attr(side)
+            if attr is not None and "epoch" in attr.lower():
+                self.fn.epochs.append(_EpochEvent(
+                    attr, node.lineno, node.col_offset, bool(held),
+                    self.fn.name))
+
+
+# ---------------------------------------------------------------------------
+# scope analysis (one class, or the module top level)
+# ---------------------------------------------------------------------------
+
+class _ScopeReport:
+    def __init__(self, owner: str, env: _LockEnv):
+        self.owner = owner
+        self.env = env
+        self.fns: Dict[str, _FnEvents] = {}
+        self.extra: List[_FnEvents] = []     # nested defs / lambdas
+
+    def all_events(self):
+        for fn in self.fns.values():
+            yield fn
+        for fn in self.extra:
+            yield fn
+
+
+def _analyze_scope(owner: str, body: Sequence[ast.stmt],
+                   self_based: bool,
+                   parent: Optional[_LockEnv] = None) -> _ScopeReport:
+    env = _LockEnv(owner, parent=parent)
+    _discover_locks(body, env, self_based)
+    report = _ScopeReport(owner, env)
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = _FnEvents(node.name)
+            report.fns[node.name] = fn
+            walker = _FnWalker(env, fn, report.extra, self_based)
+            walker.walk(node.body, (), False)
+    return report
+
+
+def _acquire_closure(report: _ScopeReport) -> Dict[str, Set[str]]:
+    """Locks each named function (transitively) acquires."""
+    closure: Dict[str, Set[str]] = {
+        name: {a.lock for a in fn.acquires}
+        for name, fn in report.fns.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in report.fns.items():
+            for call in fn.calls:
+                extra = closure.get(call.callee)
+                if extra and not extra <= closure[name]:
+                    closure[name] |= extra
+                    changed = True
+    return closure
+
+
+def _always_held(report: _ScopeReport) -> Dict[str, Set[str]]:
+    """For each private method: locks held at EVERY in-class call site
+    (transitively — a caller's own always-held set counts).
+
+    Accesses inside such ``_relaunch_locked``-style helpers inherit the
+    callers' locked context, so the engine keeps them without a noqa on
+    every line.  Methods referenced uncalled (thread targets,
+    callbacks) never qualify — they run on their own thread.
+    """
+    referenced: Set[str] = set()
+    for fn in report.all_events():
+        referenced |= fn.method_refs
+    sites: Dict[str, List[Tuple[Tuple[str, ...], str]]] = {}
+    for fn in report.all_events():
+        for call in fn.calls:
+            sites.setdefault(call.callee, []).append(
+                (call.held, fn.name))
+    out: Dict[str, Set[str]] = {name: set() for name in report.fns}
+    changed = True
+    while changed:
+        changed = False
+        for name in report.fns:
+            if name in referenced:
+                continue
+            if not name.startswith("_") or name.startswith("__"):
+                continue
+            call_sites = sites.get(name)
+            if not call_sites:
+                continue
+            new: Optional[Set[str]] = None
+            for held, caller in call_sites:
+                eff = set(held) | out.get(caller, set())
+                new = eff if new is None else (new & eff)
+            new = new or set()
+            if new != out[name]:
+                out[name] = new
+                changed = True
+    return out
+
+
+def _effective_locked(ev, always: Dict[str, Set[str]]) -> bool:
+    return ev.locked or bool(always.get(ev.fn))
+
+
+# ---------------------------------------------------------------------------
+# PTL901 — lock-order graph + cycle detection
+# ---------------------------------------------------------------------------
+
+def _order_edges(report: _ScopeReport,
+                 closure: Dict[str, Set[str]]
+                 ) -> Dict[Tuple[str, str], int]:
+    """Directed edges held->acquired with a representative line."""
+    edges: Dict[Tuple[str, str], int] = {}
+    for fn in report.all_events():
+        for acq in fn.acquires:
+            for h in acq.held:
+                if h != acq.lock:
+                    edges.setdefault((h, acq.lock), acq.line)
+        for call in fn.calls:
+            if not call.held:
+                continue
+            for lock in closure.get(call.callee, ()):
+                for h in call.held:
+                    if h != lock:
+                        edges.setdefault((h, lock), call.line)
+    return edges
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], int]
+                 ) -> List[Tuple[List[str], int]]:
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    seen_cycles: Set[frozenset] = set()
+    out: List[Tuple[List[str], int]] = []
+    for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+        # path b ->* a closes a cycle through edge a->b
+        stack, prev = [b], {b: None}
+        found = False
+        while stack and not found:
+            cur = stack.pop()
+            for nxt in adj.get(cur, ()):
+                if nxt == a:
+                    prev[a] = cur
+                    found = True
+                    break
+                if nxt not in prev:
+                    prev[nxt] = cur
+                    stack.append(nxt)
+        if not found:
+            continue
+        path = [a]
+        cur = prev[a]
+        while cur is not None:
+            path.append(cur)
+            cur = prev[cur]
+        path.reverse()                     # b ... a
+        cycle = [a, b] + path[1:-1]
+        key = frozenset(cycle)
+        if key in seen_cycles:
+            continue
+        seen_cycles.add(key)
+        out.append((cycle, line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule passes
+# ---------------------------------------------------------------------------
+
+def _check_lock_order(report: _ScopeReport, filename: str,
+                      findings: List[Finding]) -> None:
+    closure = _acquire_closure(report)
+    edges = _order_edges(report, closure)
+    for cycle, line in _find_cycles(edges):
+        ids = list(cycle)
+        findings.append(make_finding(
+            "PTL901",
+            "lock-order cycle %s -> %s: two threads taking these locks "
+            "in opposite orders deadlock; pick one global order (the "
+            "runtime twin FLAGS_lock_sanitizer raises LockOrderError "
+            "at the same inversion)"
+            % (" -> ".join(ids), ids[0]),
+            file=filename, line=line))
+
+
+def _check_shared_state(report: _ScopeReport, filename: str,
+                        findings: List[Finding],
+                        all_sites: bool = False) -> None:
+    always = _always_held(report)
+    by_attr: Dict[str, List[_Access]] = {}
+    for fn in report.all_events():
+        for acc in fn.accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+    for attr in sorted(by_attr):
+        if attr in PTL902_ALLOWLIST or attr.startswith("__"):
+            continue
+        if attr in report.fns:
+            continue                        # method object, not state
+        accs = by_attr[attr]
+        outside_init = [a for a in accs
+                        if not a.fn.split(".", 1)[0] == "__init__"]
+        if not any(a.write for a in outside_init):
+            continue                        # immutable after __init__
+        locked = [a for a in outside_init
+                  if _effective_locked(a, always)]
+        unlocked = [a for a in outside_init
+                    if not _effective_locked(a, always)]
+        if not locked or not unlocked:
+            continue
+        if all_sites:
+            # stale-noqa view: every unlocked site is a candidate, so
+            # a suppression on ANY of them counts as live (one finding
+            # per line; a line with both prefers the write)
+            by_line: Dict[int, _Access] = {}
+            for a in sorted(unlocked, key=lambda a: (a.line, a.col)):
+                cur = by_line.get(a.line)
+                if cur is None or (a.write and not cur.write):
+                    by_line[a.line] = a
+            sites = [by_line[ln] for ln in sorted(by_line)]
+        else:
+            # prefer reporting an unlocked WRITE (lost update beats
+            # stale read); one finding per attribute
+            sites = [next((a for a in unlocked if a.write),
+                          unlocked[0])]
+        for site in sites:
+            kind = "write" if site.write else "read"
+            findings.append(make_finding(
+                "PTL902",
+                "unsynchronized %s of '%s.%s': accessed under a lock "
+                "in this class but lock-free here — torn read / lost "
+                "update hazard; hold the lock, or justify with "
+                "`# noqa: PTL902` if the access is a deliberate "
+                "GIL-atomic snapshot"
+                % (kind, report.owner, attr),
+                file=filename, line=site.line, col=site.col))
+
+
+def _check_condition_hygiene(report: _ScopeReport, filename: str,
+                             findings: List[Finding]) -> None:
+    always = _always_held(report)
+    for fn in report.all_events():
+        for w in fn.waits:
+            if not w.in_while:
+                findings.append(make_finding(
+                    "PTL903",
+                    "%s.wait() outside a while-predicate loop: spurious "
+                    "wakeups and missed-notify races require "
+                    "`while not pred: cv.wait()`" % w.cond,
+                    file=filename, line=w.line, col=w.col))
+        for n in fn.notifies:
+            held_here = (n.holds_owner
+                         or n.cond in always.get(fn.name, ()))
+            if not held_here:
+                findings.append(make_finding(
+                    "PTL903",
+                    "notify on %s without holding its lock: the waiter "
+                    "can re-check its predicate between your state "
+                    "write and this notify and sleep forever" % n.cond,
+                    file=filename, line=n.line, col=n.col))
+
+
+def _check_thread_lifecycle(report: _ScopeReport, filename: str,
+                            findings: List[Finding]) -> None:
+    joined: Set[str] = set()
+    for fn in report.all_events():
+        joined |= fn.joined
+    always = _always_held(report)
+    for fn in report.all_events():
+        for t in fn.threads:
+            if t.daemon_decided:
+                continue
+            if t.bind is not None:
+                kind, name = t.bind
+                ref = ("self.%s" % name) if kind == "self" else name
+                if ref in joined or name in joined:
+                    continue
+            elif fn.joined:
+                # an unbound Thread (comprehension/inline) in a
+                # function that joins threads: the join loop is the
+                # lifecycle decision
+                continue
+            findings.append(make_finding(
+                "PTL904",
+                "Thread started without a lifecycle decision: pass "
+                "daemon=..., or join() it on every exit path — "
+                "otherwise it outlives stop() and trips the test "
+                "suite's thread-leak guard",
+                file=filename, line=t.line, col=t.col))
+        for e in fn.epochs:
+            if not _effective_locked(e, always):
+                findings.append(make_finding(
+                    "PTL904",
+                    "epoch guard '%s.%s' compared outside the fencing "
+                    "lock: a zombie thread can pass a stale check and "
+                    "commit into the relaunched engine's state — read "
+                    "and compare the epoch under the lock that bumps it"
+                    % (report.owner, e.attr),
+                    file=filename, line=e.line, col=e.col))
+
+
+# ---------------------------------------------------------------------------
+# entry point (lint.py calls this behind is_concurrency_path)
+# ---------------------------------------------------------------------------
+
+def concheck_findings_source(source: str, filename: str,
+                             tree: Optional[ast.AST] = None,
+                             all_sites: bool = False
+                             ) -> List[Finding]:
+    """PTL901-904 over one source blob (fixture-testable core).
+
+    ``all_sites=True`` switches PTL902 from one-finding-per-attribute
+    to one per unlocked line — the stale-noqa sweep's view, where each
+    suppression must be matched against the exact line it lives on.
+    """
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=filename)
+        except SyntaxError:
+            return []
+    findings: List[Finding] = []
+    scopes: List[_ScopeReport] = []
+    module_body = [n for n in tree.body
+                   if not isinstance(n, ast.ClassDef)]
+    module_scope = _analyze_scope("<module>", module_body,
+                                  self_based=False)
+    scopes.append(module_scope)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            scopes.append(_analyze_scope(node.name, node.body,
+                                         self_based=True,
+                                         parent=module_scope.env))
+    for report in scopes:
+        _check_lock_order(report, filename, findings)
+        if report.owner != "<module>":
+            _check_shared_state(report, filename, findings,
+                                all_sites=all_sites)
+        _check_condition_hygiene(report, filename, findings)
+        _check_thread_lifecycle(report, filename, findings)
+    return findings

@@ -26,10 +26,13 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import io
 import os
 import re
+import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from .concheck import concheck_findings_source, is_concurrency_path
 from .rules import ERROR, WARNING, Finding, make_finding
 from .shardcheck import (is_shard_path, is_strategy_path,
                          shard_findings_source, strategy_findings_source)
@@ -845,14 +848,30 @@ def is_kernel_path(path: str) -> bool:
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
     """line -> None (bare noqa: suppress all) | set of codes.
 
-    ``# noqa: PTL801,PTL803 reason text`` takes any number of
-    comma/space-separated codes; token collection stops at the first
-    non-code token so trailing prose never dilutes the set.  A colon
-    followed by no valid code suppresses nothing (typo-safe), while a
-    bare ``# noqa`` suppresses everything on the line.
+    A suppression like ``noqa: PTL801,PTL803 reason text`` (after the
+    hash) takes any number of comma/space-separated codes; token
+    collection stops at the first non-code token so trailing prose
+    never dilutes the set.  A colon followed by no valid code
+    suppresses nothing (typo-safe), while a bare noqa suppresses
+    everything on the line.  Only real COMMENT tokens count — the same
+    text inside a docstring (e.g. this one) is documentation, not a
+    suppression.
     """
+    comments = []
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            ValueError):
+        # unparseable blob: fall back to the raw line scan so the
+        # suppression surface degrades rather than vanishing
+        comments = [(i, line)
+                    for i, line in enumerate(source.splitlines(), 1)
+                    if "#" in line]
     out: Dict[int, Optional[Set[str]]] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
+    for i, line in comments:
         m = _NOQA_RE.search(line)
         if not m:
             continue
@@ -883,10 +902,13 @@ def is_surface_path(path: str) -> bool:
 def lint_source(source: str, filename: str = "<string>",
                 surface: Optional[bool] = None,
                 select: Optional[Set[str]] = None,
-                ignore: Optional[Set[str]] = None) -> List[Finding]:
+                ignore: Optional[Set[str]] = None,
+                respect_noqa: bool = True) -> List[Finding]:
     """Lint one source blob.  ``surface=None`` infers from the path;
     ``select`` keeps only the named codes, ``ignore`` drops them
-    (ignore wins when a code appears in both)."""
+    (ignore wins when a code appears in both).  ``respect_noqa=False``
+    reports suppressed findings too — the stale-noqa sweep diffs the
+    two views."""
     if surface is None:
         surface = is_surface_path(filename)
     try:
@@ -929,7 +951,10 @@ def lint_source(source: str, filename: str = "<string>",
     if is_strategy_path(filename):
         findings.extend(
             strategy_findings_source(source, filename, tree=tree))
-    noqa = _collect_noqa(source)
+    if is_concurrency_path(filename):
+        findings.extend(
+            concheck_findings_source(source, filename, tree=tree))
+    noqa = _collect_noqa(source) if respect_noqa else {}
     out = []
     for f in findings:
         supp = noqa.get(f.line, "missing")
@@ -944,6 +969,65 @@ def lint_source(source: str, filename: str = "<string>",
         out.append(f)
     out.sort(key=lambda f: (f.file, f.line, f.col, f.code))
     return out
+
+
+# every code lint_source can emit with a trustworthy line number — the
+# stale-noqa sweep only judges these; whole-repo passes (registry,
+# cost-model, PTL502/601) have no per-line re-fire to compare against
+LINT_SOURCE_CODES: Set[str] = frozenset({
+    "PTL000", "PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
+    "PTL006", "PTL007", "PTL008", "PTL009", "PTL010",
+    "PTL401", "PTL501", "PTL602", "PTL603", "PTL701",
+    "PTL801", "PTL802", "PTL803", "PTL804",
+    "PTL901", "PTL902", "PTL903", "PTL904",
+})
+
+
+def stale_noqa_paths(paths: Sequence[str]) -> List[Finding]:
+    """PTL905: every ``# noqa: PTLxxx`` whose rule no longer fires on
+    that line (``python -m paddle_tpu.analysis --stale-noqa``).
+
+    Bare ``# noqa`` comments and codes outside
+    :data:`LINT_SOURCE_CODES` (whole-repo passes, foreign linters like
+    BLE001) are not judged — the sweep only reports suppressions it
+    can re-check exactly, so a PTL905 is always actionable.
+    """
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        noqa = _collect_noqa(source)
+        if not any(codes for codes in noqa.values()
+                   if codes is not None):
+            continue
+        fired: Dict[int, Set[str]] = {}
+        for f in lint_source(source, filename=path, respect_noqa=False):
+            fired.setdefault(f.line, set()).add(f.code)
+        if is_concurrency_path(path):
+            # PTL902 normally reports ONE site per attribute; the
+            # suppressions live per-line, so liveness needs the
+            # all-candidate-sites view or every noqa after the first
+            # would read as stale
+            for f in concheck_findings_source(source, path,
+                                              all_sites=True):
+                fired.setdefault(f.line, set()).add(f.code)
+        for line, codes in sorted(noqa.items()):
+            if codes is None:
+                continue
+            for code in sorted(codes):
+                if code not in LINT_SOURCE_CODES:
+                    continue
+                if code not in fired.get(line, ()):
+                    findings.append(make_finding(
+                        "PTL905",
+                        "stale suppression: %s no longer fires on this "
+                        "line — delete the noqa (it would silence a "
+                        "future real finding)" % code,
+                        file=path, line=line))
+    return findings
 
 
 def lint_file(path: str, select: Optional[Set[str]] = None,

@@ -30,6 +30,11 @@ Code space:
           DistributedStrategy knob coverage; see shardcheck.py — the
           runtime twin is the FLAGS_collective_sanitizer fingerprint
           cross-check in distributed/communication/sanitizer.py)
+  PTL9xx  concurrency rules (lock-order cycles, unsynchronized shared
+          state, condition-wait and thread-lifecycle hygiene over the
+          threaded serving tier, plus the stale-noqa sweep; see
+          concheck.py — the runtime twin is the FLAGS_lock_sanitizer
+          lock-graph sanitizer in observability/lockwatch.py)
 
 This module is stdlib-only on purpose: the AST linter must run without
 importing jax (fast CI pre-pass, editors, cold containers).
@@ -461,6 +466,82 @@ _rule(
     "(pass:<registered name>, layout:<mesh wiring>, flag:<FLAGS "
     "mirror>, or parity:<why it is accepted-and-ignored>), and keep "
     "the named pass registered in distributed/passes.")
+
+# ---------------------------------------------------------------------------
+# PTL9xx — concurrency rules (analysis/concheck.py; runtime twin:
+# observability/lockwatch.py behind FLAGS_lock_sanitizer)
+# ---------------------------------------------------------------------------
+
+_rule(
+    "PTL901", "lock-order-cycle", ERROR,
+    "two named locks are acquired in opposite orders on different "
+    "paths (cycle in the module's lock-acquisition graph)",
+    "The serving tier's iteration loop, watchdog, supervisor and "
+    "router threads interleave freely; a lock-order inversion is a "
+    "latent deadlock that fires only under the exact interleaving "
+    "chaos CI cannot enumerate.  The graph is built from `with lock:` "
+    "/ .acquire() nesting closed over the intra-module call graph, so "
+    "an inversion hidden behind a helper call is still a cycle.  A "
+    "wedged lock stalls the whole replica until the fleet router "
+    "drains it.",
+    "Pick one global acquisition order for the lock pair and restore "
+    "it on every path (release before taking the other lock, or hoist "
+    "the second acquisition); the runtime twin (FLAGS_lock_sanitizer) "
+    "raises LockOrderError at the same inversion.  A provably "
+    "single-threaded path takes '# noqa: PTL901' with a reason "
+    "comment.")
+_rule(
+    "PTL902", "unsynchronized-shared-state", ERROR,
+    "attribute accessed under a lock somewhere but read/written "
+    "lock-free elsewhere in the same class",
+    "A field the class protects with a lock in one method and touches "
+    "bare in another is a torn read or lost update waiting for "
+    "traffic — the PR 4 `_errors += 1` race class.  The GIL makes "
+    "single bytecode ops atomic, not read-modify-write sequences, and "
+    "not multi-field invariants.",
+    "Take the lock around the bare access, or — for a deliberate "
+    "GIL-atomic snapshot or monotonic flag — add '# noqa: PTL902' "
+    "with a one-line justification; poller-published scalars live in "
+    "analysis.concheck.PTL902_ALLOWLIST.")
+_rule(
+    "PTL903", "condition-wait-hygiene", WARNING,
+    "Condition.wait() outside a while-predicate loop, or notify() "
+    "without holding the condition's lock",
+    "wait() can return spuriously and can lose a notify that fired "
+    "before the waiter slept; only `while not predicate: cv.wait()` "
+    "under the lock is correct.  notify() outside the lock races the "
+    "waiter's predicate re-check: state write, waiter checks, notify "
+    "— the waiter sleeps forever.",
+    "Wrap the wait in a while loop over the guarded predicate and "
+    "hold the condition's lock around state-change + notify; a "
+    "timeout-only wait with no predicate takes '# noqa: PTL903' with "
+    "a reason comment.")
+_rule(
+    "PTL904", "thread-lifecycle-hygiene", WARNING,
+    "Thread started without a daemon/join decision, or an epoch-guard "
+    "comparison outside the lock that fences the epoch",
+    "A non-daemon thread nobody joins outlives stop() and hangs "
+    "process exit (the test suite's thread-leak guard fails it); an "
+    "epoch comparison outside the fencing lock lets a zombie thread "
+    "pass a stale check and commit into the relaunched engine's "
+    "state — the exact race the PR 19 watchdog epoch fence exists to "
+    "close.",
+    "Pass daemon=... at Thread construction or join() on every exit "
+    "path; read and compare epochs only under the lock that bumps "
+    "them.  A deliberately detached thread takes '# noqa: PTL904' "
+    "with a reason comment.")
+_rule(
+    "PTL905", "stale-noqa", WARNING,
+    "a '# noqa: PTLxxx' suppression whose rule no longer fires on "
+    "that line",
+    "Noqa comments accumulate: after a refactor the suppressed rule "
+    "may no longer fire, leaving a comment that silences a future, "
+    "real finding on that line and documents a hazard that no longer "
+    "exists.",
+    "Delete the stale suppression (re-run `python -m "
+    "paddle_tpu.analysis --stale-noqa` to confirm); if the rule is "
+    "only conditionally quiet (fixture-dependent), keep it and note "
+    "why.")
 
 
 def get_rule(code: str) -> Rule:

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ...observability.lockwatch import make_condition, make_lock
+
 __all__ = ["TCPStore", "Store"]
 
 
@@ -84,9 +86,9 @@ class TCPStore(Store):
         # distinct: the master's own client connection round-trips
         # through its server thread, which needs the data lock while the
         # client is still holding its socket lock
-        self._cv = threading.Condition(threading.Lock())
-        self._sock_lock = threading.Lock()
-        self._nlock = threading.Lock()  # atomicity of two-phase native get
+        self._cv = make_condition("comm.store._cv")
+        self._sock_lock = make_lock("comm.store._sock_lock")
+        self._nlock = make_lock("comm.store._nlock")  # atomicity of two-phase native get
         self._server = None
         self._sock = None
         self._nlib = None     # native C++ backend (see module docstring)
@@ -197,7 +199,8 @@ class TCPStore(Store):
                 self._host.encode(), self._port,
                 ctypes.c_double(remaining))
             if h:
-                self._ncli = h
+                with self._nlock:
+                    self._ncli = h
                 return
             time.sleep(0.1)
         # fall through to the python client's own retry/raise
@@ -218,7 +221,8 @@ class TCPStore(Store):
             try:
                 s = socket.create_connection((self._host, self._port),
                                              timeout=max(remaining, 0.5))
-                self._sock = s
+                with self._sock_lock:
+                    self._sock = s
                 return
             except OSError as e:
                 last = e
@@ -246,7 +250,7 @@ class TCPStore(Store):
 
     # -- single-shot primitives (native or python, identical semantics) --
     def _prim_set(self, key: str, value: bytes):
-        if self._ncli is not None:
+        if self._ncli is not None:  # noqa: PTL902 — write-once handle: set during __init__ connect, immutable before any client op runs
             # from_buffer_copy = one memcpy; splatting bytes as python
             # ints would be O(n) interpreter work on the hot path
             buf = ((ctypes.c_uint8 * len(value)).from_buffer_copy(value)
@@ -351,7 +355,7 @@ class TCPStore(Store):
                 self._nlib.pd_store_client_close(self._ncli)
             if self._nsrv is not None:
                 self._nlib.pd_store_server_stop(self._nsrv)
-            if self._sock is not None:
+            if self._sock is not None:  # noqa: PTL902 — write-once handle read at teardown, after all traffic
                 self._sock.close()
             if self._server is not None:
                 self._server.close()

@@ -285,6 +285,20 @@ define_flag("collective_sanitizer", False,
             "fingerprint streams (instead of the silent hang) and "
             "emit a collective_mismatch event. "
             "See paddle_tpu.distributed.communication.sanitizer")
+# read lazily by observability.lockwatch.make_lock/make_rlock/
+# make_condition at construction time — deliberately no on_change hook
+# (lockwatch imports observability for contention events, which must
+# not load during flag bootstrap).  Set it BEFORE building the engine/
+# router/supervisor: already-constructed objects keep their stdlib
+# locks.
+define_flag("lock_sanitizer", False,
+            "instrument the serving tier's Lock/RLock/Condition "
+            "objects: record per-thread held-lock sets, detect "
+            "lock-order (wait-for) cycles at acquire time and raise "
+            "LockOrderError naming both threads' hold stacks instead "
+            "of deadlocking; emit lock_contention events past "
+            "hold/wait thresholds and export paddle_lock_* metrics. "
+            "See paddle_tpu.observability.lockwatch")
 def _apply_observability_dir(path: str):
     """One flag, every telemetry stream (paddle_tpu.observability):
     the JSONL event log (step/compile/checkpoint/fault/restart/tuning/

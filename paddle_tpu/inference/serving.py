@@ -76,6 +76,7 @@ from . import Config, Predictor, create_predictor
 from ..observability import metrics as _metrics
 from ..observability import events as _events
 from ..observability import tracing as _tracing
+from ..observability.lockwatch import make_condition, make_lock
 from ..resilience.retry import with_retries
 
 __all__ = ["InferenceServer", "serve", "predict_http", "generate_http"]
@@ -121,8 +122,8 @@ class InferenceServer:
         self.engine = engine
         self.stream_timeout = float(stream_timeout)
         self.max_in_flight = int(max_in_flight)
-        self._lock = threading.Lock()          # predictor execution
-        self._state = threading.Condition()    # in-flight accounting
+        self._lock = make_lock("inference.serving._lock")     # predictor execution
+        self._state = make_condition("inference.serving._state")  # in-flight accounting
         self._in_flight = 0
         self._closing = False
         # registry-backed serving counters (atomic under concurrent

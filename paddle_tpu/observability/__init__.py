@@ -15,6 +15,13 @@ Two surfaces, one flag:
   and the crash/SIGTERM flight recorder.
 * :mod:`~paddle_tpu.observability.watchdog` — SLO regression gate over
   per-kind duration baselines from historical event logs.
+* :mod:`~paddle_tpu.observability.lockwatch` — the lock-graph
+  sanitizer behind ``FLAGS_lock_sanitizer``: instrumented
+  Lock/RLock/Condition factories for the serving tier that raise
+  ``LockOrderError`` on lock-order inversions (both threads' hold
+  stacks) instead of deadlocking, emit ``lock_contention`` events and
+  export ``paddle_lock_*`` metrics.  The runtime twin of the PTL9xx
+  static rules (``analysis/concheck.py``).
 
 CLI: ``python -m paddle_tpu.observability
 {snapshot,tail,report,trace,watchdog}``.
@@ -26,6 +33,9 @@ from . import metrics  # noqa: F401
 from . import events   # noqa: F401
 from . import tracing  # noqa: F401
 from . import watchdog  # noqa: F401
+from . import lockwatch  # noqa: F401
+from .lockwatch import (LockOrderError, make_lock, make_rlock,  # noqa: F401
+                        make_condition, reset_lockwatch)
 from .metrics import (counter, gauge, histogram, default_registry,  # noqa: F401
                       HistogramValue, MetricsRegistry)
 from .events import (emit, span, read_events, emit_dispatch_summary,  # noqa: F401
@@ -38,4 +48,6 @@ __all__ = ["metrics", "events", "tracing", "watchdog", "counter",
            "MetricsRegistry", "emit", "span", "read_events",
            "emit_dispatch_summary", "EVENT_SCHEMA", "start_span",
            "trace_span", "parse_traceparent", "format_traceparent",
-           "dump_flight", "flight_snapshot"]
+           "dump_flight", "flight_snapshot", "lockwatch",
+           "LockOrderError", "make_lock", "make_rlock",
+           "make_condition", "reset_lockwatch"]

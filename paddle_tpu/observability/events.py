@@ -185,6 +185,13 @@ EVENT_SCHEMA: Dict[str, Dict[str, str]] = {
                             "rank_a": "int", "rank_b": "int",
                             "fingerprint_a": "str",
                             "fingerprint_b": "str", "nranks": "int"},
+    # the lock sanitizer (observability.lockwatch) saw a wait or hold
+    # on an instrumented serving-tier lock cross its threshold —
+    # phase="wait" carries wait_s, phase="hold" carries held_s; site is
+    # the file:line that acquired the lock
+    "lock_contention": {"lock": "str", "phase": "str", "site": "str",
+                        "wait_s": "float", "held_s": "float",
+                        "thread": "str"},
 }
 
 _lock = threading.Lock()

@@ -103,7 +103,8 @@ class HistogramValue:
 
     @property
     def avg(self) -> float:
-        return self.sum / self.count if self.count else 0.0
+        with self._lock:
+            return self.sum / self.count if self.count else 0.0
 
     def quantile(self, q: float) -> float:
         """Bucket-interpolated quantile estimate (0 <= q <= 1)."""
@@ -130,7 +131,8 @@ class HistogramValue:
 
     def summary(self) -> Dict[str, float]:
         """Compact stats for reports/bench JSON."""
-        return {"count": self.count, "sum": round(self.sum, 6),
+        return {"count": self.count, "sum": round(self.sum, 6),  # noqa: PTL902 — report-time snapshot: one stale observation is acceptable in bench JSON
+
                 "avg": round(self.avg, 6),
                 "p50": round(self.quantile(0.5), 6),
                 "p90": round(self.quantile(0.9), 6),

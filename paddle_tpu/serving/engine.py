@@ -86,6 +86,7 @@ __all__ = ["ServingEngine"]
 from ..observability import events as _events
 from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..observability.lockwatch import make_condition, make_lock
 from ..resilience import faults as _faults
 from .prefix_cache import PrefixCache
 from .scheduler import PagePool, Request, Scheduler
@@ -263,8 +264,8 @@ class ServingEngine:
         self._c_quarantined = _QUARANTINED.labels(engine=eid)
         self._c_cancelled = _CANCELLED.labels(engine=eid)
         self._c_step_timeout = _STEP_TIMEOUTS.labels(engine=eid)
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
+        self._lock = make_lock("serving.engine._lock")
+        self._wake = make_condition("serving.engine._wake", self._lock)
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._accepting = False
@@ -306,18 +307,21 @@ class ServingEngine:
                 return self
             self._running = True
             self._accepting = True
-            self._step_timeout_s = float(
+            timeout_s = float(
                 get_flag("serving_step_timeout_s") or 0.0)
+            self._step_timeout_s = timeout_s
             epoch = self._epoch
-        self._thread = threading.Thread(target=self._loop, args=(epoch,),
+        # single creator: the _running CAS above guarantees exactly one
+        # start() reaches here, and stop() must join without the lock
+        self._thread = threading.Thread(target=self._loop, args=(epoch,),  # noqa: PTL902 — sole-winner write; joiners read the handle lock-free by design
                                         daemon=True,
                                         name=f"serving-engine-"
                                              f"{self.engine_id}")
         self._thread.start()
-        if self._step_timeout_s > 0:
+        if timeout_s > 0:
             self._watchdog = threading.Thread(
                 target=self._watchdog_loop,
-                args=(self._step_timeout_s,), daemon=True,
+                args=(timeout_s,), daemon=True,
                 name=f"serving-watchdog-{self.engine_id}")
             self._watchdog.start()
         return self
@@ -371,7 +375,7 @@ class ServingEngine:
         if wd is not None:
             wd.join(timeout=max(float(join_timeout), 1.0))
             self._watchdog = None
-        return {"engine": self.engine_id, "health": self.health,
+        return {"engine": self.engine_id, "health": self.health,  # noqa: PTL902 — post-join snapshot: both threads are dead by here
                 "wedged": wedged}
 
     def __enter__(self):
@@ -610,19 +614,19 @@ class ServingEngine:
         # the watchdog relaunches around it, the zombie must keep
         # writing into the ABANDONED buffers it captured here — never
         # into the fresh epoch's pools (self._pools by then)
-        pools_in, key_in = self._pools, self._key
+        pools_in, key_in = self._pools, self._key  # noqa: PTL902 — THE zombie-containment snapshot: lock-free on purpose, see comment above
         nan_lane = self._maybe_poison(plan)
         qw = _bucket(plan.tok.shape[1])
         n_progs = len(self._programs)
         prog = self._program(qw)
         cold_start = len(self._programs) > n_progs
         if cold_start:
-            self._dispatch_cold = True   # grant the compile grace
+            self._dispatch_cold = True   # noqa: PTL902 — GIL-atomic bool, sole loop-thread writer; the watchdog tolerates one stale poll of the compile-grace flag
         pad = qw - plan.tok.shape[1]
         tok = np.pad(plan.tok, ((0, 0), (0, pad)))
         pos = np.pad(plan.pos, ((0, 0), (0, pad)))
         page_ids = np.pad(plan.page_ids, ((0, 0), (0, pad)),
-                          constant_values=self.pool.sink)
+                          constant_values=self.pool.sink)  # noqa: PTL902 — epoch-snapshot pool handle; sink is immutable per pool
         slots = np.pad(plan.slots, ((0, 0), (0, pad)))
         # chaos NaN injection rides a logits bias vector: 0 everywhere
         # (jit-compiled no-op add) except the poisoned lane
@@ -745,7 +749,7 @@ class ServingEngine:
         # snapshot the device state FIRST (see _run_step_traced): a
         # zombie thread must only ever write into these captured,
         # abandoned buffers after a watchdog relaunch
-        pools_in, key_in = self._pools, self._key
+        pools_in, key_in = self._pools, self._key  # noqa: PTL902 — zombie-containment snapshot (window path), same contract as _run_step_traced
         # the fused program has no poison vector input, so "nan"
         # poison degrades to a pre-dispatch raise here — the failure
         # still quarantines through the same bisection (which pins the
@@ -767,7 +771,7 @@ class ServingEngine:
         prog = self._window_program(max_window)
         cold_start = len(self._programs) > n_progs
         if cold_start:
-            self._dispatch_cold = True   # grant the compile grace
+            self._dispatch_cold = True   # noqa: PTL902 — GIL-atomic bool, sole loop-thread writer; the watchdog tolerates one stale poll of the compile-grace flag
         # PRE-append lengths: the committed KV, not the plan's
         # post-step kv_lens — the compiled loop owns the append cursor
         kv0 = (plan.kv_lens - plan.q_lens).astype("int32")
@@ -1149,11 +1153,11 @@ class ServingEngine:
                "free_pages": self.pool.available(),
                "programs": len(self._programs),
                "health": self.health,
-               "quarantined": self._n_quarantined,
+               "quarantined": self._n_quarantined,  # noqa: PTL902 — stats() is an advisory lock-free snapshot; counters are GIL-atomic ints
                "quarantined_prompts": len(self._quarantined),
-               "cancelled": self._n_cancelled,
-               "watchdog_relaunches": self._relaunches,
+               "cancelled": self._n_cancelled,  # noqa: PTL902 — advisory snapshot (see above)
+               "watchdog_relaunches": self._relaunches,  # noqa: PTL902 — advisory snapshot (see above)
                "wedged_threads": self._wedged_threads}
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None:  # noqa: PTL902 — advisory snapshot; the handle swaps atomically at relaunch
             out["prefix_cache"] = self.prefix_cache.stats()
         return out

@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ...observability import events as _events
 from ...observability import metrics as _metrics
+from ...observability.lockwatch import make_lock
 from ...resilience.driver import restart_backoff
 
 __all__ = ["ReplicaHandle", "ReplicaSupervisor"]
@@ -145,7 +146,7 @@ class ReplicaSupervisor:
             for i in range(int(n_replicas))]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.replica._lock")
         # replicas due for relaunch: id -> monotonic deadline (backoff
         # staged without blocking the poll thread on one replica)
         self._relaunch_at: Dict[str, float] = {}

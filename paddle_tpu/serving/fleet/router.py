@@ -59,6 +59,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 from ...observability import events as _events
 from ...observability import metrics as _metrics
 from ...observability import tracing as _tracing
+from ...observability.lockwatch import make_condition, make_lock
 from ..prefix_cache import chained_page_keys
 from . import perf_merge
 from .replica import ReplicaHandle, ReplicaSupervisor
@@ -209,11 +210,11 @@ class FleetRouter:
         # which replica's prefix cache owns which chained keys
         self._owners: "OrderedDict[str, str]" = OrderedDict()
         self._owner_cap = int(owner_map_size)
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.router._lock")
         self._rr = itertools.count()
         self._req_ids = itertools.count(1)
         self._in_flight = 0
-        self._state = threading.Condition()
+        self._state = make_condition("fleet.router._state")
         self._closing = False
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None

@@ -60,3 +60,31 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks():
+    """Runtime analogue of PTL904: a test that returns while a
+    non-daemon thread it started is still alive would wedge the pytest
+    process at exit (the interpreter joins non-daemon threads).  Daemon
+    threads are a declared lifecycle decision and get a pass — e.g. the
+    deliberately-wedged engine loop in test_stop_detects_wedged_loop."""
+    import threading
+    import time
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.monotonic() + 2.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()
+                  and not t.daemon]
+        if not leaked:
+            return
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
+    pytest.fail(
+        "test leaked live non-daemon thread(s): "
+        + ", ".join(repr(t.name) for t in leaked)
+        + " — join them (or mark them daemon) before returning",
+        pytrace=False)

@@ -1,0 +1,582 @@
+"""PTL9xx concurrency rules: the static concheck pass, the stale-noqa
+sweep, and the analysis-gate wiring (SARIF, changed-only widening).
+
+Oracles:
+* each PTL901-904 rule fires on a planted-defect fixture (direct
+  inversion, inversion hidden behind a call chain, unlocked shared
+  state, naked wait, unfenced notify, undecided thread lifecycle,
+  unfenced epoch guard) and stays silent on the sanctioned patterns
+  (consistent order, Condition-wraps-lock aliasing, daemon threads,
+  fenced epochs, init-only writes, the allowlist);
+* the rules ride ``lint_source`` — path predicates scope them to the
+  threaded serving tier, ``# noqa: PTL902`` suppression applies;
+* PTL905 reports a suppression whose rule no longer fires and leaves
+  live suppressions (and noqa text inside docstrings) alone;
+* the shipped concurrency scope self-lints clean — the lint-marked
+  test IS the CI gate for the serving tier's locking discipline;
+* ``tools/run_analysis.py`` emits valid SARIF 2.1.0 and widens
+  --changed-only to the whole concurrency scope when any of its files
+  change.
+
+The runtime twin (FLAGS_lock_sanitizer) is covered by
+tests/test_lockwatch.py.
+"""
+import json
+import os
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import lint_source, stale_noqa_paths
+from paddle_tpu.analysis.concheck import (
+    PTL902_ALLOWLIST, concheck_findings_source, is_concurrency_path)
+from paddle_tpu.analysis.rules import RULES
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# any path CONCURRENCY_GLOBS match — fixtures lint as serving code
+_CONC_FILE = "paddle_tpu/serving/fixture.py"
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def _lint(src):
+    return lint_source(textwrap.dedent(src), _CONC_FILE)
+
+
+# ---------------------------------------------------------------------------
+# scoping + registration
+# ---------------------------------------------------------------------------
+
+def test_path_predicates():
+    assert is_concurrency_path(_CONC_FILE)
+    assert is_concurrency_path("paddle_tpu/serving/fleet/router.py")
+    assert is_concurrency_path("x/resilience/driver.py")
+    assert is_concurrency_path("x/observability/lockwatch.py")
+    assert is_concurrency_path("paddle_tpu/inference/serving.py")
+    assert is_concurrency_path(
+        "paddle_tpu/distributed/communication/store.py")
+    assert not is_concurrency_path("paddle_tpu/core/tensor.py")
+    assert not is_concurrency_path("paddle_tpu/inference/__init__.py")
+    # findings only appear under concurrency paths
+    src = textwrap.dedent("""
+        import threading
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PTL901" in _codes(lint_source(src, _CONC_FILE))
+    assert _codes(lint_source(src, "paddle_tpu/nn/layer/common.py")) == []
+
+
+def test_rules_registered():
+    for code in ("PTL901", "PTL902", "PTL903", "PTL904", "PTL905"):
+        assert code in RULES
+    assert RULES["PTL901"].severity == "error"
+    assert RULES["PTL902"].severity == "error"
+    assert RULES["PTL903"].severity == "warning"
+    assert RULES["PTL904"].severity == "warning"
+    assert RULES["PTL905"].severity == "warning"
+
+
+# ---------------------------------------------------------------------------
+# PTL901 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_ptl901_direct_inversion_fires():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PTL901" in _codes(fs)
+    msg = next(f for f in fs if f.code == "PTL901").message
+    assert "lock-order cycle" in msg
+
+
+def test_ptl901_inversion_via_call_chain_fires():
+    # f holds _a and calls helper, which takes _b; g nests them the
+    # other way — the cycle only exists through the call graph
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def _helper(self):
+                with self._b:
+                    pass
+            def f(self):
+                with self._a:
+                    self._helper()
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PTL901" in _codes(fs)
+
+
+def test_ptl901_consistent_order_stays_clean():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def g(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    assert "PTL901" not in _codes(fs)
+
+
+def test_ptl901_condition_aliases_its_lock():
+    # Condition(self._lock) IS self._lock for ordering purposes — the
+    # engine's _wake/_lock pair must not read as a 2-cycle
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake = threading.Condition(self._lock)
+                self._done = False
+            def f(self):
+                with self._lock:
+                    self._done = True
+            def g(self):
+                with self._wake:
+                    while not self._done:
+                        self._wake.wait()
+    """)
+    assert "PTL901" not in _codes(fs)
+
+
+def test_ptl901_factory_locks_recognized():
+    # the lockwatch factory spellings register locks exactly like the
+    # stdlib ctors (the production engine now builds locks this way)
+    fs = _lint("""
+        from paddle_tpu.observability.lockwatch import (
+            make_condition, make_lock)
+        class Engine:
+            def __init__(self):
+                self._a = make_lock("e._a")
+                self._b = make_condition("e._b")
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PTL901" in _codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# PTL902 — unsynchronized shared state
+# ---------------------------------------------------------------------------
+
+_PTL902_SRC = """
+    import threading
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+        def locked_bump(self):
+            with self._lock:
+                self.count += 1
+        def racy_bump(self):
+            self.count += 1
+"""
+
+
+def test_ptl902_unlocked_write_fires():
+    fs = _lint(_PTL902_SRC)
+    assert _codes(fs) == ["PTL902"]
+    assert "Engine.count" in fs[0].message
+    assert "write" in fs[0].message
+
+
+def test_ptl902_noqa_suppresses():
+    src = textwrap.dedent(_PTL902_SRC).replace(
+        "self.count += 1\n",
+        "self.count += 1  # noqa: PTL902 — test snapshot\n")
+    # both sites share the replace; only the racy one had a finding
+    assert _codes(lint_source(src, _CONC_FILE)) == []
+
+
+def test_ptl902_allowlist_and_init_only_stay_clean():
+    allowed = sorted(PTL902_ALLOWLIST)[0]
+    fs = _lint(f"""
+        import threading
+        class Handle:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.{allowed} = 0
+                self.frozen = 7
+            def poll(self):
+                with self._lock:
+                    self.{allowed} = 1
+            def read(self):
+                return self.{allowed} + self.frozen
+    """)
+    assert _codes(fs) == []
+
+
+def test_ptl902_private_helper_inherits_callers_lock():
+    # a private method only ever called under the lock is effectively
+    # locked — no finding for its accesses
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = 0
+            def _bump_locked(self):
+                self.state += 1
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+            def bump2(self):
+                with self._lock:
+                    self.state += 1
+    """)
+    assert _codes(fs) == []
+
+
+def test_ptl902_all_sites_mode_reports_every_line():
+    src = textwrap.dedent("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def locked(self):
+                with self._lock:
+                    self.count += 1
+            def racy_write(self):
+                self.count += 1
+            def racy_read(self):
+                return self.count
+    """)
+    one = concheck_findings_source(src, _CONC_FILE)
+    alls = concheck_findings_source(src, _CONC_FILE, all_sites=True)
+    assert len([f for f in one if f.code == "PTL902"]) == 1
+    assert len([f for f in alls if f.code == "PTL902"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# PTL903 — condition-wait hygiene
+# ---------------------------------------------------------------------------
+
+def test_ptl903_naked_wait_fires():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._cv = threading.Condition()
+            def f(self):
+                with self._cv:
+                    self._cv.wait(timeout=1)
+    """)
+    assert _codes(fs) == ["PTL903"]
+    assert "while" in fs[0].message
+
+
+def test_ptl903_unfenced_notify_fires():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._cv = threading.Condition()
+            def f(self):
+                self._cv.notify_all()
+    """)
+    assert _codes(fs) == ["PTL903"]
+    assert "notify" in fs[0].message
+
+
+def test_ptl903_sanctioned_shapes_stay_clean():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._done = False
+            def waiter(self):
+                with self._cv:
+                    while not self._done:
+                        self._cv.wait(timeout=1)
+            def notifier(self):
+                with self._cv:
+                    self._done = True
+                    self._cv.notify_all()
+            def _notify_locked(self):
+                self._cv.notify_all()
+            def bump(self):
+                with self._cv:
+                    self._notify_locked()
+    """)
+    assert _codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# PTL904 — thread lifecycle + epoch fencing
+# ---------------------------------------------------------------------------
+
+def test_ptl904_undecided_thread_fires():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def start(self):
+                t = threading.Thread(target=print)
+                t.start()
+    """)
+    assert _codes(fs) == ["PTL904"]
+    assert "lifecycle" in fs[0].message
+
+
+def test_ptl904_daemon_or_join_stays_clean():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def start(self):
+                t = threading.Thread(target=print, daemon=True)
+                t.start()
+            def run(self):
+                t = threading.Thread(target=print)
+                t.start()
+                t.join()
+            def fan_out(self):
+                threads = [threading.Thread(target=print)
+                           for _ in range(4)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+    """)
+    assert _codes(fs) == []
+
+
+def test_ptl904_unfenced_epoch_guard_fires():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._epoch = 0
+            def relaunch(self):
+                with self._lock:
+                    self._epoch += 1
+            def zombie_commit(self, epoch):
+                if self._epoch == epoch:
+                    return True
+    """)
+    assert "PTL904" in _codes(fs)
+    assert "epoch" in [f for f in fs if f.code == "PTL904"][0].message
+
+
+def test_ptl904_fenced_epoch_stays_clean():
+    fs = _lint("""
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._epoch = 0
+            def relaunch(self):
+                with self._lock:
+                    self._epoch += 1
+            def commit(self, epoch):
+                with self._lock:
+                    if self._epoch == epoch:
+                        return True
+    """)
+    assert "PTL904" not in _codes(fs)
+
+
+# ---------------------------------------------------------------------------
+# PTL905 — stale-noqa sweep
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def test_ptl905_stale_fires_live_survives(tmp_path):
+    path = _write(tmp_path, "paddle_tpu/serving/fixture.py", """
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self.clean = 0    # noqa: PTL902 — STALE: never racy
+            def locked(self):
+                with self._lock:
+                    self.count += 1
+            def racy(self):
+                self.count += 1   # noqa: PTL902 — live suppression
+    """)
+    fs = stale_noqa_paths([path])
+    assert _codes(fs) == ["PTL905"]
+    assert "PTL902" in fs[0].message
+    # the stale one is the clean-attr line, not the live one
+    assert "STALE" in open(path).readlines()[fs[0].line - 1]
+
+
+def test_ptl905_second_site_of_same_attr_is_live(tmp_path):
+    # PTL902 reports one site per attribute; the sweep must still see
+    # the OTHER suppressed sites as live (all-candidate-sites view)
+    path = _write(tmp_path, "paddle_tpu/serving/fixture.py", """
+        import threading
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def locked(self):
+                with self._lock:
+                    self.count += 1
+            def racy_a(self):
+                self.count += 1   # noqa: PTL902 — snapshot a
+            def racy_b(self):
+                self.count += 1   # noqa: PTL902 — snapshot b
+    """)
+    assert stale_noqa_paths([path]) == []
+
+
+def test_ptl905_ignores_docstrings_and_foreign_codes(tmp_path):
+    path = _write(tmp_path, "paddle_tpu/serving/fixture.py", '''
+        """Docs may show the syntax: ``# noqa: PTL902 reason``."""
+        import subprocess   # noqa: BLE001 — foreign linter's code
+    ''')
+    assert stale_noqa_paths([path]) == []
+
+
+def test_cli_stale_noqa_mode(tmp_path, capsys):
+    from paddle_tpu.analysis.cli import main
+    path = _write(tmp_path, "paddle_tpu/serving/fixture.py", """
+        X = 1   # noqa: PTL902 — nothing concurrent here at all
+    """)
+    rc = main([path, "--stale-noqa"])
+    out = capsys.readouterr().out
+    assert "PTL905" in out
+    assert rc == 0          # warning severity: never gates
+
+
+# ---------------------------------------------------------------------------
+# the gate: self-lint + run_analysis wiring
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_concurrency_scope_self_lints_clean():
+    """The shipped threaded tier carries zero PTL9xx findings — every
+    racy-looking site is either fixed or carries a reasoned noqa."""
+    from paddle_tpu.analysis import lint_paths
+    targets = [os.path.join(_REPO, "paddle_tpu")]
+    fs = [f for f in lint_paths(targets)
+          if f.code.startswith("PTL9")]
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.lint
+def test_concurrency_scope_has_no_stale_noqas():
+    fs = stale_noqa_paths([os.path.join(_REPO, "paddle_tpu")])
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def _run_analysis_module(monkeypatch):
+    import importlib
+    monkeypatch.syspath_prepend(os.path.join(_REPO, "tools"))
+    return importlib.import_module("run_analysis")
+
+
+def test_sarif_output(tmp_path, monkeypatch):
+    ra = _run_analysis_module(monkeypatch)
+    bad = _write(tmp_path, "paddle_tpu/serving/fixture.py", """
+        import threading
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    out = tmp_path / "out.sarif"
+    rc = ra.main(["--no-registry", "--no-cost-model",
+                  "--no-perf-model", "--no-metrics-schema",
+                  "--no-pass-verify", "--sarif", str(out), bad])
+    assert rc == 1                      # PTL901 is error severity
+    sarif = json.loads(out.read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "PTL901" in rule_ids
+    res = [r for r in run["results"] if r["ruleId"] == "PTL901"]
+    assert res and res[0]["level"] == "error"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("fixture.py")
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_changed_only_widens_to_concurrency_scope(monkeypatch):
+    ra = _run_analysis_module(monkeypatch)
+    engine = os.path.join(_REPO, "paddle_tpu", "serving", "engine.py")
+    monkeypatch.setattr(ra, "_changed_files",
+                        lambda repo, base="HEAD": [engine])
+    captured = {}
+    import paddle_tpu.analysis.lint as lint_mod
+
+    def _spy(targets, **kw):
+        captured["targets"] = list(targets)
+        return []
+    monkeypatch.setattr(lint_mod, "lint_paths", _spy)
+    rc = ra.main(["--changed-only", "--no-stale-noqa"])
+    assert rc == 0
+    targets = captured["targets"]
+    assert engine in targets
+    # the rest of the concurrency scope rode along
+    assert any(t.endswith(os.path.join("fleet", "router.py"))
+               for t in targets)
+    assert any(t.endswith(os.path.join("communication", "store.py"))
+               for t in targets)
+    # a non-concurrency change does NOT widen
+    tensor = os.path.join(_REPO, "paddle_tpu", "core", "tensor.py")
+    monkeypatch.setattr(ra, "_changed_files",
+                        lambda repo, base="HEAD": [tensor])
+    ra.main(["--changed-only", "--no-stale-noqa"])
+    assert captured["targets"] == [tensor]

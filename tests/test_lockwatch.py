@@ -1,0 +1,188 @@
+"""FLAGS_lock_sanitizer — the PTL9xx rules' runtime twin
+(observability.lockwatch).
+
+Oracles:
+* flag off → the factories return stdlib primitives (zero overhead,
+  no graph recording);
+* a planted lock-order inversion raises ``LockOrderError`` naming BOTH
+  threads and their full hold stacks — deterministically, at the
+  acquire that closes the cycle, *instead of the deadlock the
+  inversion would be* (the chaos-marked headline test);
+* instrumented Conditions keep the held-stack honest across wait()
+  (releasing inside wait must not leave the lock "held" in the graph);
+* waits/holds past the thresholds emit ``lock_contention`` events into
+  the JSONL envelope and the ``paddle_lock_*`` metric families record
+  acquisitions;
+* the serving engine built under the flag actually carries
+  instrumented locks (the factory adoption is live, not decorative).
+"""
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import events as obs_events
+from paddle_tpu.observability import lockwatch
+from paddle_tpu.observability.lockwatch import (
+    LockOrderError, make_condition, make_lock, make_rlock,
+    reset_lockwatch)
+
+
+@pytest.fixture
+def sanitizer_on():
+    paddle.set_flags({"FLAGS_lock_sanitizer": True})
+    reset_lockwatch()
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_lock_sanitizer": False})
+        reset_lockwatch()
+
+
+def test_flag_gates_instrumentation():
+    paddle.set_flags({"FLAGS_lock_sanitizer": False})
+    lock = make_lock("gate.lock")
+    assert type(lock) is type(threading.Lock())
+    rlock = make_rlock("gate.rlock")
+    assert type(rlock) is type(threading.RLock())
+    cond = make_condition("gate.cond")
+    assert isinstance(cond, threading.Condition)
+    # stdlib condition wraps a stdlib RLock, not a watched one
+    assert not isinstance(cond._lock, lockwatch._WatchedLock)
+
+
+@pytest.mark.chaos
+def test_planted_inversion_raises_instead_of_hanging(sanitizer_on):
+    """The headline contract: the B->A acquire that would deadlock
+    against an established A->B order raises a diagnostic naming both
+    threads' hold stacks — no interleaving luck required, no hang."""
+    A = make_lock("inv.A")
+    B = make_lock("inv.B")
+
+    def establish():
+        with A:
+            with B:
+                pass
+
+    t = threading.Thread(target=establish, name="establisher")
+    t.start()
+    t.join()
+
+    with pytest.raises(LockOrderError) as ei:
+        with B:
+            with A:          # closes the cycle: raises BEFORE blocking
+                pass
+    err = ei.value
+    assert err.lock == "inv.A"
+    assert err.other_thread == "establisher"
+    assert "inv.A" in err.path and "inv.B" in err.path
+    # both hold stacks are rendered with acquire sites
+    msg = str(err)
+    assert "establisher" in msg
+    assert "inv.B (acquired at" in msg
+    assert "inv.A (acquired at" in msg
+    # ...and the failing thread did NOT end up owning A
+    assert not A.locked()
+    assert not B.locked()
+
+
+def test_same_thread_nesting_one_order_is_fine(sanitizer_on):
+    A = make_lock("ok.A")
+    B = make_lock("ok.B")
+    for _ in range(3):
+        with A:
+            with B:
+                pass
+    # same-name re-entry across instances must not self-deadlock
+    A2 = make_lock("ok.A")
+    with A:
+        with A2:
+            pass
+
+
+def test_rlock_reentrancy(sanitizer_on):
+    R = make_rlock("re.R")
+    with R:
+        with R:
+            assert R._is_owned()
+    assert not R._is_owned()
+
+
+def test_condition_wait_keeps_graph_honest(sanitizer_on):
+    """wait() releases through the wrapper: while the waiter sleeps,
+    its held-stack must not pin the condition's lock, or the notifier
+    taking an unrelated lock first would false-positive."""
+    L = make_lock("cv.L")
+    cv = make_condition("cv.C", L)
+    other = make_lock("cv.other")
+    state = {"go": False, "err": None}
+
+    def waiter():
+        try:
+            with cv:
+                while not state["go"]:
+                    cv.wait(timeout=5)
+        except BaseException as e:   # pragma: no cover - diagnostic
+            state["err"] = e
+
+    t = threading.Thread(target=waiter, name="waiter")
+    t.start()
+    time.sleep(0.05)
+    with other:
+        with cv:                     # other -> L order, while waiter sleeps
+            state["go"] = True
+            cv.notify_all()
+    t.join(5)
+    assert not t.is_alive()
+    assert state["err"] is None
+
+
+def test_contention_events_and_metrics(tmp_path, sanitizer_on,
+                                       monkeypatch):
+    monkeypatch.setattr(lockwatch, "WAIT_THRESHOLD_S", 0.0)
+    monkeypatch.setattr(lockwatch, "HOLD_THRESHOLD_S", 0.0)
+    paddle.set_flags({"FLAGS_observability_dir": str(tmp_path)})
+    try:
+        L = make_lock("contend.L")
+        with L:
+            time.sleep(0.01)
+    finally:
+        paddle.set_flags({"FLAGS_observability_dir": ""})
+    evs = obs_events.read_events(str(tmp_path),
+                                 kinds=["lock_contention"])
+    phases = {e["phase"] for e in evs}
+    assert "wait" in phases and "hold" in phases
+    hold = next(e for e in evs if e["phase"] == "hold")
+    assert hold["lock"] == "contend.L"
+    assert hold["held_s"] >= 0.01
+    assert hold["thread"]
+    assert ":" in hold["site"]       # file:line of the acquire
+    # metric families recorded the acquisition
+    from paddle_tpu.observability import metrics
+    reg = metrics.default_registry()
+    fam = reg.get("paddle_lock_acquisitions_total")
+    assert fam is not None
+    assert fam.labels(lock="contend.L").value >= 1
+    assert reg.get("paddle_lock_contention_seconds") is not None
+    assert reg.get("paddle_lock_held_seconds") is not None
+
+
+def test_engine_adopts_instrumented_locks(sanitizer_on):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+    paddle.seed(0)
+    cfg = GPTConfig(num_layers=2, hidden_size=64, num_heads=4,
+                    vocab_size=128, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    engine = ServingEngine(model, max_batch=2, page_size=16)
+    assert isinstance(engine._lock, lockwatch._WatchedLock)
+    assert isinstance(engine._wake, threading.Condition)
+    assert engine._wake._lock is engine._lock
+    with engine:
+        out = engine.submit([1, 2, 3], max_new_tokens=4).wait(
+            timeout=120)
+    assert len(out) == 4

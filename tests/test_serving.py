@@ -1024,15 +1024,23 @@ def test_fused_engine_prestages_plans(gpt_model, fused_flags):
 def chaos(tmp_path):
     """Observability capture plus guaranteed fault-schedule and
     timeout-flag cleanup — a leaked schedule would poison every test
-    that follows."""
+    that follows.  Runs the whole scenario under FLAGS_lock_sanitizer:
+    every engine built inside the test gets instrumented locks, so a
+    lock-order inversion anywhere in the relaunch/quarantine machinery
+    fails the test with a LockOrderError instead of hanging it."""
     from paddle_tpu.resilience import faults
-    set_flags({"FLAGS_observability_dir": str(tmp_path)})
+    from paddle_tpu.observability.lockwatch import reset_lockwatch
+    set_flags({"FLAGS_observability_dir": str(tmp_path),
+               "FLAGS_lock_sanitizer": True})
+    reset_lockwatch()
     try:
         yield str(tmp_path)
     finally:
         faults.install_schedule(None)
         set_flags({"FLAGS_observability_dir": "",
-                   "FLAGS_serving_step_timeout_s": 0.0})
+                   "FLAGS_serving_step_timeout_s": 0.0,
+                   "FLAGS_lock_sanitizer": False})
+        reset_lockwatch()
 
 
 def _run_all(reqs, timeout=180):

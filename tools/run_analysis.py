@@ -35,11 +35,22 @@ scriptable twin of `pytest -m lint` for environments without pytest:
                                                  # skipped.  CI keeps
                                                  # full runs.
     python tools/run_analysis.py --changed-only --diff-base origin/main
+    python tools/run_analysis.py --sarif out.sarif  # SARIF 2.1.0 for
+                                                 # code-scanning UIs
 
 The lint pass also includes the PTL8xx SPMD/collective consistency
 rules (analysis/shardcheck.py: PartitionSpec arity vs the mesh,
 rank-divergent collective order, donation aliasing, DistributedStrategy
-knob coverage) over the distributed layer.
+knob coverage) over the distributed layer, and the PTL9xx concurrency
+rules (analysis/concheck.py: lock-order cycles, unsynchronized shared
+state, condition-wait and thread-lifecycle hygiene) over the threaded
+serving tier.  A stale-noqa sweep (PTL905) rides every run as warnings
+— it reports suppressions whose rule no longer fires but never gates.
+
+Because lock-order bugs cross file boundaries, --changed-only widens
+its target set to the WHOLE concurrency scope whenever any changed
+file is part of it: editing serving/engine.py re-lints the fleet
+router too.
 
 The cost-model pass (PTL301) runs paddle_tpu.tuning.cost_model
 .sanity_check(); the metrics-schema pass (PTL502) validates every
@@ -85,6 +96,57 @@ def _changed_files(repo: str, base: str = "HEAD") -> list:
     return files
 
 
+_SARIF_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def findings_to_sarif(findings) -> dict:
+    """SARIF 2.1.0 — one run, rules from the PTL registry, relative
+    artifact URIs so code-scanning UIs anchor them in the repo."""
+    from paddle_tpu.analysis.rules import RULES
+    used = sorted({f.code for f in findings})
+    rules = []
+    for code in used:
+        r = RULES.get(code)
+        rules.append({
+            "id": code,
+            "name": r.name if r else code,
+            "shortDescription": {"text": r.summary if r else code},
+            "helpUri": "docs/static_analysis.md",
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(
+                    r.severity if r else "warning", "warning")},
+        })
+    results = []
+    for f in findings:
+        uri = os.path.relpath(f.file, _REPO) if os.path.isabs(f.file) \
+            else f.file
+        results.append({
+            "ruleId": f.code,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": uri.replace(os.sep, "/")},
+                    "region": {"startLine": max(int(f.line), 1),
+                               "startColumn": max(int(f.col), 0) + 1},
+                },
+            }],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paddle_tpu.analysis",
+                "informationUri": "docs/static_analysis.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--no-registry", action="store_true",
@@ -115,6 +177,13 @@ def main(argv=None) -> int:
                     help="git ref --changed-only diffs against "
                          "(default HEAD)")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="also write the findings as SARIF 2.1.0 to "
+                         "OUT (for code-scanning UIs); '-' writes to "
+                         "stdout instead of the text summary")
+    ap.add_argument("--no-stale-noqa", action="store_true",
+                    help="skip the PTL905 stale-suppression sweep "
+                         "(on by default; warnings only, never gates)")
     ap.add_argument("paths", nargs="*",
                     help="override the default lint targets")
     args = ap.parse_args(argv)
@@ -136,11 +205,31 @@ def main(argv=None) -> int:
         if not targets:
             print("analysis: --changed-only found no changed .py files")
             return 0
+        # lock-order cycles are a cross-file property: method A in the
+        # engine and method B in the router together form the cycle.
+        # If the diff touches ANY concurrency-scope file, lint the
+        # whole scope so the other half of an inversion is visible.
+        from paddle_tpu.analysis.concheck import is_concurrency_path
+        if any(is_concurrency_path(t) for t in targets):
+            seen = set(targets)
+            for dirpath, _dirs, files in os.walk(
+                    os.path.join(_REPO, "paddle_tpu")):
+                for fn in files:
+                    p = os.path.join(dirpath, fn)
+                    if (fn.endswith(".py") and p not in seen
+                            and is_concurrency_path(p)):
+                        targets.append(p)
+                        seen.add(p)
     else:
         targets = args.paths or [os.path.join(_REPO, d)
                                  for d in ("paddle_tpu", "examples",
                                            "tools")]
     findings = lint_paths(targets)
+    if not args.no_stale_noqa:
+        # PTL905 is warning-severity by construction: a stale noqa is
+        # debt to clean up, not a build break
+        from paddle_tpu.analysis.lint import stale_noqa_paths
+        findings.extend(stale_noqa_paths(targets))
     if not args.no_registry:
         from paddle_tpu.analysis.registry_check import check_registry
         findings.extend(check_registry(deep_sample=8))
@@ -175,6 +264,14 @@ def main(argv=None) -> int:
 
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.code))
     errors = [f for f in findings if f.severity == "error"]
+    if args.sarif:
+        sarif = json.dumps(findings_to_sarif(findings), indent=2)
+        if args.sarif == "-":
+            print(sarif)
+            return 1 if errors else 0
+        with open(args.sarif, "w") as fh:
+            fh.write(sarif + "\n")
+        print(f"analysis: SARIF written to {args.sarif}")
     if args.json:
         print(json.dumps(findings_to_json(findings), indent=2))
     else:
@@ -182,6 +279,7 @@ def main(argv=None) -> int:
             print(f.render())
         print(f"analysis: {len(findings)} finding(s), "
               f"{len(errors)} error(s) over {len(targets)} target(s)"
+              + ("" if args.no_stale_noqa else " + stale-noqa")
               + ("" if args.no_registry else " + registry")
               + ("" if args.no_cost_model else " + cost-model")
               + ("" if args.no_perf_model else " + perf-model")
